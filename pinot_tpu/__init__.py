@@ -1,0 +1,26 @@
+"""pinot_tpu — a TPU-native realtime distributed OLAP framework.
+
+A from-scratch re-design of the capabilities of Apache Pinot (reference:
+/root/reference, 0.11.0-SNAPSHOT) for TPU hardware:
+
+- Columnar segments live as padded, dict-encoded device arrays in HBM
+  (replacing mmap'd ``PinotDataBuffer`` byte buffers,
+  pinot-segment-spi/.../memory/PinotDataBuffer.java).
+- The per-segment operator chain (filter -> doc-id-set -> projection ->
+  transform -> aggregate, pinot-core/.../operator/) is replaced by fused,
+  jitted mask-based kernel pipelines specialized per query shape.
+- The per-server multi-segment combine (BaseCombineOperator thread fan-out +
+  BlockingQueue merge) is replaced by batched kernel launches over a stacked
+  segment axis and ``psum``/``all_gather`` collectives over a
+  ``jax.sharding.Mesh``.
+- Broker / controller / ingestion control planes stay host-side Python/C++.
+
+int64 support is required for exact integral aggregation (SUM over 100M+
+int32 rows overflows 32 bits); TPUs execute int64 as lowered int32 pairs.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

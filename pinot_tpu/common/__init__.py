@@ -1,0 +1,2 @@
+from pinot_tpu.common.datatypes import DataType, FieldRole
+from pinot_tpu.common.schema import FieldSpec, Schema

@@ -1,0 +1,4 @@
+from pinot_tpu.storage.dictionary import Dictionary
+from pinot_tpu.storage.creator import SegmentCreator, build_segment
+from pinot_tpu.storage.segment import ImmutableSegment, ColumnMetadata, SegmentMetadata
+from pinot_tpu.storage.device import DeviceSegment, DeviceColumn

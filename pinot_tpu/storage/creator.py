@@ -1,0 +1,265 @@
+"""Segment creation: columnar data -> sealed segment directory.
+
+Equivalent of the reference's two-pass ``SegmentIndexCreationDriverImpl``
+(pinot-segment-local/.../creator/impl/SegmentIndexCreationDriverImpl.java:101
+init / :196 build): pass 1 collects per-column stats (cardinality, min/max,
+sortedness — creator/impl/stats/), pass 2 writes dictionaries, forward
+indexes and auxiliary indexes (SegmentColumnarIndexCreator.java). Here both
+passes are fused into vectorized numpy (``np.unique`` yields stats + dict +
+encoded ids at once), and indexes are written as dense mmap-able npy arrays
+instead of bit-packed buffers.
+
+Encoding policy (TPU-first, diverging from the reference's
+dictionary-everything default): STRING/JSON/BYTES and all dimension /
+datetime columns are dict-encoded (device work stays in int32 id space);
+metric columns are stored raw so SUM/AVG avoid a device-side gather.
+``no_dictionary_columns`` forces RAW for numeric columns.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from pinot_tpu.common.datatypes import DataType, FieldRole
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.storage import partition as partition_mod
+from pinot_tpu.storage.segment import (
+    METADATA_FILE,
+    ColumnMetadata,
+    Encoding,
+    ImmutableSegment,
+    SegmentMetadata,
+    write_creation_meta,
+)
+
+import json
+
+
+def _np_column(values, dtype: DataType) -> np.ndarray:
+    """Coerce an ingested column to its canonical numpy representation."""
+    if dtype.is_string_like:
+        if dtype is DataType.BYTES:
+            # fixed-width byte strings: np.save-able without pickle
+            return np.asarray([v if isinstance(v, bytes) else bytes(v) for v in values], dtype=np.bytes_)
+        return np.asarray([str(v) for v in values], dtype=np.str_)
+    arr = np.asarray(values)
+    if arr.dtype == object:
+        arr = np.asarray([dtype.convert(v) for v in values])
+    return arr.astype(dtype.np_dtype)
+
+
+class SegmentCreator:
+    def __init__(
+        self,
+        schema: Schema,
+        table_config: Optional[TableConfig] = None,
+        segment_name: str = "segment_0",
+    ):
+        self.schema = schema
+        self.table_config = table_config or TableConfig(table_name=schema.name)
+        self.segment_name = segment_name
+
+    def build(self, columns: Mapping[str, Sequence], out_dir: str) -> str:
+        """Build a sealed segment from column arrays; returns the segment dir."""
+        os.makedirs(out_dir, exist_ok=True)
+        idx_cfg = self.table_config.indexing
+        n_docs = None
+        col_meta: dict[str, ColumnMetadata] = {}
+
+        for name in self.schema.column_names():
+            spec = self.schema.field(name)
+            if name not in columns:
+                raise KeyError(f"input data missing column {name!r}")
+            raw_in = columns[name]
+
+            if not spec.single_value:
+                # multi-value: flatten + offsets
+                lens = np.fromiter((len(r) for r in raw_in), dtype=np.int64, count=len(raw_in))
+                flat = [v for row in raw_in for v in row]
+                raw = _np_column(flat, spec.data_type)
+                mv_off = np.zeros(len(raw_in) + 1, dtype=np.int64)
+                np.cumsum(lens, out=mv_off[1:])
+            else:
+                raw = _np_column(raw_in, spec.data_type)
+                mv_off = None
+
+            nd = len(raw_in)
+            if n_docs is None:
+                n_docs = nd
+            elif nd != n_docs:
+                raise ValueError(f"column {name} has {nd} rows, expected {n_docs}")
+
+            use_dict = self._use_dictionary(spec, idx_cfg.no_dictionary_columns)
+            meta = self._write_column(
+                name, spec, raw, mv_off, out_dir, use_dict, idx_cfg, nd
+            )
+            col_meta[name] = meta
+
+        time_col = self.table_config.time_column
+        start = end = None
+        if time_col and time_col in col_meta:
+            start = col_meta[time_col].min_value
+            end = col_meta[time_col].max_value
+
+        meta = SegmentMetadata(
+            segment_name=self.segment_name,
+            table_name=self.table_config.table_name,
+            n_docs=int(n_docs or 0),
+            columns=col_meta,
+            time_column=time_col,
+            start_time=start,
+            end_time=end,
+        )
+        with open(os.path.join(out_dir, METADATA_FILE), "w") as f:
+            json.dump(meta.to_json(), f, indent=1, default=_json_default)
+        write_creation_meta(out_dir)
+
+        # star-tree build happens after the base segment is sealed, like the
+        # reference (SegmentIndexCreationDriverImpl.java:290,316)
+        if idx_cfg.star_tree_configs:
+            from pinot_tpu.storage.startree import build_star_trees
+
+            build_star_trees(ImmutableSegment(out_dir), idx_cfg.star_tree_configs)
+        return out_dir
+
+    @staticmethod
+    def _use_dictionary(spec, no_dict_cols) -> bool:
+        if spec.data_type.is_string_like:
+            return True
+        if spec.name in no_dict_cols:
+            return False
+        return spec.role is not FieldRole.METRIC
+
+    def _write_column(self, name, spec, raw, mv_off, out_dir, use_dict, idx_cfg, n_docs):
+        def p(fname):
+            return os.path.join(out_dir, fname)
+
+        total_entries = len(raw)
+        is_sorted = bool(np.all(raw[1:] >= raw[:-1])) if total_entries > 1 else True
+        if not spec.single_value:
+            is_sorted = False
+
+        if use_dict:
+            from pinot_tpu.storage.dictionary import Dictionary
+
+            dictionary, ids = Dictionary.build(raw)
+            np.save(p(f"{name}.fwd.npy"), ids, allow_pickle=False)
+            dictionary.save(p(f"{name}.dict.npy"))
+            cardinality = dictionary.cardinality
+            if cardinality:
+                minv, maxv = dictionary.get(0), dictionary.get(cardinality - 1)
+            else:
+                minv = maxv = None
+            encoding = Encoding.DICT
+            fwd_for_inv = ids
+            dict_values = dictionary.values
+        else:
+            dict_values = None
+            np.save(p(f"{name}.fwd.npy"), raw, allow_pickle=False)
+            cardinality = int(len(np.unique(raw)))
+            minv, maxv = (raw.min(), raw.max()) if len(raw) else (None, None)
+            encoding = Encoding.RAW
+            fwd_for_inv = None
+
+        if mv_off is not None:
+            np.save(p(f"{name}.mvoff.npy"), mv_off, allow_pickle=False)
+
+        has_inverted = False
+        if name in idx_cfg.inverted_index_columns and fwd_for_inv is not None:
+            self._write_inverted(name, fwd_for_inv, cardinality, mv_off, out_dir)
+            has_inverted = True
+
+        has_bloom = False
+        if name in idx_cfg.bloom_filter_columns:
+            from pinot_tpu.storage.bloom import build_bloom
+
+            build_bloom(raw if dict_values is None else None, dict_values, p(f"{name}.bloom.npy"))
+            has_bloom = True
+
+        # Range acceleration: DICT columns get it for free — the sorted
+        # dictionary maps a value range to a dict-id interval. RAW columns
+        # fall back to scan until the bit-sliced range index lands, so the
+        # flag is only advertised where a reader can actually serve it.
+        has_range = name in idx_cfg.range_index_columns and encoding == Encoding.DICT
+
+        part_fn = part_n = parts = None
+        pmap = self.table_config.partition.column_partition_map
+        if name in pmap:
+            fn, n_part = pmap[name]
+            vals = raw if dict_values is None else dict_values
+            pids = partition_mod.partition_ids(np.asarray(vals), fn, n_part)
+            part_fn, part_n, parts = fn, n_part, sorted(set(int(x) for x in np.unique(pids)))
+
+        return ColumnMetadata(
+            name=name,
+            data_type=spec.data_type,
+            encoding=encoding,
+            cardinality=int(cardinality),
+            min_value=_scalar(minv),
+            max_value=_scalar(maxv),
+            is_sorted=is_sorted,
+            single_value=spec.single_value,
+            max_mv_entries=int(np.max(np.diff(mv_off))) if mv_off is not None and len(mv_off) > 1 else 1,
+            has_dictionary=use_dict,
+            has_inverted=has_inverted,
+            has_range=has_range,
+            has_bloom=has_bloom,
+            total_number_of_entries=int(total_entries),
+            partition_function=part_fn,
+            num_partitions=part_n,
+            partitions=parts,
+        )
+
+    @staticmethod
+    def _write_inverted(name, ids, cardinality, mv_off, out_dir):
+        """Inverted index: per-dict-id sorted doc lists, concatenated.
+
+        Dense equivalent of one RoaringBitmap per dict id
+        (OffHeapBitmapInvertedIndexCreator.java). ``argsort(kind='stable')``
+        groups doc ids by dict id while preserving doc order within a group.
+        """
+        if mv_off is not None:
+            # map each flattened entry back to its doc id
+            doc_of_entry = np.repeat(
+                np.arange(len(mv_off) - 1, dtype=np.int64), np.diff(mv_off)
+            )
+            order = np.argsort(ids, kind="stable")
+            docs = doc_of_entry[order].astype(np.int32)
+            counts = np.bincount(ids, minlength=cardinality)
+        else:
+            order = np.argsort(ids, kind="stable").astype(np.int32)
+            docs = order
+            counts = np.bincount(ids, minlength=cardinality)
+        offsets = np.zeros(cardinality + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        np.save(os.path.join(out_dir, f"{name}.inv.docs.npy"), docs, allow_pickle=False)
+        np.save(os.path.join(out_dir, f"{name}.inv.off.npy"), offsets, allow_pickle=False)
+
+
+def _scalar(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _json_default(o):
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, bytes):
+        return o.hex()
+    raise TypeError(f"not JSON serializable: {type(o)}")
+
+
+def build_segment(
+    schema: Schema,
+    columns: Mapping[str, Sequence],
+    out_dir: str,
+    table_config: Optional[TableConfig] = None,
+    segment_name: str = "segment_0",
+) -> ImmutableSegment:
+    SegmentCreator(schema, table_config, segment_name).build(columns, out_dir)
+    return ImmutableSegment(out_dir)

@@ -1,0 +1,212 @@
+"""Immutable segment: on-disk format + host-side reader.
+
+Equivalent of the reference's segment directory format + ``ImmutableSegmentImpl``
+(pinot-segment-local/.../indexsegment/immutable/ImmutableSegmentImpl.java and
+V1Constants.java:25-53), re-designed for a TPU loader:
+
+- ``metadata.json``         segment + per-column metadata (replaces
+                            metadata.properties + index_map)
+- ``<col>.fwd.npy``         forward index: int32 dict ids (DICT encoding) or
+                            raw typed values (RAW encoding); mmap-able dense
+                            arrays instead of bit-packed buffers so the device
+                            upload is a straight memcpy. (A bit-packed variant
+                            ``<col>.fwdpacked.bin`` is produced by the native
+                            C++ packer when enabled.)
+- ``<col>.mvoff.npy``       multi-value row offsets (n_docs+1) when the column
+                            is multi-value; fwd then holds the flattened values
+- ``<col>.dict.npy``        sorted dictionary values
+- ``<col>.inv.docs.npy`` /
+  ``<col>.inv.off.npy``     inverted index: concatenated sorted doc-id lists
+                            per dict id + offsets (card+1) — the dense analog
+                            of one RoaringBitmap per dict id
+                            (BitmapInvertedIndexReader.java)
+- ``<col>.bloom.npy``       bloom filter bitset (host-side pruning)
+- ``startree/``             star-tree pre-aggregated segment (own metadata)
+
+All arrays load with ``np.load(mmap_mode='r')`` — the host never copies a
+column until it is shipped to HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.storage.dictionary import Dictionary
+
+SEGMENT_FORMAT_VERSION = 1
+
+METADATA_FILE = "metadata.json"
+CREATION_META_FILE = "creation.meta.json"
+
+
+class Encoding:
+    DICT = "DICT"
+    RAW = "RAW"
+
+
+@dataclasses.dataclass
+class ColumnMetadata:
+    name: str
+    data_type: DataType
+    encoding: str
+    cardinality: int
+    min_value: object
+    max_value: object
+    is_sorted: bool
+    single_value: bool = True
+    max_mv_entries: int = 1
+    has_dictionary: bool = False
+    has_inverted: bool = False
+    has_range: bool = False
+    has_bloom: bool = False
+    total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
+    partition_function: Optional[str] = None
+    num_partitions: Optional[int] = None
+    partitions: Optional[list[int]] = None
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["data_type"] = self.data_type.value
+        for k in ("min_value", "max_value"):
+            v = d[k]
+            if isinstance(v, (np.generic,)):
+                d[k] = v.item()
+            if isinstance(v, bytes):
+                d[k] = v.hex()
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ColumnMetadata":
+        d = dict(d)
+        d["data_type"] = DataType(d["data_type"])
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class SegmentMetadata:
+    segment_name: str
+    table_name: str
+    n_docs: int
+    columns: dict[str, ColumnMetadata]
+    time_column: Optional[str] = None
+    start_time: Optional[int] = None
+    end_time: Optional[int] = None
+    format_version: int = SEGMENT_FORMAT_VERSION
+    crc: Optional[str] = None
+
+    def to_json(self) -> dict:
+        return {
+            "segment_name": self.segment_name,
+            "table_name": self.table_name,
+            "n_docs": self.n_docs,
+            "time_column": self.time_column,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "format_version": self.format_version,
+            "crc": self.crc,
+            "columns": {k: v.to_json() for k, v in self.columns.items()},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SegmentMetadata":
+        d = dict(d)
+        d["columns"] = {k: ColumnMetadata.from_json(v) for k, v in d["columns"].items()}
+        return cls(**d)
+
+
+class ImmutableSegment:
+    """Host-side handle on a sealed segment directory (mmap-backed).
+
+    The query path never reads values through this object doc-by-doc; it
+    either ships whole columns to the device (``DeviceSegment``) or runs
+    vectorized numpy over the mmap for host-only paths (pruning, string
+    materialization) — the moral replacement for ForwardIndexReader's
+    batch ``readDictIds``/``readValuesSV`` (ForwardIndexReader.java:85,114).
+    """
+
+    def __init__(self, segment_dir: str):
+        self.dir = segment_dir
+        with open(os.path.join(segment_dir, METADATA_FILE)) as f:
+            self.metadata = SegmentMetadata.from_json(json.load(f))
+        self._dict_cache: dict[str, Optional[Dictionary]] = {}
+        self._fwd_cache: dict[str, np.ndarray] = {}
+
+    # ---- identity -------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.metadata.segment_name
+
+    @property
+    def n_docs(self) -> int:
+        return self.metadata.n_docs
+
+    def column_names(self) -> list[str]:
+        return list(self.metadata.columns)
+
+    def column_metadata(self, col: str) -> ColumnMetadata:
+        return self.metadata.columns[col]
+
+    def _path(self, fname: str) -> str:
+        return os.path.join(self.dir, fname)
+
+    # ---- index readers --------------------------------------------------
+    def dictionary(self, col: str) -> Optional[Dictionary]:
+        if col not in self._dict_cache:
+            meta = self.column_metadata(col)
+            if meta.has_dictionary:
+                self._dict_cache[col] = Dictionary.load(self._path(f"{col}.dict.npy"))
+            else:
+                self._dict_cache[col] = None
+        return self._dict_cache[col]
+
+    def forward(self, col: str) -> np.ndarray:
+        """Dict ids (int32) for DICT columns, raw values for RAW columns."""
+        if col not in self._fwd_cache:
+            self._fwd_cache[col] = np.load(
+                self._path(f"{col}.fwd.npy"), mmap_mode="r", allow_pickle=False
+            )
+        return self._fwd_cache[col]
+
+    def mv_offsets(self, col: str) -> Optional[np.ndarray]:
+        if self.column_metadata(col).single_value:
+            return None
+        return np.load(self._path(f"{col}.mvoff.npy"), mmap_mode="r", allow_pickle=False)
+
+    def inverted(self, col: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """(concat_sorted_doc_ids, offsets[card+1]) or None."""
+        if not self.column_metadata(col).has_inverted:
+            return None
+        docs = np.load(self._path(f"{col}.inv.docs.npy"), mmap_mode="r", allow_pickle=False)
+        off = np.load(self._path(f"{col}.inv.off.npy"), mmap_mode="r", allow_pickle=False)
+        return docs, off
+
+    def bloom(self, col: str) -> Optional[np.ndarray]:
+        if not self.column_metadata(col).has_bloom:
+            return None
+        return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
+
+    # ---- raw value access (host-side materialization) -------------------
+    def values(self, col: str) -> np.ndarray:
+        """Decoded raw values for the whole column (host path only)."""
+        meta = self.column_metadata(col)
+        fwd = self.forward(col)
+        if meta.encoding == Encoding.DICT:
+            return self.dictionary(col).take(np.asarray(fwd))
+        return np.asarray(fwd)
+
+    def has_star_tree(self) -> bool:
+        return os.path.isdir(self._path("startree"))
+
+
+def write_creation_meta(segment_dir: str) -> None:
+    with open(os.path.join(segment_dir, CREATION_META_FILE), "w") as f:
+        json.dump(
+            {"creation_time_ms": int(time.time() * 1000), "version": SEGMENT_FORMAT_VERSION}, f
+        )

@@ -1,0 +1,18 @@
+"""Star-tree pre-aggregation index (placeholder until the index milestone).
+
+Target design (reference: pinot-segment-local/.../startree/v2/builder/
+BaseSingleTreeBuilder.java + StarTreeV2): sort docs by the dimension split
+order, build a tree whose nodes pre-aggregate doc ranges, materialize
+star-nodes for "dimension unconstrained" traversal, and store the
+pre-aggregated docs as a child segment under ``<segment>/startree/`` so the
+normal device pipeline can scan it.
+"""
+
+from __future__ import annotations
+
+
+def build_star_trees(segment, star_tree_configs) -> None:
+    raise NotImplementedError(
+        "star-tree index build is not implemented yet; remove star_tree_configs "
+        "from IndexingConfig or wait for the star-tree milestone"
+    )

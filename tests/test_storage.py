@@ -1,0 +1,153 @@
+"""Storage substrate tests: dictionary, segment create/load round-trip,
+inverted index, bloom, device upload. Mirrors the reference's tier-1 unit
+tests for index creators/readers (SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.storage.bloom import BloomFilter
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.dictionary import Dictionary
+from pinot_tpu.storage.segment import Encoding, ImmutableSegment
+
+
+class TestDictionary:
+    def test_build_roundtrip_ints(self):
+        raw = np.array([5, 3, 5, 7, 3, 3], dtype=np.int64)
+        d, ids = Dictionary.build(raw)
+        assert list(d.values) == [3, 5, 7]
+        np.testing.assert_array_equal(d.take(ids), raw)
+
+    def test_strings_sorted(self):
+        raw = np.array(["b", "a", "c", "a"], dtype=np.str_)
+        d, ids = Dictionary.build(raw)
+        assert list(d.values) == ["a", "b", "c"]
+        assert d.index_of("c") == 2
+        assert d.index_of("zz") == -1
+
+    def test_ids_of_partial_hits(self):
+        d, _ = Dictionary.build(np.array([10, 20, 30]))
+        np.testing.assert_array_equal(d.ids_of([20, 25, 30, 5]), [1, 2])
+
+    def test_range_ids(self):
+        d, _ = Dictionary.build(np.array([10, 20, 30, 40]))
+        assert d.range_ids(15, 35) == (1, 3)
+        assert d.range_ids(20, 30, lower_inclusive=False) == (2, 3)
+        assert d.range_ids(None, 30, upper_inclusive=False) == (0, 2)
+        assert d.range_ids(100, None) == (4, 4)
+
+
+class TestSegmentRoundTrip:
+    def test_metadata(self, baseball_segment, baseball_columns):
+        seg = baseball_segment
+        assert seg.n_docs == len(baseball_columns["runs"])
+        m = seg.column_metadata("playerName")
+        assert m.encoding == Encoding.DICT and m.has_dictionary and m.has_bloom
+        r = seg.column_metadata("runs")
+        assert r.encoding == Encoding.RAW
+        assert r.min_value == int(baseball_columns["runs"].min())
+        assert r.max_value == int(baseball_columns["runs"].max())
+
+    def test_values_roundtrip(self, baseball_segment, baseball_columns):
+        for col in ("playerName", "yearID", "runs", "salary"):
+            got = baseball_segment.values(col)
+            np.testing.assert_array_equal(
+                np.asarray(got), np.asarray(baseball_columns[col]).astype(got.dtype)
+            )
+
+    def test_reload_from_disk(self, baseball_segment, baseball_columns):
+        seg2 = ImmutableSegment(baseball_segment.dir)
+        np.testing.assert_array_equal(seg2.values("runs"), baseball_columns["runs"])
+
+    def test_inverted_index(self, baseball_segment, baseball_columns):
+        docs, off = baseball_segment.inverted("teamID")
+        d = baseball_segment.dictionary("teamID")
+        team = "team_7"
+        tid = d.index_of(team)
+        got = np.asarray(docs[off[tid] : off[tid + 1]])
+        expect = np.nonzero(np.asarray(baseball_columns["teamID"]) == team)[0]
+        np.testing.assert_array_equal(got, expect)
+
+    def test_bloom(self, baseball_segment):
+        bf = BloomFilter.load(baseball_segment._path("playerName.bloom.npy"))
+        assert bf.might_contain("player_003")
+        # fpp is ~1%, a random absent key should essentially always miss
+        misses = sum(not bf.might_contain(f"absent_{i}") for i in range(200))
+        assert misses >= 190
+
+
+class TestMultiValue:
+    def test_mv_column_roundtrip(self, tmp_path):
+        schema = Schema.build(
+            "mvtab",
+            multi_value_dimensions=[("tags", DataType.STRING)],
+            metrics=[("v", DataType.INT)],
+        )
+        cols = {"tags": [["a", "b"], ["b"], [], ["c", "a", "a"]], "v": [1, 2, 3, 4]}
+        cfg = TableConfig(table_name="mvtab", indexing=IndexingConfig(inverted_index_columns=["tags"]))
+        seg = build_segment(schema, cols, str(tmp_path / "mv0"), cfg, "mv0")
+        off = seg.mv_offsets("tags")
+        np.testing.assert_array_equal(off, [0, 2, 3, 3, 6])
+        d = seg.dictionary("tags")
+        docs, ioff = seg.inverted("tags")
+        aid = d.index_of("a")
+        np.testing.assert_array_equal(np.asarray(docs[ioff[aid] : ioff[aid + 1]]), [0, 3, 3])
+
+
+class TestDeviceUpload:
+    def test_device_segment_padding(self, baseball_segment):
+        from pinot_tpu.storage.device import DeviceSegment
+
+        ds = DeviceSegment(baseball_segment, columns=["playerName", "runs"])
+        assert ds.padded % 1024 == 0 and ds.padded >= ds.n_docs
+        ids = np.asarray(ds.column("playerName").data)
+        assert ids.shape == (ds.padded,)
+        assert (ids[ds.n_docs :] == -1).all()
+        runs = np.asarray(ds.column("runs").data)
+        assert runs.dtype == np.int32
+        np.testing.assert_array_equal(runs[: ds.n_docs], baseball_segment.values("runs"))
+
+    def test_batch_stacking(self, baseball_schema, baseball_columns, tmp_path):
+        from pinot_tpu.storage.device import DeviceSegmentBatch
+
+        segs = []
+        for i, sl in enumerate([slice(0, 3000), slice(3000, 5000)]):
+            cols = {k: np.asarray(v)[sl] for k, v in baseball_columns.items()}
+            segs.append(
+                build_segment(baseball_schema, cols, str(tmp_path / f"s{i}"), segment_name=f"s{i}")
+            )
+        batch = DeviceSegmentBatch(segs, columns=["runs"])
+        arr = np.asarray(batch.column("runs").data)
+        assert arr.shape == (2, batch.pad_to)
+        np.testing.assert_array_equal(batch.n_docs, [3000, 2000])
+
+
+class TestReviewRegressions:
+    def test_bytes_column_roundtrip(self, tmp_path):
+        schema = Schema.build("bt", dimensions=[("b", DataType.BYTES)], metrics=[("v", DataType.INT)])
+        cols = {"b": [b"\x01\x02", b"\xff", b"\x01\x02"], "v": [1, 2, 3]}
+        seg = build_segment(schema, cols, str(tmp_path / "b0"))
+        got = [bytes(x) for x in seg.values("b")]
+        assert got == [b"\x01\x02", b"\xff", b"\x01\x02"]
+
+    def test_ids_of_no_truncation_false_hit(self):
+        d, _ = Dictionary.build(np.array(["abc", "zz"], dtype=np.str_))
+        assert len(d.ids_of(["abcd"])) == 0
+        assert list(d.ids_of(["abc", "abcd", "zz"])) == [0, 1]
+
+    def test_ids_of_empty_dictionary(self):
+        d, _ = Dictionary.build(np.array([], dtype=np.int64))
+        assert len(d.ids_of([1, 2])) == 0
+
+    def test_ids_of_float_query_on_int_dict(self):
+        d, _ = Dictionary.build(np.array([1, 2, 3], dtype=np.int64))
+        assert len(d.ids_of(np.array([2.5]))) == 0
+        assert list(d.ids_of(np.array([2.0]))) == [1]
+
+    def test_empty_segment(self, tmp_path):
+        schema = Schema.build("e", dimensions=[("a", DataType.STRING)], metrics=[("m", DataType.INT)])
+        seg = build_segment(schema, {"a": [], "m": []}, str(tmp_path / "e0"))
+        assert seg.n_docs == 0
