@@ -1,0 +1,159 @@
+"""SQL parser / compiler / optimizer tests (reference analog:
+pinot-common CalciteSqlCompilerTest + pinot-core QueryOptimizer tests)."""
+
+import pytest
+
+from pinot_tpu.query.context import (
+    Expression,
+    FilterNode,
+    FilterNodeType,
+    PredicateType,
+)
+from pinot_tpu.query.optimizer import optimize_filter, optimize_query
+from pinot_tpu.sql.compiler import compile_query
+from pinot_tpu.sql.parser import SqlParseError, parse_sql
+
+
+class TestParser:
+    def test_basic_select(self):
+        q = compile_query("SELECT a, b FROM t")
+        assert q.table_name == "t"
+        assert [str(e) for e in q.select_expressions] == ["a", "b"]
+        assert q.limit == 10  # default
+
+    def test_star_and_count_star(self):
+        q = compile_query("SELECT COUNT(*) FROM t")
+        e = q.select_expressions[0]
+        assert e.is_function and e.name == "count"
+        q2 = compile_query("SELECT * FROM t LIMIT 5")
+        assert q2.select_expressions[0].name == "*"
+        assert q2.limit == 5
+
+    def test_aliases_and_group_order(self):
+        q = compile_query(
+            "SELECT playerName AS p, SUM(runs) AS total FROM baseballStats "
+            "GROUP BY p ORDER BY total DESC LIMIT 3"
+        )
+        assert q.aliases == ("p", "total")
+        assert str(q.group_by[0]) == "playerName"
+        ob = q.order_by[0]
+        assert not ob.ascending and str(ob.expression) == "sum(runs)"
+
+    def test_ordinal_group_by(self):
+        q = compile_query("SELECT league, COUNT(*) FROM t GROUP BY 1")
+        assert str(q.group_by[0]) == "league"
+
+    def test_where_tree(self):
+        q = compile_query(
+            "SELECT a FROM t WHERE x = 3 AND (y > 1.5 OR name IN ('a','b')) AND NOT z = 'q'"
+        )
+        f = q.filter
+        assert f.type is FilterNodeType.AND
+
+    def test_between_like_null(self):
+        q = compile_query(
+            "SELECT a FROM t WHERE x BETWEEN 2 AND 9 AND name LIKE 'foo%' AND b IS NOT NULL"
+        )
+        preds = [c.predicate for c in q.filter.children]
+        assert preds[0].type is PredicateType.RANGE
+        assert preds[0].lower == 2 and preds[0].upper == 9
+        assert preds[1].type is PredicateType.LIKE
+        assert preds[2].type is PredicateType.IS_NOT_NULL
+
+    def test_not_in(self):
+        q = compile_query("SELECT a FROM t WHERE x NOT IN (1, 2, 3)")
+        p = q.filter.predicate
+        assert p.type is PredicateType.NOT_IN and p.values == (1, 2, 3)
+
+    def test_flipped_comparison(self):
+        q = compile_query("SELECT a FROM t WHERE 5 < x")
+        p = q.filter.predicate
+        assert p.type is PredicateType.RANGE
+        assert p.lower == 5 and not p.lower_inclusive and p.upper is None
+
+    def test_limit_offset_forms(self):
+        q = compile_query("SELECT a FROM t LIMIT 20 OFFSET 40")
+        assert q.limit == 20 and q.offset == 40
+        q2 = compile_query("SELECT a FROM t LIMIT 40, 20")
+        assert q2.limit == 20 and q2.offset == 40
+
+    def test_set_options_and_explain(self):
+        q = compile_query("SET timeoutMs = 500; SET useStarTree = false; "
+                          "EXPLAIN PLAN FOR SELECT a FROM t")
+        assert q.explain
+        assert q.options_dict() == {"timeoutMs": 500, "useStarTree": False}
+
+    def test_expression_arith(self):
+        q = compile_query("SELECT a + b * 2, SUM(c) / COUNT(*) FROM t")
+        e = q.select_expressions[0]
+        assert e.name == "plus"
+        assert e.args[1].name == "times"
+
+    def test_count_distinct(self):
+        q = compile_query("SELECT COUNT(DISTINCT a) FROM t")
+        assert q.select_expressions[0].name == "distinctcount"
+
+    def test_case_when(self):
+        q = compile_query("SELECT CASE WHEN a > 1 THEN 'x' ELSE 'y' END FROM t")
+        e = q.select_expressions[0]
+        assert e.name == "case" and len(e.args) == 3
+
+    def test_cast(self):
+        q = compile_query("SELECT CAST(a AS LONG) FROM t")
+        e = q.select_expressions[0]
+        assert e.name == "cast" and e.args[1].value == "LONG"
+
+    def test_quoted_identifiers_and_string_escape(self):
+        q = compile_query('SELECT "select" FROM t WHERE s = \'it''s\'')
+        assert q.select_expressions[0].name == "select"
+
+    def test_errors(self):
+        with pytest.raises(SqlParseError):
+            parse_sql("SELECT FROM t")
+        with pytest.raises(SqlParseError):
+            parse_sql("SELECT a FROM t WHERE")
+        with pytest.raises(SqlParseError):
+            parse_sql("SELECT a FROM t trailing garbage ,")
+
+    def test_aggregations_listing(self):
+        q = compile_query(
+            "SELECT league, SUM(runs), MAX(hits) FROM t GROUP BY league "
+            "HAVING SUM(runs) > 10 ORDER BY MIN(salary)"
+        )
+        aggs = [str(a) for a in q.aggregations()]
+        assert aggs == ["sum(runs)", "max(hits)", "min(salary)"]
+
+
+class TestOptimizer:
+    def test_flatten_and_merge_in(self):
+        q = compile_query("SELECT a FROM t WHERE x = 1 OR x = 2 OR x IN (2, 3)")
+        f = optimize_filter(q.filter)
+        assert f.type is FilterNodeType.PREDICATE
+        assert f.predicate.type is PredicateType.IN
+        assert set(f.predicate.values) == {1, 2, 3}
+
+    def test_merge_ranges(self):
+        q = compile_query("SELECT a FROM t WHERE x > 3 AND x <= 10 AND x >= 4")
+        f = optimize_filter(q.filter)
+        p = f.predicate
+        assert p.lower == 4 and p.lower_inclusive
+        assert p.upper == 10 and p.upper_inclusive
+
+    def test_empty_range_folds_false(self):
+        q = compile_query("SELECT a FROM t WHERE x > 10 AND x < 5")
+        f = optimize_filter(q.filter)
+        assert f.type is FilterNodeType.CONSTANT_FALSE
+
+    def test_and_intersect_eq(self):
+        q = compile_query("SELECT a FROM t WHERE x = 1 AND x = 2")
+        f = optimize_filter(q.filter)
+        assert f.type is FilterNodeType.CONSTANT_FALSE
+
+    def test_double_not(self):
+        q = compile_query("SELECT a FROM t WHERE NOT NOT x = 1")
+        f = optimize_filter(q.filter)
+        assert f.type is FilterNodeType.PREDICATE
+
+    def test_optimize_query_noop_without_filter(self):
+        q = compile_query("SELECT a FROM t")
+        assert optimize_query(q) is q
