@@ -1,0 +1,382 @@
+"""Aggregation-function specs: canonical mergeable partial states.
+
+The TPU analog of the reference's AggregationFunction SPI
+(pinot-core/.../query/aggregation/function/AggregationFunction.java:
+``aggregate`` / ``aggregateGroupBySV`` / ``merge`` / ``extractFinalResult``).
+Each spec defines:
+
+- ``host_groups(values, group_idx, n)``  — numpy partial arrays per group
+- ``empty(n)`` / ``scatter_merge(acc, idx, part)`` — value-space merge used
+  by the reduce step (IndexedTable / DataTableReducer analog); device
+  executors convert their dense global-id partials into this same canonical
+  form, so reduce is backend-agnostic
+- ``finalize(part)``                      — final result column
+
+Partial layout: dict[str, np.ndarray] with per-group arrays; object arrays
+hold set/list-valued states (distinct sets, percentile value lists).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pinot_tpu.ops import hll as hll_ops
+from pinot_tpu.query.context import Expression
+
+
+class AggSpec:
+    """Base: subclasses define the state algebra."""
+
+    name: str = ""
+    # which select-time arg expressions need evaluating over filtered rows
+    def __init__(self, expr: Expression):
+        self.expr = expr
+        self.args = expr.args
+
+    # ---- host computation over filtered row values -----------------------
+    def host_groups(self, arg_values: list, group_idx: np.ndarray, n: int) -> dict:
+        raise NotImplementedError
+
+    def host_scalar(self, arg_values: list) -> dict:
+        """Non-group-by: one-group case."""
+        idx = np.zeros(len(arg_values[0]) if arg_values else 0, dtype=np.int64)
+        return self.host_groups(arg_values, idx, 1)
+
+    # ---- merge algebra ---------------------------------------------------
+    def empty(self, n: int) -> dict:
+        raise NotImplementedError
+
+    def scatter_merge(self, acc: dict, idx: np.ndarray, part: dict) -> None:
+        raise NotImplementedError
+
+    def finalize(self, part: dict) -> np.ndarray:
+        raise NotImplementedError
+
+    def result_type(self) -> str:
+        return "DOUBLE"
+
+
+def _obj_array(n, factory):
+    a = np.empty(n, dtype=object)
+    for i in range(n):
+        a[i] = factory()
+    return a
+
+
+class CountSpec(AggSpec):
+    name = "count"
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr)
+        self.args = ()  # COUNT(*) / COUNT(col) both count docs
+
+    def host_groups(self, arg_values, group_idx, n):
+        c = np.zeros(n, dtype=np.int64)
+        np.add.at(c, group_idx, 1)
+        return {"count": c}
+
+    def empty(self, n):
+        return {"count": np.zeros(n, dtype=np.int64)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.add.at(acc["count"], idx, part["count"])
+
+    def finalize(self, part):
+        return part["count"]
+
+    def result_type(self):
+        return "LONG"
+
+
+class SumSpec(AggSpec):
+    name = "sum"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        s = np.zeros(n, dtype=np.float64)
+        np.add.at(s, group_idx, v)
+        return {"sum": s}
+
+    def empty(self, n):
+        return {"sum": np.zeros(n, dtype=np.float64)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.add.at(acc["sum"], idx, part["sum"])
+
+    def finalize(self, part):
+        return part["sum"]
+
+
+class MinSpec(AggSpec):
+    name = "min"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        m = np.full(n, np.inf)
+        np.minimum.at(m, group_idx, v)
+        return {"min": m}
+
+    def empty(self, n):
+        return {"min": np.full(n, np.inf)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.minimum.at(acc["min"], idx, part["min"])
+
+    def finalize(self, part):
+        return part["min"]
+
+
+class MaxSpec(AggSpec):
+    name = "max"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        m = np.full(n, -np.inf)
+        np.maximum.at(m, group_idx, v)
+        return {"max": m}
+
+    def empty(self, n):
+        return {"max": np.full(n, -np.inf)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.maximum.at(acc["max"], idx, part["max"])
+
+    def finalize(self, part):
+        return part["max"]
+
+
+class AvgSpec(AggSpec):
+    name = "avg"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        s = np.zeros(n, dtype=np.float64)
+        c = np.zeros(n, dtype=np.int64)
+        np.add.at(s, group_idx, v)
+        np.add.at(c, group_idx, 1)
+        return {"sum": s, "count": c}
+
+    def empty(self, n):
+        return {"sum": np.zeros(n, dtype=np.float64), "count": np.zeros(n, dtype=np.int64)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.add.at(acc["sum"], idx, part["sum"])
+        np.add.at(acc["count"], idx, part["count"])
+
+    def finalize(self, part):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return part["sum"] / part["count"]
+
+
+class MinMaxRangeSpec(AggSpec):
+    name = "minmaxrange"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        mn = np.full(n, np.inf)
+        mx = np.full(n, -np.inf)
+        np.minimum.at(mn, group_idx, v)
+        np.maximum.at(mx, group_idx, v)
+        return {"min": mn, "max": mx}
+
+    def empty(self, n):
+        return {"min": np.full(n, np.inf), "max": np.full(n, -np.inf)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.minimum.at(acc["min"], idx, part["min"])
+        np.maximum.at(acc["max"], idx, part["max"])
+
+    def finalize(self, part):
+        return part["max"] - part["min"]
+
+
+class DistinctCountSpec(AggSpec):
+    """Exact distinct count: object array of python sets (host canonical
+    form; the device path decodes presence vectors into the same sets)."""
+
+    name = "distinctcount"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        sets = _obj_array(n, set)
+        for g, val in zip(group_idx, v.tolist()):
+            sets[g].add(val)
+        return {"sets": sets}
+
+    def empty(self, n):
+        return {"sets": _obj_array(n, set)}
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            acc["sets"][g] |= part["sets"][i]
+
+    def finalize(self, part):
+        return np.array([len(s) for s in part["sets"]], dtype=np.int64)
+
+    def result_type(self):
+        return "INT"
+
+
+class DistinctCountHLLSpec(AggSpec):
+    name = "distinctcounthll"
+
+    def __init__(self, expr: Expression, log2m: int = hll_ops.DEFAULT_LOG2M):
+        super().__init__(expr)
+        # optional second literal arg = log2m (reference signature)
+        if len(expr.args) > 1 and expr.args[1].is_literal:
+            log2m = int(expr.args[1].value)
+            self.args = expr.args[:1]
+        self.log2m = log2m
+        self.m = 1 << log2m
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        return {"regs": hll_ops.registers_np(v, group_idx, n, self.log2m)}
+
+    def empty(self, n):
+        return {"regs": np.zeros((n, self.m), dtype=np.int32)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.maximum.at(acc["regs"], idx, part["regs"])
+
+    def finalize(self, part):
+        return np.array([hll_ops.estimate(r) for r in part["regs"]], dtype=np.int64)
+
+    def result_type(self):
+        return "LONG"
+
+
+class PercentileSpec(AggSpec):
+    """Exact percentile: collects values (reference PercentileAggregationFunction
+    also materializes a DoubleArrayList)."""
+
+    name = "percentile"
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr)
+        if len(expr.args) < 2 or not expr.args[1].is_literal:
+            raise ValueError("percentile(column, p) requires a literal p")
+        self.p = float(expr.args[1].value)
+        self.args = expr.args[:1]
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        lists = _obj_array(n, list)
+        for g, val in zip(group_idx, v):
+            lists[g].append(val)
+        return {"vals": lists}
+
+    def empty(self, n):
+        return {"vals": _obj_array(n, list)}
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            acc["vals"][g].extend(part["vals"][i])
+
+    def finalize(self, part):
+        out = np.full(len(part["vals"]), np.nan)
+        for i, vals in enumerate(part["vals"]):
+            if vals:
+                # reference semantics: lower-interpolation rank percentile
+                out[i] = np.percentile(np.asarray(vals), self.p, method="lower")
+        return out
+
+
+class ModeSpec(AggSpec):
+    name = "mode"
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        counters = _obj_array(n, dict)
+        for g, val in zip(group_idx, v.tolist()):
+            d = counters[g]
+            d[val] = d.get(val, 0) + 1
+        return {"counts": counters}
+
+    def empty(self, n):
+        return {"counts": _obj_array(n, dict)}
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            d = acc["counts"][g]
+            for k, c in part["counts"][i].items():
+                d[k] = d.get(k, 0) + c
+
+    def finalize(self, part):
+        out = np.full(len(part["counts"]), np.nan)
+        for i, d in enumerate(part["counts"]):
+            if d:
+                # max count; ties broken by smallest value (reference default)
+                best = max(d.items(), key=lambda kv: (kv[1], -float(kv[0])))
+                out[i] = float(best[0])
+        return out
+
+
+class FirstLastWithTimeSpec(AggSpec):
+    def __init__(self, expr: Expression, is_first: bool):
+        super().__init__(expr)
+        self.is_first = is_first
+        self.name = "firstwithtime" if is_first else "lastwithtime"
+        # args: (valueCol, timeCol, 'dataType')
+        self.args = expr.args[:2]
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0], dtype=np.float64)
+        t = np.asarray(arg_values[1], dtype=np.int64)
+        val = np.full(n, np.nan)
+        tim = np.full(n, np.iinfo(np.int64).max if self.is_first else np.iinfo(np.int64).min,
+                      dtype=np.int64)
+        for g, vv, tt in zip(group_idx, v, t):
+            better = tt < tim[g] if self.is_first else tt > tim[g]
+            if better:
+                tim[g] = tt
+                val[g] = vv
+        return {"val": val, "time": tim}
+
+    def empty(self, n):
+        return {
+            "val": np.full(n, np.nan),
+            "time": np.full(n, np.iinfo(np.int64).max if self.is_first else np.iinfo(np.int64).min,
+                            dtype=np.int64),
+        }
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            tt = part["time"][i]
+            better = tt < acc["time"][g] if self.is_first else tt > acc["time"][g]
+            if better:
+                acc["time"][g] = tt
+                acc["val"][g] = part["val"][i]
+
+    def finalize(self, part):
+        return part["val"]
+
+
+_SPECS = {
+    "count": CountSpec,
+    "sum": SumSpec,
+    "min": MinSpec,
+    "max": MaxSpec,
+    "avg": AvgSpec,
+    "minmaxrange": MinMaxRangeSpec,
+    "distinctcount": DistinctCountSpec,
+    "distinctcountbitmap": DistinctCountSpec,  # same exact semantics
+    "segmentpartitioneddistinctcount": DistinctCountSpec,
+    "distinctcounthll": DistinctCountHLLSpec,
+    "percentile": PercentileSpec,
+    "percentileest": PercentileSpec,
+    "percentiletdigest": PercentileSpec,
+    "mode": ModeSpec,
+}
+
+
+def make_spec(expr: Expression) -> AggSpec:
+    name = expr.name
+    if name == "firstwithtime":
+        return FirstLastWithTimeSpec(expr, is_first=True)
+    if name == "lastwithtime":
+        return FirstLastWithTimeSpec(expr, is_first=False)
+    cls = _SPECS.get(name)
+    if cls is None:
+        raise KeyError(f"unsupported aggregation function: {name}")
+    return cls(expr)

@@ -1,0 +1,64 @@
+"""EXPLAIN PLAN FOR: render the logical plan as rows.
+
+Reference: ServerQueryExecutorV1Impl.processExplainPlanQueries (:338-352)
+renders the operator tree via Operator.toExplainString; here the plan is the
+engine's shape dispatch + filter tree + backend choice.
+"""
+
+from __future__ import annotations
+
+from pinot_tpu.query.context import FilterNode, FilterNodeType, QueryContext
+
+
+def _filter_lines(f: FilterNode, depth: int, out: list) -> None:
+    pad = "  " * depth
+    if f.type is FilterNodeType.PREDICATE:
+        out.append(f"{pad}FILTER_PREDICATE({f.predicate})")
+        return
+    if f.type in (FilterNodeType.CONSTANT_TRUE, FilterNodeType.CONSTANT_FALSE):
+        out.append(f"{pad}FILTER_{f.type.value}")
+        return
+    out.append(f"{pad}FILTER_{f.type.value}")
+    for c in f.children:
+        _filter_lines(c, depth + 1, out)
+
+
+def explain_plan(engine, q: QueryContext) -> dict:
+    lines: list[str] = []
+    aggs = q.aggregations()
+    if q.distinct:
+        shape = "DISTINCT"
+    elif aggs and q.group_by:
+        shape = "AGGREGATE_GROUPBY_ORDERBY"
+    elif aggs:
+        shape = "AGGREGATE"
+    else:
+        shape = "SELECT_ORDERBY" if q.order_by else "SELECT"
+
+    backend = "HOST(numpy)"
+    if engine.device is not None and engine.device.supports(q):
+        backend = "DEVICE(jax/xla)"
+
+    lines.append(f"BROKER_REDUCE(limit:{q.limit})")
+    lines.append(f"  COMBINE_{shape} [{backend}]")
+    lines.append(f"    PLAN_START(table:{q.table_name})")
+    lines.append(f"    {shape}({', '.join(str(e) for e in q.select_expressions)})")
+    if q.group_by:
+        lines.append(f"    GROUP_BY({', '.join(str(g) for g in q.group_by)})")
+    if q.filter is not None:
+        _filter_lines(q.filter, 2, lines)
+    else:
+        lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
+    lines.append("    PROJECT(" + ", ".join(sorted(q.columns())) + ")")
+
+    rows = [[ln, i, i - 1] for i, ln in enumerate(lines)]
+    return {
+        "resultTable": {
+            "dataSchema": {
+                "columnNames": ["Operator", "Operator_Id", "Parent_Id"],
+                "columnDataTypes": ["STRING", "INT", "INT"],
+            },
+            "rows": rows,
+        },
+        "exceptions": [],
+    }

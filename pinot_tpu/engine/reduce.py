@@ -1,0 +1,286 @@
+"""Reduce: merge IntermediateResults and produce the final ResultTable.
+
+The broker-side reduce of the reference (pinot-core/.../query/reduce/
+BrokerReduceService.java + GroupByDataTableReducer / AggregationDataTableReducer /
+SelectionDataTableReducer, HavingFilterHandler, PostAggregationHandler):
+merges mergeable partials in value space, applies HAVING, evaluates
+post-aggregation select expressions, orders, trims, and types the result.
+
+Works over results from any executor backend (host numpy, device batch,
+remote server) because partials are canonical (engine/aggspec.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pinot_tpu.engine import aggspec
+from pinot_tpu.engine.host import _order_indices, factorize_multi
+from pinot_tpu.engine.result import ExecutionStats, IntermediateResult, ResultTable, py_value
+from pinot_tpu.ops.transform import get_function
+from pinot_tpu.query.context import (
+    Expression,
+    FilterNode,
+    FilterNodeType,
+    PredicateType,
+    QueryContext,
+)
+
+
+def merge_intermediates(q: QueryContext, results: list) -> IntermediateResult:
+    results = [r for r in results if r is not None]
+    if not results:
+        raise ValueError("no results to merge")
+    shape = results[0].shape
+    stats = ExecutionStats()
+    for r in results:
+        stats.merge(r.stats)
+
+    if shape == "aggregation":
+        specs = [aggspec.make_spec(a) for a in q.aggregations()]
+        acc = [s.empty(1) for s in specs]
+        zero = np.zeros(1, dtype=np.int64)
+        for r in results:
+            for s, a, p in zip(specs, acc, r.agg_partials):
+                s.scatter_merge(a, zero, p)
+        return IntermediateResult(shape, agg_partials=acc, stats=stats)
+
+    if shape == "group_by":
+        specs = [aggspec.make_spec(a) for a in q.aggregations()]
+        nonempty = [r for r in results if len(r.group_keys[0]) > 0]
+        if not nonempty:
+            return IntermediateResult(
+                shape,
+                group_keys=results[0].group_keys,
+                agg_partials=[s.empty(0) for s in specs],
+                stats=stats,
+            )
+        concat_keys = [
+            np.concatenate([np.asarray(r.group_keys[i]) for r in nonempty])
+            for i in range(len(q.group_by))
+        ]
+        keys, ginv = factorize_multi(concat_keys)
+        n_merged = len(keys[0])
+        acc = [s.empty(n_merged) for s in specs]
+        off = 0
+        for r in nonempty:
+            n_r = len(r.group_keys[0])
+            idx = ginv[off : off + n_r]
+            off += n_r
+            for s, a, p in zip(specs, acc, r.agg_partials):
+                s.scatter_merge(a, idx, p)
+        return IntermediateResult(shape, group_keys=keys, agg_partials=acc, stats=stats)
+
+    if shape == "selection":
+        keys = results[0].rows.keys()
+        rows = {
+            k: np.concatenate([np.asarray(r.rows[k]) for r in results]) for k in keys
+        }
+        return IntermediateResult(shape, rows=rows, stats=stats)
+
+    if shape == "distinct":
+        concat_keys = [
+            np.concatenate([np.asarray(r.group_keys[i]) for r in results])
+            for i in range(len(results[0].group_keys))
+        ]
+        if len(concat_keys[0]) == 0:
+            keys = tuple(concat_keys)
+        else:
+            keys, _ = factorize_multi(concat_keys)
+        return IntermediateResult(shape, group_keys=keys, stats=stats)
+
+    raise ValueError(f"unknown result shape {shape}")
+
+
+# ---------------------------------------------------------------------------
+# post-aggregation expression evaluation
+# ---------------------------------------------------------------------------
+
+
+def eval_post(expr: Expression, env: dict):
+    """Evaluate a select/having/order expression in post-aggregation space:
+    ``env`` maps group-by expressions and aggregation expressions to value
+    arrays (PostAggregationHandler analog)."""
+    if expr in env:
+        return env[expr]
+    if expr.is_literal:
+        return np.asarray(expr.value)
+    if expr.is_identifier:
+        raise KeyError(
+            f"column {expr.name!r} must appear in GROUP BY to be selected"
+        )
+    fn = get_function(expr.name)
+    if expr.name == "cast":
+        return fn.np_fn(eval_post(expr.args[0], env), expr.args[1].value)
+    args = [eval_post(a, env) for a in expr.args]
+    return fn.np_fn(*args)
+
+
+def _having_mask(f: FilterNode, env: dict, n: int) -> np.ndarray:
+    t = f.type
+    if t is FilterNodeType.CONSTANT_TRUE:
+        return np.ones(n, dtype=bool)
+    if t is FilterNodeType.CONSTANT_FALSE:
+        return np.zeros(n, dtype=bool)
+    if t is FilterNodeType.AND:
+        m = _having_mask(f.children[0], env, n)
+        for c in f.children[1:]:
+            m &= _having_mask(c, env, n)
+        return m
+    if t is FilterNodeType.OR:
+        m = _having_mask(f.children[0], env, n)
+        for c in f.children[1:]:
+            m |= _having_mask(c, env, n)
+        return m
+    if t is FilterNodeType.NOT:
+        return ~_having_mask(f.children[0], env, n)
+    p = f.predicate
+    v = np.broadcast_to(np.asarray(eval_post(p.lhs, env)), (n,))
+    if p.type is PredicateType.EQ:
+        return v == p.value
+    if p.type is PredicateType.NOT_EQ:
+        return v != p.value
+    if p.type is PredicateType.IN:
+        return np.isin(v, list(p.values))
+    if p.type is PredicateType.NOT_IN:
+        return ~np.isin(v, list(p.values))
+    if p.type is PredicateType.RANGE:
+        m = np.ones(n, dtype=bool)
+        if p.lower is not None:
+            m &= (v >= p.lower) if p.lower_inclusive else (v > p.lower)
+        if p.upper is not None:
+            m &= (v <= p.upper) if p.upper_inclusive else (v < p.upper)
+        return m
+    raise NotImplementedError(f"HAVING predicate {p.type}")
+
+
+# ---------------------------------------------------------------------------
+# finalization per shape
+# ---------------------------------------------------------------------------
+
+
+def finalize(q: QueryContext, merged: IntermediateResult) -> ResultTable:
+    if merged.shape == "aggregation":
+        return _finalize_aggregation(q, merged)
+    if merged.shape == "group_by":
+        return _finalize_group_by(q, merged)
+    if merged.shape == "selection":
+        return _finalize_selection(q, merged)
+    if merged.shape == "distinct":
+        return _finalize_distinct(q, merged)
+    raise ValueError(merged.shape)
+
+
+def _np_type_name(arr: np.ndarray) -> str:
+    k = arr.dtype.kind
+    if k == "b":
+        return "BOOLEAN"
+    if k in ("i", "u"):
+        return "LONG" if arr.dtype.itemsize >= 8 else "INT"
+    if k == "f":
+        return "DOUBLE"
+    return "STRING"
+
+
+def _finalize_aggregation(q, merged) -> ResultTable:
+    aggs = q.aggregations()
+    specs = [aggspec.make_spec(a) for a in aggs]
+    env = {}
+    no_rows = merged.stats.num_docs_scanned == 0
+    for a, s, p in zip(aggs, specs, merged.agg_partials):
+        if no_rows:
+            # SQL semantics over zero rows: COUNT = 0, everything else NULL;
+            # NaN propagates through post-aggregation arithmetic like NULL
+            env[a] = np.asarray([0], dtype=np.int64) if s.name == "count" \
+                else np.asarray([np.nan])
+        else:
+            env[a] = s.finalize(p)
+    names, types, cols = [], [], []
+    for i, e in enumerate(q.select_expressions):
+        v = np.asarray(eval_post(e, env)).reshape(-1)
+        names.append(q.column_name(i))
+        types.append(_np_type_name(v))
+        val = py_value(v[0]) if len(v) else None
+        if isinstance(val, float) and np.isnan(val):
+            val = None
+        cols.append(val)
+    return ResultTable(names, types, [tuple(cols)])
+
+
+def _group_env(q, merged, specs):
+    env = {}
+    for g, k in zip(q.group_by, merged.group_keys):
+        env[g] = np.asarray(k)
+    for a, s, p in zip(q.aggregations(), specs, merged.agg_partials):
+        env[a] = s.finalize(p)
+    return env
+
+
+def _finalize_group_by(q, merged) -> ResultTable:
+    specs = [aggspec.make_spec(a) for a in q.aggregations()]
+    env = _group_env(q, merged, specs)
+    n = len(merged.group_keys[0])
+
+    if q.having is not None and n > 0:
+        mask = _having_mask(q.having, env, n)
+        env = {k: np.asarray(v)[mask] if np.asarray(v).ndim else v for k, v in env.items()}
+        n = int(mask.sum())
+
+    if q.order_by and n > 0:
+        order = _order_indices(
+            [(np.broadcast_to(np.asarray(eval_post(ob.expression, env)), (n,)), ob.ascending)
+             for ob in q.order_by]
+        )
+        env = {k: (np.asarray(v)[order] if np.asarray(v).ndim else v) for k, v in env.items()}
+
+    sel = q.offset, q.offset + q.limit
+    out_cols = []
+    names, types = [], []
+    for i, e in enumerate(q.select_expressions):
+        v = np.broadcast_to(np.asarray(eval_post(e, env)), (n,))[sel[0]: sel[1]]
+        names.append(q.column_name(i))
+        types.append(_np_type_name(v))
+        out_cols.append(v)
+    rows = [tuple(py_value(c[i]) for c in out_cols) for i in range(len(out_cols[0]) if out_cols else 0)]
+    return ResultTable(names, types, rows)
+
+
+def _finalize_selection(q, merged) -> ResultTable:
+    n = len(next(iter(merged.rows.values()))) if merged.rows else 0
+    idx = np.arange(n)
+    if q.order_by and n > 0:
+        order = _order_indices(
+            [(merged.rows[f"__ob{j}"], ob.ascending) for j, ob in enumerate(q.order_by)]
+        )
+        idx = idx[order]
+    idx = idx[q.offset : q.offset + q.limit]
+    names, types, cols = [], [], []
+    for i in range(len(q.select_expressions)):
+        v = np.asarray(merged.rows[i])[idx]
+        names.append(q.column_name(i))
+        types.append(_np_type_name(v))
+        cols.append(v)
+    rows = [tuple(py_value(c[j]) for c in cols) for j in range(len(idx))]
+    return ResultTable(names, types, rows)
+
+
+def _finalize_distinct(q, merged) -> ResultTable:
+    keys = [np.asarray(k) for k in merged.group_keys]
+    n = len(keys[0])
+    idx = np.arange(n)
+    if q.order_by and n > 0:
+        env = {e: k for e, k in zip(q.select_expressions, keys)}
+        order = _order_indices(
+            [(np.broadcast_to(np.asarray(eval_post(ob.expression, env)), (n,)), ob.ascending)
+             for ob in q.order_by]
+        )
+        idx = idx[order]
+    idx = idx[q.offset : q.offset + q.limit]
+    names, types, cols = [], [], []
+    for i, e in enumerate(q.select_expressions):
+        v = keys[i][idx]
+        names.append(q.column_name(i))
+        types.append(_np_type_name(v))
+        cols.append(v)
+    rows = [tuple(py_value(c[j]) for c in cols) for j in range(len(idx))]
+    return ResultTable(names, types, rows)

@@ -1,0 +1,85 @@
+"""Result containers: the DataTable / BrokerResponse analogs.
+
+``IntermediateResult`` is the mergeable per-executor result (reference:
+DataTable, pinot-core/.../common/datatable/) in *value space* — group keys
+are actual values, aggregation states are canonical mergeable partials
+(engine/aggspec.py). ``ResultTable`` is the final broker response payload
+(reference: BrokerResponseNative's resultTable).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ExecutionStats:
+    """Per-query execution statistics (ExecutionStatistics.java analog)."""
+
+    num_docs_scanned: int = 0
+    num_entries_scanned_in_filter: int = 0
+    num_entries_scanned_post_filter: int = 0
+    num_segments_queried: int = 0
+    num_segments_processed: int = 0
+    num_segments_matched: int = 0
+    num_segments_pruned: int = 0
+    total_docs: int = 0
+    time_used_ms: float = 0.0
+
+    def merge(self, other: "ExecutionStats") -> None:
+        self.num_docs_scanned += other.num_docs_scanned
+        self.num_entries_scanned_in_filter += other.num_entries_scanned_in_filter
+        self.num_entries_scanned_post_filter += other.num_entries_scanned_post_filter
+        self.num_segments_queried += other.num_segments_queried
+        self.num_segments_processed += other.num_segments_processed
+        self.num_segments_matched += other.num_segments_matched
+        self.num_segments_pruned += other.num_segments_pruned
+        self.total_docs += other.total_docs
+
+
+@dataclasses.dataclass
+class IntermediateResult:
+    """Mergeable executor output. Exactly one of the shapes is populated:
+
+    - aggregation:      ``agg_partials`` (list, one per aggregation)
+    - group-by:         ``group_keys`` (tuple of value arrays, one per
+                        group-by expr) + ``agg_partials`` (per-group arrays)
+    - selection:        ``rows`` (dict col->np array of selected docs)
+    - distinct:         ``group_keys`` only
+    """
+
+    shape: str  # "aggregation" | "group_by" | "selection" | "distinct"
+    agg_partials: Optional[list] = None
+    group_keys: Optional[tuple] = None
+    rows: Optional[dict] = None
+    stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
+
+
+@dataclasses.dataclass
+class ResultTable:
+    column_names: list
+    column_types: list  # DataType names (strings)
+    rows: list  # list of tuples of python values
+
+    def to_json(self) -> dict:
+        return {
+            "resultTable": {
+                "dataSchema": {
+                    "columnNames": self.column_names,
+                    "columnDataTypes": self.column_types,
+                },
+                "rows": [list(r) for r in self.rows],
+            }
+        }
+
+
+def py_value(v):
+    """numpy scalar → python value for the JSON layer."""
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, bytes):
+        return v.hex()
+    return v

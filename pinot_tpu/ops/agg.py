@@ -1,0 +1,116 @@
+"""Masked aggregation kernels + dense group-by scatter.
+
+Replaces the reference's AggregationFunction.aggregate /
+aggregateGroupBySV scatter loops (pinot-core/.../query/aggregation/function/,
+e.g. SumAggregationFunction) and the per-server IndexedTable merge: because
+group ids are in *global* dictionary space (engine/params.py), the whole
+(S, L) batch aggregates into one dense (G,) accumulator — segment combine
+happens inside the kernel launch, and cross-chip combine is a psum of the
+same accumulators (parallel/mesh.py).
+
+Accumulator dtypes: sums in float64 when x64 is enabled else float32
+(DOUBLE columns already narrowed on upload); int sums in int64 to match the
+reference's long accumulators (SumAggregationFunction uses double; COUNT
+long).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+NEG_INF = float("-inf")
+POS_INF = float("inf")
+
+
+# ---- scalar (non-group-by) aggregations over a mask -----------------------
+
+
+def agg_count(mask):
+    return jnp.sum(mask, dtype=jnp.int64)
+
+
+def agg_sum(values, mask):
+    dt = jnp.int64 if jnp.issubdtype(values.dtype, jnp.integer) else None
+    return jnp.sum(jnp.where(mask, values, 0), dtype=dt)
+
+
+def agg_min(values, mask):
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        big = jnp.iinfo(values.dtype).max
+    else:
+        big = POS_INF
+    return jnp.min(jnp.where(mask, values, big))
+
+
+def agg_max(values, mask):
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        small = jnp.iinfo(values.dtype).min
+    else:
+        small = NEG_INF
+    return jnp.max(jnp.where(mask, values, small))
+
+
+# ---- dense group-by scatter ----------------------------------------------
+# gids: int32 (S, L) global group ids; invalid/padded docs get gid = G
+# (one overflow slot, sliced off afterwards) so no branch is needed.
+
+
+def group_count(gids, num_groups: int):
+    flat = gids.reshape(-1)
+    out = jnp.zeros(num_groups + 1, dtype=jnp.int64).at[flat].add(1)
+    return out[:num_groups]
+
+
+def group_sum(gids, values, num_groups: int):
+    flat = gids.reshape(-1)
+    v = values.reshape(-1)
+    dt = jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer) else v.dtype
+    out = jnp.zeros(num_groups + 1, dtype=dt).at[flat].add(v.astype(dt))
+    return out[:num_groups]
+
+
+def group_min(gids, values, num_groups: int):
+    flat = gids.reshape(-1)
+    v = values.reshape(-1)
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        init = jnp.iinfo(v.dtype).max
+    else:
+        init = POS_INF
+    out = jnp.full(num_groups + 1, init, dtype=v.dtype).at[flat].min(v)
+    return out[:num_groups]
+
+
+def group_max(gids, values, num_groups: int):
+    flat = gids.reshape(-1)
+    v = values.reshape(-1)
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        init = jnp.iinfo(v.dtype).min
+    else:
+        init = NEG_INF
+    out = jnp.full(num_groups + 1, init, dtype=v.dtype).at[flat].max(v)
+    return out[:num_groups]
+
+
+def group_ids_combine(per_col_gids, cardinalities, mask, num_groups: int):
+    """Combine per-column global ids into one dense group id (ARRAY_BASED
+    regime of DictionaryBasedGroupKeyGenerator.java:43-45: raw key == group
+    id via cartesian arithmetic).
+
+    per_col_gids: list of int32 (S, L) arrays in [0, C_j)
+    cardinalities: static list of C_j
+    mask: filter & validity mask (S, L)
+    Returns int32 (S, L) with masked-out docs sent to `num_groups` (overflow
+    slot).
+    """
+    gid = None
+    for g, c in zip(per_col_gids, cardinalities):
+        gid = g if gid is None else gid * c + g
+    return jnp.where(mask, gid, num_groups)
+
+
+def distinct_presence(gids, num_groups: int):
+    """Presence vector over global ids (DISTINCT / DISTINCTCOUNT on a dict
+    column): 1 where any doc carries the id."""
+    flat = gids.reshape(-1)
+    out = jnp.zeros(num_groups + 1, dtype=jnp.int32).at[flat].max(1)
+    return out[:num_groups]

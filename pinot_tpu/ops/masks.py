@@ -1,0 +1,101 @@
+"""Predicate-mask kernels: the device replacement for filter operators.
+
+The reference walks per-doc iterators (pinot-core/.../operator/dociditerators/
+SVScanDocIdIterator.java:56-94) and RoaringBitmap algebra
+(AndFilterOperator/OrFilterOperator). On TPU the filter result is a dense
+boolean mask over the padded (S, L) segment batch — fixed shape, fuse-friendly
+— and AND/OR/NOT are elementwise ops XLA fuses into the surrounding kernel.
+
+Predicate literals arrive as *parameter arrays* resolved per segment on the
+host (dict-id space, see engine/params.py), so the jitted pipeline is reused
+across literal values — only shapes retrace.
+
+All functions here are shape-polymorphic jnp ops, traced inside the engine's
+jitted pipeline; nothing allocates per-doc.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def valid_mask(n_docs, padded_len: int, batched: bool):
+    """(S, L) or (L,) mask of real (non-padding) docs.
+
+    ``n_docs``: int32 (S,) vector when batched, scalar otherwise.
+    """
+    iota = jnp.arange(padded_len, dtype=jnp.int32)
+    if batched:
+        return iota[None, :] < n_docs[:, None]
+    return iota < n_docs
+
+
+# ---- dict-id space predicates (DICT-encoded columns) ----------------------
+# `ids` is the forward index: int32 (S, L); padding is -1.
+# Per-segment params use -2 (or empty ranges) as "no match in this segment".
+
+
+def eq_dict(ids, target_ids):
+    """EQ: ``target_ids`` int32 (S,) — the literal's dict id per segment."""
+    return ids == target_ids[:, None]
+
+
+def in_dict(ids, id_matrix):
+    """IN: ``id_matrix`` int32 (S, K), padded with -2.
+
+    K is small (the literal count); the (S, L, K) broadcast stays in
+    registers under XLA fusion.
+    """
+    return jnp.any(ids[:, :, None] == id_matrix[:, None, :], axis=-1)
+
+
+def range_dict(ids, lo, hi):
+    """RANGE on a sorted dictionary: per-segment id interval [lo, hi).
+
+    ``lo``/``hi`` int32 (S,). The host resolved value bounds to id bounds via
+    binary search (Dictionary.range_ids) — the dictionary-based range
+    evaluator trick (RangePredicateEvaluatorFactory).
+    """
+    return (ids >= lo[:, None]) & (ids < hi[:, None])
+
+
+def lut_dict(ids, lut):
+    """Arbitrary predicate on a dict column via per-dictid boolean LUT.
+
+    ``lut``: bool (S, C_max) — entry [s, d] says whether dict id d of segment
+    s matches (host evaluated the predicate once per dictionary entry, e.g.
+    regex over a few thousand strings instead of millions of rows — the same
+    leverage the reference gets from dictionary-based predicate evaluators).
+    Padding ids (-1) index entry 0 after clamping; callers AND with
+    valid_mask at the top of the tree, so the value is irrelevant.
+    """
+    clamped = jnp.clip(ids, 0, lut.shape[1] - 1)
+    return jnp.take_along_axis(lut, clamped, axis=1)
+
+
+# ---- raw-value space predicates (RAW-encoded columns / computed exprs) ----
+
+
+def eq_raw(values, literal):
+    return values == literal
+
+
+def neq_raw(values, literal):
+    return values != literal
+
+
+def in_raw(values, literals):
+    """``literals``: (K,) device vector."""
+    return jnp.any(values[..., None] == literals, axis=-1)
+
+
+def range_raw(values, lower, upper, lower_inclusive: bool, upper_inclusive: bool,
+              has_lower: bool, has_upper: bool):
+    """Static inclusivity/boundedness (part of the jit template); bounds are
+    traced scalars."""
+    m = jnp.ones(values.shape, dtype=bool)
+    if has_lower:
+        m &= (values >= lower) if lower_inclusive else (values > lower)
+    if has_upper:
+        m &= (values <= upper) if upper_inclusive else (values < upper)
+    return m

@@ -1,0 +1,37 @@
+"""Device top-k selection for ORDER BY ... LIMIT.
+
+Replaces the reference's per-segment selection-order-by priority queues +
+min/max-pruned combine (SelectionOrderByOperator,
+MinMaxValueBasedSelectionOrderByCombineOperator): the TPU path computes the
+full multi-key ordering permutation over the (flattened) batch with
+fixed-shape stable sorts and takes the first k — full sort per block is
+cheaper than data-dependent early exit on this hardware; the host merges
+only tiny (k,) results across batches.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def order_permutation(keys, valid, k: int):
+    """Indices of the top-k docs by lexicographic (key, ascending) order.
+
+    keys: list of (array (N,), ascending: bool) — most significant first.
+          Keys must be numeric (dict ids order by value because dictionaries
+          are sorted — same trick as the reference's dictId-based ordering).
+    valid: bool (N,) — invalid docs sort last regardless of key.
+    Returns int32 (k,) indices into the flattened batch.
+    """
+    n = valid.shape[0]
+    perm = jnp.arange(n, dtype=jnp.int32)
+    # stable lexicographic: sort by least-significant key first
+    for key, asc in reversed(list(keys)):
+        kp = key[perm]
+        order = jnp.argsort(kp, stable=True, descending=not asc)
+        perm = perm[order]
+    # validity as most significant: stable-partition valid docs to the front
+    vp = valid[perm]
+    order = jnp.argsort(~vp, stable=True)
+    perm = perm[order]
+    return perm[:k]

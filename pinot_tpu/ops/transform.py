@@ -1,0 +1,259 @@
+"""Transform-function registry: one semantic definition, two backends.
+
+The reference's ~50 TransformFunction classes
+(pinot-core/.../operator/transform/function/) plus the @ScalarFunction
+registry (pinot-common/.../function/scalar/) collapse here into a table of
+(numpy impl, jnp impl) pairs. The device column selects which impl a query
+template traces; host-only functions (strings, datetime) force the engine's
+host path for that expression.
+
+Division follows the reference: DOUBLE division, x/0 → inf (Java double
+semantics), so results match across backends and the duckdb oracle modulo
+float formatting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+except Exception:  # pragma: no cover
+    jnp = None
+
+
+class FunctionDef:
+    def __init__(self, name, np_fn, jnp_fn=None, min_args=1, max_args=None,
+                 returns_bool=False):
+        self.name = name
+        self.np_fn = np_fn
+        self.jnp_fn = jnp_fn  # None → host-only
+        self.min_args = min_args
+        self.max_args = max_args if max_args is not None else min_args
+        self.returns_bool = returns_bool
+
+    @property
+    def device_capable(self) -> bool:
+        return self.jnp_fn is not None
+
+
+REGISTRY: dict[str, FunctionDef] = {}
+
+
+def _reg(name, np_fn, jnp_fn=None, min_args=1, max_args=None, returns_bool=False):
+    REGISTRY[name] = FunctionDef(name, np_fn, jnp_fn, min_args, max_args, returns_bool)
+
+
+def get_function(name: str) -> FunctionDef:
+    f = REGISTRY.get(name)
+    if f is None:
+        raise KeyError(f"unknown function: {name}")
+    return f
+
+
+# ---- arithmetic -----------------------------------------------------------
+
+def _np_div(a, b):
+    with np.errstate(divide="ignore", invalid="ignore"):
+        return np.asarray(a, dtype=np.float64) / np.asarray(b, dtype=np.float64)
+
+
+def _jnp_div(a, b):
+    return jnp.asarray(a, dtype=jnp.float32) / jnp.asarray(b, dtype=jnp.float32)
+
+
+_reg("plus", lambda a, b: np.add(a, b), lambda a, b: jnp.add(a, b), 2)
+_reg("minus", lambda a, b: np.subtract(a, b), lambda a, b: jnp.subtract(a, b), 2)
+_reg("times", lambda a, b: np.multiply(a, b), lambda a, b: jnp.multiply(a, b), 2)
+_reg("divide", _np_div, _jnp_div, 2)
+_reg("mod", lambda a, b: np.mod(a, b), lambda a, b: jnp.mod(a, b), 2)
+_reg("abs", np.abs, (lambda a: jnp.abs(a)), 1)
+_reg("ceil", np.ceil, (lambda a: jnp.ceil(a)), 1)
+_reg("floor", np.floor, (lambda a: jnp.floor(a)), 1)
+_reg("exp", np.exp, (lambda a: jnp.exp(a)), 1)
+_reg("ln", np.log, (lambda a: jnp.log(a)), 1)
+_reg("log2", np.log2, (lambda a: jnp.log2(a)), 1)
+_reg("log10", np.log10, (lambda a: jnp.log10(a)), 1)
+_reg("sqrt", np.sqrt, (lambda a: jnp.sqrt(a)), 1)
+_reg("power", np.power, (lambda a, b: jnp.power(a, b)), 2)
+_reg("pow", np.power, (lambda a, b: jnp.power(a, b)), 2)
+_reg("least", np.minimum, (lambda a, b: jnp.minimum(a, b)), 2)
+_reg("greatest", np.maximum, (lambda a, b: jnp.maximum(a, b)), 2)
+_reg("sign", np.sign, (lambda a: jnp.sign(a)), 1)
+_reg("round", np.round, (lambda a: jnp.round(a)), 1, 2)
+
+# trigonometric (scalar/Trigonometric*.java)
+for _n, _np, _j in [
+    ("sin", np.sin, "sin"), ("cos", np.cos, "cos"), ("tan", np.tan, "tan"),
+    ("asin", np.arcsin, "arcsin"), ("acos", np.arccos, "arccos"),
+    ("atan", np.arctan, "arctan"), ("sinh", np.sinh, "sinh"),
+    ("cosh", np.cosh, "cosh"), ("tanh", np.tanh, "tanh"),
+    ("degrees", np.degrees, "degrees"), ("radians", np.radians, "radians"),
+]:
+    _reg(_n, _np, (lambda a, _f=_j: getattr(jnp, _f)(a)), 1)
+
+# ---- comparisons (usable inside CASE / arithmetic contexts) ---------------
+
+_reg("equals", lambda a, b: np.equal(a, b), lambda a, b: jnp.equal(a, b), 2, returns_bool=True)
+_reg("not_equals", lambda a, b: np.not_equal(a, b), lambda a, b: jnp.not_equal(a, b), 2, returns_bool=True)
+_reg("greater_than", lambda a, b: np.greater(a, b), lambda a, b: jnp.greater(a, b), 2, returns_bool=True)
+_reg("greater_than_or_equal", lambda a, b: np.greater_equal(a, b), lambda a, b: jnp.greater_equal(a, b), 2, returns_bool=True)
+_reg("less_than", lambda a, b: np.less(a, b), lambda a, b: jnp.less(a, b), 2, returns_bool=True)
+_reg("less_than_or_equal", lambda a, b: np.less_equal(a, b), lambda a, b: jnp.less_equal(a, b), 2, returns_bool=True)
+_reg("and", lambda *a: np.logical_and.reduce(a), lambda *a: jnp.stack(a).all(0), 2, 99, returns_bool=True)
+_reg("or", lambda *a: np.logical_or.reduce(a), lambda *a: jnp.stack(a).any(0), 2, 99, returns_bool=True)
+_reg("not", np.logical_not, (lambda a: jnp.logical_not(a)), 1, returns_bool=True)
+
+
+# ---- CASE / CAST ----------------------------------------------------------
+
+def _np_case(*args):
+    # (c1, v1, c2, v2, ..., else)
+    conds = list(args[:-1:2])
+    vals = list(args[1:-1:2])
+    return np.select(conds, vals, default=args[-1])
+
+
+def _jnp_case(*args):
+    out = args[-1]
+    for c, v in zip(reversed(args[:-1:2]), reversed(args[1:-1:2])):
+        out = jnp.where(c, v, out)
+    return out
+
+
+_reg("case", _np_case, _jnp_case, 3, 99)
+
+_CAST_NP = {
+    "INT": np.int32, "INTEGER": np.int32, "LONG": np.int64, "BIGINT": np.int64,
+    "FLOAT": np.float32, "DOUBLE": np.float64, "BOOLEAN": np.bool_,
+    "STRING": np.str_, "VARCHAR": np.str_, "TIMESTAMP": np.int64,
+}
+_CAST_JNP = {
+    "INT": "int32", "INTEGER": "int32", "LONG": "int64", "BIGINT": "int64",
+    "FLOAT": "float32", "DOUBLE": "float32", "BOOLEAN": "bool_",
+    "TIMESTAMP": "int64",
+}
+
+
+def _np_cast(a, type_name):
+    t = _CAST_NP.get(str(type_name).upper())
+    if t is None:
+        raise KeyError(f"CAST to unsupported type {type_name}")
+    if t is np.str_:
+        return np.asarray(a).astype(str)
+    if np.issubdtype(t, np.integer):
+        # SQL CAST truncates toward zero
+        return np.trunc(np.asarray(a, dtype=np.float64)).astype(t) \
+            if np.asarray(a).dtype.kind == "f" else np.asarray(a).astype(t)
+    return np.asarray(a).astype(t)
+
+
+def _jnp_cast(a, type_name):
+    t = _CAST_JNP.get(str(type_name).upper())
+    if t is None:
+        raise KeyError(f"CAST to {type_name} is host-only")
+    if t.startswith("int") and jnp.issubdtype(a.dtype, jnp.floating):
+        a = jnp.trunc(a)
+    return a.astype(getattr(jnp, t))
+
+
+_reg("cast", _np_cast, _jnp_cast, 2)
+
+
+# ---- string functions (host-only; device work stays in dict-id space) -----
+
+def _u(a):
+    return np.asarray(a).astype(str)
+
+
+_reg("lower", lambda a: np.char.lower(_u(a)))
+_reg("upper", lambda a: np.char.upper(_u(a)))
+_reg("trim", lambda a: np.char.strip(_u(a)))
+_reg("ltrim", lambda a: np.char.lstrip(_u(a)))
+_reg("rtrim", lambda a: np.char.rstrip(_u(a)))
+_reg("reverse", lambda a: np.array([s[::-1] for s in _u(a)]))
+_reg("length", lambda a: np.char.str_len(_u(a)).astype(np.int32))
+_reg("strlen", lambda a: np.char.str_len(_u(a)).astype(np.int32))
+_reg("concat", lambda *a: np.char.add(*[_u(x) for x in a]) if len(a) == 2
+     else _concat_many(a), min_args=2, max_args=99)
+_reg("substr", lambda a, start, end=None: _substr(a, start, end), 2, 3)
+_reg("startswith", lambda a, p: np.char.startswith(_u(a), p), 2, returns_bool=True)
+_reg("endswith", lambda a, p: np.char.endswith(_u(a), p), 2, returns_bool=True)
+_reg("replace", lambda a, f, t: np.char.replace(_u(a), f, t), 3)
+_reg("lpad", lambda a, n, p: np.array([s.rjust(int(n), str(p)) for s in _u(a)]), 3)
+_reg("rpad", lambda a, n, p: np.array([s.ljust(int(n), str(p)) for s in _u(a)]), 3)
+_reg("codepoint", lambda a: np.array([ord(s[0]) if s else 0 for s in _u(a)], dtype=np.int32))
+_reg("chr", lambda a: np.array([chr(int(x)) for x in np.asarray(a).ravel()]))
+
+
+def _concat_many(arrs):
+    out = _u(arrs[0])
+    for x in arrs[1:]:
+        out = np.char.add(out, _u(x))
+    return out
+
+
+def _substr(a, start, end=None):
+    # Pinot substr(col, start[, end]) is 0-based, end exclusive
+    s = _u(a)
+    start = int(start)
+    if end is None:
+        return np.array([x[start:] for x in s])
+    return np.array([x[start:int(end)] for x in s])
+
+
+# ---- datetime (host-only) -------------------------------------------------
+
+_reg("year", lambda a: _dtfield(a, "year"))
+_reg("month", lambda a: _dtfield(a, "month"))
+_reg("dayofmonth", lambda a: _dtfield(a, "day"))
+_reg("dayofweek", lambda a: _dtfield(a, "dayofweek"))
+_reg("hour", lambda a: _dtfield(a, "hour"))
+_reg("minute", lambda a: _dtfield(a, "minute"))
+_reg("second", lambda a: _dtfield(a, "second"))
+_reg("frommillis", lambda a: np.asarray(a, dtype=np.int64))
+_reg("tomillis", lambda a: np.asarray(a, dtype=np.int64))
+
+
+def _dtfield(millis, field):
+    dt = np.asarray(millis, dtype="int64").astype("datetime64[ms]")
+    Y = dt.astype("datetime64[Y]")
+    M = dt.astype("datetime64[M]")
+    D = dt.astype("datetime64[D]")
+    if field == "year":
+        return Y.astype(int) + 1970
+    if field == "month":
+        return (M - Y).astype(int) + 1
+    if field == "day":
+        return (D - M).astype(int) + 1
+    if field == "dayofweek":
+        return ((D.astype(int) + 4) % 7) + 1  # 1970-01-01 was a Thursday
+    sec = dt.astype("datetime64[s]")
+    if field == "hour":
+        return ((sec - D).astype(int) // 3600).astype(np.int32)
+    if field == "minute":
+        return (((sec - D).astype(int) // 60) % 60).astype(np.int32)
+    if field == "second":
+        return ((sec - D).astype(int) % 60).astype(np.int32)
+    raise KeyError(field)
+
+
+def _datetrunc(unit, millis):
+    unit = str(unit).lower()
+    ms = np.asarray(millis, dtype=np.int64)
+    table = {
+        "millisecond": 1, "second": 1000, "minute": 60_000, "hour": 3_600_000,
+        "day": 86_400_000, "week": 7 * 86_400_000,
+    }
+    if unit in table:
+        q = table[unit]
+        return (ms // q) * q
+    dt = ms.astype("datetime64[ms]")
+    if unit == "month":
+        return dt.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+    if unit == "year":
+        return dt.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+    raise KeyError(f"datetrunc unit {unit}")
+
+
+_reg("datetrunc", _datetrunc, min_args=2, max_args=2)

@@ -1,0 +1,373 @@
+"""End-to-end query correctness vs a sqlite3 oracle.
+
+The reference's strategy (SURVEY.md §4): build real segments, run the full
+server+broker pipeline in-process, and cross-check results against an
+embedded SQL engine (it uses H2; we use sqlite3 — duckdb is not in this image). Two segments exercise the
+per-segment execute + merge + reduce path, like the inner/inter-segment
+query suites (pinot-core/src/test/.../queries/BaseQueriesTest.java).
+"""
+
+import math
+
+import sqlite3
+import numpy as np
+import pytest
+
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    n = 6000
+    players = np.array([f"player_{i:03d}" for i in range(150)])
+    teams = np.array([f"team_{i}" for i in range(25)])
+    cols = {
+        "playerName": players[rng.integers(0, len(players), n)],
+        "teamID": teams[rng.integers(0, len(teams), n)],
+        "league": np.array(["AL", "NL"])[rng.integers(0, 2, n)],
+        "yearID": rng.integers(1980, 2020, n).astype(np.int32),
+        "runs": rng.integers(0, 150, n).astype(np.int32),
+        "hits": rng.integers(0, 200, n).astype(np.int32),
+        "homeRuns": rng.integers(0, 60, n).astype(np.int32),
+        "salary": np.round(rng.uniform(1e4, 1e7, n), 2),
+    }
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+
+    schema = Schema.build(
+        name="baseballStats",
+        dimensions=[
+            ("playerName", DataType.STRING),
+            ("teamID", DataType.STRING),
+            ("league", DataType.STRING),
+            ("yearID", DataType.INT),
+        ],
+        metrics=[
+            ("runs", DataType.INT),
+            ("hits", DataType.INT),
+            ("homeRuns", DataType.INT),
+            ("salary", DataType.DOUBLE),
+        ],
+    )
+    cfg = TableConfig(
+        table_name="baseballStats",
+        indexing=IndexingConfig(
+            inverted_index_columns=["teamID", "league"],
+            bloom_filter_columns=["playerName"],
+        ),
+    )
+    base = tmp_path_factory.mktemp("qseg")
+    engine = QueryEngine()
+    half = n // 2
+    for i, sl in enumerate([slice(0, half), slice(half, n)]):
+        part = {k: v[sl] for k, v in cols.items()}
+        seg = build_segment(schema, part, str(base / f"s{i}"), cfg, f"s{i}")
+        if not isinstance(seg, ImmutableSegment):
+            seg = ImmutableSegment(str(base / f"s{i}"))
+        engine.add_segment("baseballStats", seg)
+
+    con = sqlite3.connect(":memory:")
+    con.execute(
+        "CREATE TABLE baseballStats (playerName TEXT, teamID TEXT, "
+        "league TEXT, yearID INT, runs INT, hits INT, homeRuns INT, salary REAL)"
+    )
+    con.executemany(
+        "INSERT INTO baseballStats VALUES (?,?,?,?,?,?,?,?)",
+        list(
+            zip(
+                cols["playerName"].tolist(),
+                cols["teamID"].tolist(),
+                cols["league"].tolist(),
+                cols["yearID"].tolist(),
+                cols["runs"].tolist(),
+                cols["hits"].tolist(),
+                cols["homeRuns"].tolist(),
+                cols["salary"].tolist(),
+            )
+        ),
+    )
+    return engine, con
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        f = float(v)
+        return None if math.isnan(f) else f
+    return v
+
+
+def _rows_equal(a, b):
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            va, vb = _norm(va), _norm(vb)
+            if va is None or vb is None:
+                if va is not vb and not (va is None and vb is None):
+                    return False
+            elif isinstance(va, float) and isinstance(vb, float):
+                if not math.isclose(va, vb, rel_tol=1e-9, abs_tol=1e-6):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+def check(setup, sql, oracle_sql=None, unordered=False):
+    engine, con = setup
+    resp = engine.execute(sql)
+    assert not resp.get("exceptions"), resp.get("exceptions")
+    got = [tuple(r) for r in resp["resultTable"]["rows"]]
+    want = con.execute(oracle_sql or sql).fetchall()
+    if unordered:
+        got = sorted((tuple(map(repr, map(_norm, r))) for r in got))
+        want = sorted((tuple(map(repr, map(_norm, r))) for r in want))
+        assert got == want, f"\ngot:  {got[:5]}\nwant: {want[:5]}"
+    else:
+        assert _rows_equal(got, want), f"\ngot:  {got[:5]}\nwant: {want[:5]}"
+    return resp
+
+
+class TestAggregation:
+    def test_count_star(self, setup):
+        check(setup, "SELECT COUNT(*) FROM baseballStats")
+
+    def test_basic_aggs(self, setup):
+        check(
+            setup,
+            "SELECT SUM(runs), MIN(runs), MAX(runs), AVG(salary) FROM baseballStats",
+        )
+
+    def test_filtered_agg(self, setup):
+        check(
+            setup,
+            "SELECT SUM(runs) FROM baseballStats WHERE teamID = 'team_3' AND yearID > 2000",
+        )
+
+    def test_in_between_like(self, setup):
+        check(
+            setup,
+            "SELECT COUNT(*), SUM(hits) FROM baseballStats WHERE "
+            "teamID IN ('team_1','team_2','team_19') AND yearID BETWEEN 1990 AND 2005 "
+            "AND playerName LIKE 'player_0%'",
+        )
+
+    def test_not_filters(self, setup):
+        check(
+            setup,
+            "SELECT COUNT(*) FROM baseballStats WHERE league != 'AL' AND "
+            "teamID NOT IN ('team_1','team_2') AND NOT yearID < 1995",
+        )
+
+    def test_or_filter(self, setup):
+        check(
+            setup,
+            "SELECT COUNT(*) FROM baseballStats WHERE teamID = 'team_1' OR "
+            "(runs > 100 AND league = 'NL')",
+        )
+
+    def test_expression_filter(self, setup):
+        check(
+            setup,
+            "SELECT COUNT(*) FROM baseballStats WHERE runs + hits > 250",
+        )
+
+    def test_empty_result(self, setup):
+        resp = check(
+            setup,
+            "SELECT COUNT(*), SUM(runs), MAX(runs) FROM baseballStats WHERE league = 'XX'",
+        )
+        assert resp["resultTable"]["rows"][0][0] == 0
+
+    def test_post_aggregation(self, setup):
+        check(
+            setup,
+            "SELECT SUM(runs) / COUNT(*), MAX(runs) - MIN(runs) FROM baseballStats",
+            oracle_sql="SELECT CAST(SUM(runs) AS REAL) / COUNT(*), MAX(runs) - MIN(runs) FROM baseballStats",
+        )
+
+    def test_minmaxrange(self, setup):
+        check(
+            setup,
+            "SELECT MINMAXRANGE(runs) FROM baseballStats",
+            oracle_sql="SELECT MAX(runs) - MIN(runs) FROM baseballStats",
+        )
+
+    def test_distinctcount(self, setup):
+        check(
+            setup,
+            "SELECT DISTINCTCOUNT(teamID), COUNT(DISTINCT playerName) FROM baseballStats",
+            oracle_sql="SELECT COUNT(DISTINCT teamID), COUNT(DISTINCT playerName) FROM baseballStats",
+        )
+
+    def test_percentile(self, setup):
+        engine, con = setup
+        resp = engine.execute("SELECT PERCENTILE(runs, 50) FROM baseballStats")
+        got = resp["resultTable"]["rows"][0][0]
+        vals = np.array([r[0] for r in con.execute("SELECT runs FROM baseballStats").fetchall()])
+        want = float(np.percentile(vals, 50, method="lower"))
+        assert got == pytest.approx(want)
+
+
+class TestGroupBy:
+    def test_sum_group_by(self, setup):
+        check(
+            setup,
+            "SELECT playerName, SUM(runs) FROM baseballStats GROUP BY playerName "
+            "ORDER BY SUM(runs) DESC, playerName LIMIT 20",
+        )
+
+    def test_multi_group_by(self, setup):
+        check(
+            setup,
+            "SELECT league, teamID, COUNT(*), AVG(salary) FROM baseballStats "
+            "GROUP BY league, teamID ORDER BY league, teamID LIMIT 100",
+        )
+
+    def test_group_by_with_filter(self, setup):
+        check(
+            setup,
+            "SELECT teamID, MAX(homeRuns) FROM baseballStats WHERE yearID >= 2000 "
+            "GROUP BY teamID ORDER BY teamID LIMIT 50",
+        )
+
+    def test_having(self, setup):
+        check(
+            setup,
+            "SELECT teamID, COUNT(*) FROM baseballStats GROUP BY teamID "
+            "HAVING COUNT(*) > 230 ORDER BY COUNT(*) DESC, teamID LIMIT 30",
+        )
+
+    def test_group_by_expression(self, setup):
+        check(
+            setup,
+            "SELECT yearID - MOD(yearID, 10), SUM(runs) FROM baseballStats "
+            "GROUP BY yearID - MOD(yearID, 10) ORDER BY 1 LIMIT 10",
+            oracle_sql="SELECT yearID - MOD(yearID, 10) AS d, SUM(runs) FROM baseballStats "
+            "GROUP BY d ORDER BY d LIMIT 10",
+        )
+
+    def test_post_agg_in_group_by(self, setup):
+        check(
+            setup,
+            "SELECT league, SUM(runs) / SUM(hits) FROM baseballStats "
+            "GROUP BY league ORDER BY league",
+            oracle_sql="SELECT league, CAST(SUM(runs) AS REAL) / SUM(hits) FROM baseballStats "
+            "GROUP BY league ORDER BY league",
+        )
+
+    def test_group_by_unordered(self, setup):
+        check(
+            setup,
+            "SELECT teamID, SUM(runs) FROM baseballStats GROUP BY teamID LIMIT 1000",
+            unordered=True,
+        )
+
+    def test_count_distinct_group_by(self, setup):
+        check(
+            setup,
+            "SELECT league, DISTINCTCOUNT(playerName) FROM baseballStats "
+            "GROUP BY league ORDER BY league",
+            oracle_sql="SELECT league, COUNT(DISTINCT playerName) FROM baseballStats "
+            "GROUP BY league ORDER BY league",
+        )
+
+
+class TestSelection:
+    def test_selection_order_by(self, setup):
+        check(
+            setup,
+            "SELECT playerName, runs FROM baseballStats "
+            "ORDER BY runs DESC, playerName LIMIT 15",
+        )
+
+    def test_selection_filter_order(self, setup):
+        check(
+            setup,
+            "SELECT playerName, teamID, salary FROM baseballStats WHERE league = 'AL' "
+            "ORDER BY salary DESC, playerName, teamID LIMIT 10",
+        )
+
+    def test_selection_expression(self, setup):
+        check(
+            setup,
+            "SELECT playerName, runs + hits FROM baseballStats "
+            "ORDER BY runs + hits DESC, playerName LIMIT 12",
+        )
+
+    def test_selection_offset(self, setup):
+        check(
+            setup,
+            "SELECT playerName, runs FROM baseballStats "
+            "ORDER BY runs DESC, playerName LIMIT 10 OFFSET 20",
+        )
+
+    def test_selection_no_order(self, setup):
+        engine, con = setup
+        resp = engine.execute("SELECT playerName FROM baseballStats LIMIT 7")
+        assert len(resp["resultTable"]["rows"]) == 7
+
+    def test_case_expression(self, setup):
+        check(
+            setup,
+            "SELECT playerName, CASE WHEN runs > 100 THEN 'high' ELSE 'low' END "
+            "FROM baseballStats ORDER BY runs DESC, playerName LIMIT 8",
+            oracle_sql="SELECT playerName, CASE WHEN runs > 100 THEN 'high' ELSE 'low' END "
+            "FROM baseballStats ORDER BY runs DESC, playerName LIMIT 8",
+        )
+
+
+class TestDistinct:
+    def test_distinct(self, setup):
+        check(
+            setup,
+            "SELECT DISTINCT league FROM baseballStats ORDER BY league",
+        )
+
+    def test_distinct_multi(self, setup):
+        check(
+            setup,
+            "SELECT DISTINCT league, teamID FROM baseballStats "
+            "ORDER BY league, teamID LIMIT 60",
+        )
+
+
+class TestMisc:
+    def test_explain(self, setup):
+        engine, _ = setup
+        resp = engine.execute(
+            "EXPLAIN PLAN FOR SELECT SUM(runs) FROM baseballStats WHERE teamID = 'team_1'"
+        )
+        ops = [r[0] for r in resp["resultTable"]["rows"]]
+        assert any("BROKER_REDUCE" in o for o in ops)
+        assert any("FILTER_PREDICATE" in o for o in ops)
+
+    def test_stats_present(self, setup):
+        engine, _ = setup
+        resp = engine.execute("SELECT COUNT(*) FROM baseballStats WHERE league = 'AL'")
+        assert resp["totalDocs"] == 6000
+        assert resp["numSegmentsProcessed"] == 2
+        assert 0 < resp["numDocsScanned"] < 6000
+
+    def test_bloom_pruning(self, setup):
+        engine, _ = setup
+        resp = engine.execute(
+            "SELECT COUNT(*) FROM baseballStats WHERE playerName = 'nonexistent_player'"
+        )
+        assert resp["resultTable"]["rows"][0][0] == 0
+        assert resp["numSegmentsPrunedByServer"] == 2
+
+    def test_unknown_table_error(self, setup):
+        engine, _ = setup
+        resp = engine.execute("SELECT COUNT(*) FROM nope")
+        assert resp["exceptions"]
