@@ -1,0 +1,477 @@
+"""Device (JAX/XLA) query executor: the TPU hot path.
+
+Replaces the reference's per-segment operator chains + combine thread pool
+(§3.1 of SURVEY.md, BaseCombineOperator.java:79-145) with ONE jitted kernel
+pipeline over the whole (S, L) segment batch:
+
+    filter masks → (optional) global-id group keys → dense scatter aggregation
+
+compiled once per *query template* (literals parameterized out — the explicit
+form of InstancePlanMakerImplV2's per-shape plan dispatch) and cached. The
+segment axis is the axis parallel/mesh.py shards over the device mesh; the
+per-chip result is the same dense accumulator, combined with psum.
+
+Group-by runs in global dictionary id space (engine/params.py), so the dense
+(G,) accumulator directly replaces Pinot's ARRAY_BASED group-key regime
+(DictionaryBasedGroupKeyGenerator.java:43-45) *and* its ConcurrentIndexedTable
+merge: groups are already aligned across segments when the scatter lands.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.engine import aggspec
+from pinot_tpu.engine.params import BatchContext, DeviceUnsupported, build_expr, build_filter
+from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
+from pinot_tpu.ops import agg as agg_ops
+from pinot_tpu.ops import hll as hll_ops
+from pinot_tpu.ops import masks as mask_ops
+from pinot_tpu.ops.transform import get_function
+from pinot_tpu.query.context import Expression, QueryContext
+from pinot_tpu.storage.segment import Encoding
+
+DEVICE_AGGS = {
+    "count", "sum", "min", "max", "avg", "minmaxrange",
+    "distinctcount", "distinctcountbitmap", "distinctcounthll",
+    "segmentpartitioneddistinctcount",
+}
+
+MAX_DENSE_GROUPS = 1 << 22        # ARRAY_BASED regime guard (~4M groups)
+MAX_PRESENCE_CELLS = 1 << 24      # distinctcount (G, C) presence guard
+
+
+# ---------------------------------------------------------------------------
+# template evaluation (traced inside jit)
+# ---------------------------------------------------------------------------
+
+
+def _eval_expr(tpl, cols, params):
+    kind = tpl[0]
+    if kind == "lit":
+        return params[tpl[1]]
+    if kind == "raw":
+        return cols[tpl[1]]
+    if kind == "dictval":
+        lut = params[f"vlut_{tpl[1]}"]
+        ids = jnp.clip(cols[tpl[1]], 0, lut.shape[1] - 1)
+        return jnp.take_along_axis(lut, ids, axis=1)
+    if kind == "cast":
+        return get_function("cast").jnp_fn(_eval_expr(tpl[1], cols, params), tpl[2])
+    fn = get_function(kind)
+    args = [_eval_expr(a, cols, params) for a in tpl[1:]]
+    return fn.jnp_fn(*args)
+
+
+def _eval_filter(tpl, cols, params, shape):
+    kind = tpl[0]
+    if kind == "true":
+        return jnp.ones(shape, dtype=bool)
+    if kind == "false":
+        return jnp.zeros(shape, dtype=bool)
+    if kind == "and":
+        m = _eval_filter(tpl[1], cols, params, shape)
+        for c in tpl[2:]:
+            m &= _eval_filter(c, cols, params, shape)
+        return m
+    if kind == "or":
+        m = _eval_filter(tpl[1], cols, params, shape)
+        for c in tpl[2:]:
+            m |= _eval_filter(c, cols, params, shape)
+        return m
+    if kind == "not":
+        return ~_eval_filter(tpl[1], cols, params, shape)
+    if kind == "eq_dict":
+        return mask_ops.eq_dict(cols[tpl[1]], params[tpl[2]])
+    if kind == "in_dict":
+        return mask_ops.in_dict(cols[tpl[1]], params[tpl[2]])
+    if kind == "range_dict":
+        return mask_ops.range_dict(cols[tpl[1]], params[tpl[2]], params[tpl[3]])
+    if kind == "lut_dict":
+        return mask_ops.lut_dict(cols[tpl[1]], params[tpl[2]])
+    if kind == "eq_raw":
+        return mask_ops.eq_raw(_eval_expr(tpl[1], cols, params), params[tpl[2]])
+    if kind == "in_raw":
+        return mask_ops.in_raw(_eval_expr(tpl[1], cols, params), params[tpl[2]])
+    if kind == "range_raw":
+        _, expr_tpl, klo, khi, has_lo, has_hi, lo_inc, hi_inc = tpl
+        return mask_ops.range_raw(
+            _eval_expr(expr_tpl, cols, params), params[klo], params[khi],
+            lo_inc, hi_inc, has_lo, has_hi,
+        )
+    raise AssertionError(f"bad filter template node {kind}")
+
+
+def _gids_for_col(col, cols, params):
+    rlut = params[f"rlut_{col}"]
+    ids = jnp.clip(cols[col], 0, rlut.shape[1] - 1)
+    return jnp.take_along_axis(rlut, ids, axis=1)
+
+
+def build_pipeline(template):
+    """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict."""
+    shape, filter_tpl, group_cols, group_cards, aggs = template
+    num_groups = 1
+    for c in group_cards:
+        num_groups *= c
+
+    def pipeline(cols, n_docs, params):
+        any_col = next(iter(cols.values()))
+        sl = any_col.shape
+        valid = mask_ops.valid_mask(n_docs, sl[1], batched=True)
+        mask = _eval_filter(filter_tpl, cols, params, sl) & valid
+        seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)  # (S,) for stats
+        outs = {"doc_count": jnp.sum(seg_matched), "seg_matched": seg_matched}
+
+        if shape == "groupby":
+            per_col = [_gids_for_col(c, cols, params) for c in group_cols]
+            gid = agg_ops.group_ids_combine(per_col, group_cards, mask, num_groups)
+            outs["gcount"] = agg_ops.group_count(gid, num_groups)
+            for i, (name, argt, extra) in enumerate(aggs):
+                k = f"a{i}"
+                if name == "count":
+                    pass  # gcount reused
+                elif name in ("sum", "avg"):
+                    v = _eval_expr(argt, cols, params)
+                    outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups)
+                elif name == "min":
+                    v = _eval_expr(argt, cols, params)
+                    outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
+                elif name == "max":
+                    v = _eval_expr(argt, cols, params)
+                    outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
+                elif name == "minmaxrange":
+                    v = _eval_expr(argt, cols, params)
+                    outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
+                    outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
+                elif name == "distinctcount":
+                    card = extra
+                    sub = _gids_for_col(argt, cols, params)
+                    gid2 = jnp.where(mask, gid * card + sub, num_groups * card)
+                    pres = jnp.zeros(num_groups * card + 1, dtype=jnp.int8)
+                    pres = pres.at[gid2.reshape(-1)].max(1)
+                    outs[f"{k}_pres"] = pres[: num_groups * card].reshape(num_groups, card)
+                elif name == "distinctcounthll":
+                    log2m = extra
+                    m = 1 << log2m
+                    hlut = params[f"hlut_{argt}"]
+                    ids = jnp.clip(cols[argt], 0, hlut.shape[1] - 1)
+                    h = jnp.take_along_axis(hlut, ids, axis=1)
+                    idx, rho = hll_ops.hll_idx_rho(h, log2m)
+                    slot = jnp.where(mask, gid * m + idx, num_groups * m)
+                    regs = jnp.zeros(num_groups * m + 1, dtype=jnp.int32)
+                    regs = regs.at[slot.reshape(-1)].max(rho.reshape(-1))
+                    outs[f"{k}_regs"] = regs[: num_groups * m].reshape(num_groups, m)
+            return outs
+
+        # scalar aggregation shape
+        for i, (name, argt, extra) in enumerate(aggs):
+            k = f"a{i}"
+            if name == "count":
+                pass  # doc_count reused
+            elif name in ("sum", "avg"):
+                v = _eval_expr(argt, cols, params)
+                outs[f"{k}_sum"] = agg_ops.agg_sum(v, mask)
+            elif name == "min":
+                outs[f"{k}_min"] = agg_ops.agg_min(_eval_expr(argt, cols, params), mask)
+            elif name == "max":
+                outs[f"{k}_max"] = agg_ops.agg_max(_eval_expr(argt, cols, params), mask)
+            elif name == "minmaxrange":
+                v = _eval_expr(argt, cols, params)
+                outs[f"{k}_min"] = agg_ops.agg_min(v, mask)
+                outs[f"{k}_max"] = agg_ops.agg_max(v, mask)
+            elif name == "distinctcount":
+                card = extra
+                sub = _gids_for_col(argt, cols, params)
+                slot = jnp.where(mask, sub, card)
+                outs[f"{k}_pres"] = agg_ops.distinct_presence(slot, card)
+            elif name == "distinctcounthll":
+                log2m = extra
+                hlut = params[f"hlut_{argt}"]
+                ids = jnp.clip(cols[argt], 0, hlut.shape[1] - 1)
+                h = jnp.take_along_axis(hlut, ids, axis=1)
+                outs[f"{k}_regs"] = hll_ops.hll_registers_prehashed(h, mask, log2m)
+        return outs
+
+    return jax.jit(pipeline)
+
+
+# ---------------------------------------------------------------------------
+# executor
+# ---------------------------------------------------------------------------
+
+
+class DeviceExecutor:
+    MAX_CACHED_BATCHES = 4  # LRU cap: a batch holds full columns in HBM
+
+    def __init__(self):
+        self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
+        self._pipelines: dict = {}   # template -> jitted fn
+
+    # cheap static check (EXPLAIN backend display)
+    def supports(self, q: QueryContext) -> bool:
+        aggs = q.aggregations()
+        if q.distinct or not aggs:
+            return False
+        return all(a.name in DEVICE_AGGS for a in aggs)
+
+    def batch_for(self, segments) -> BatchContext:
+        key = tuple(s.dir for s in segments)
+        ctx = self._batches.pop(key, None)
+        if ctx is None:
+            ctx = BatchContext(segments)
+            while len(self._batches) >= self.MAX_CACHED_BATCHES:
+                # evict least-recently-used (insertion order == recency)
+                self._batches.pop(next(iter(self._batches)))
+        self._batches[key] = ctx
+        return ctx
+
+    def try_execute(self, q: QueryContext, segments):
+        """list[IntermediateResult] (length 1) or None → host fallback."""
+        try:
+            return [self._execute(q, segments)]
+        except DeviceUnsupported:
+            return None
+
+    # ---- template build --------------------------------------------------
+    def _agg_template(self, a: Expression, ctx: BatchContext, params, counter):
+        name = a.name
+        if name in ("distinctcountbitmap", "segmentpartitioneddistinctcount"):
+            name = "distinctcount"
+        if name not in DEVICE_AGGS:
+            raise DeviceUnsupported(f"aggregation {name} not on device")
+        if name == "count":
+            return ("count", None, None)
+        if name == "distinctcount":
+            arg = a.args[0]
+            if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
+                raise DeviceUnsupported("distinctcount needs a dict column")
+            card = len(ctx.global_dict(arg.name))
+            params[f"rlut_{arg.name}"] = ctx.remap_lut(arg.name)
+            return ("distinctcount", arg.name, card)
+        if name == "distinctcounthll":
+            arg = a.args[0]
+            if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
+                raise DeviceUnsupported("distinctcounthll device path needs a dict column")
+            spec = aggspec.make_spec(a)
+            params[f"hlut_{arg.name}"] = ctx.hash_lut(arg.name)
+            return ("distinctcounthll", arg.name, spec.log2m)
+        # numeric-arg aggregations
+        argt = build_expr(a.args[0], ctx, params, counter)
+        self._register_vluts(argt, ctx, params)
+        return (name, argt, None)
+
+    def _register_vluts(self, tpl, ctx: BatchContext, params):
+        if not isinstance(tpl, tuple):
+            return
+        if tpl[0] == "dictval":
+            params[f"vlut_{tpl[1]}"] = ctx.value_lut(tpl[1])
+            return
+        for t in tpl[1:]:
+            self._register_vluts(t, ctx, params)
+
+    def _execute(self, q: QueryContext, segments) -> IntermediateResult:
+        aggs = q.aggregations()
+        if q.distinct or not aggs:
+            raise DeviceUnsupported("selection/distinct on host path")
+        for a in aggs:
+            if a.name not in DEVICE_AGGS:
+                raise DeviceUnsupported(f"agg {a.name}")
+
+        ctx = self.batch_for(segments)
+        params: dict = {}
+        counter = [0]
+
+        filter_tpl = ("true",) if q.filter is None else build_filter(
+            q.filter, ctx, params, counter
+        )
+        self._register_filter_vluts(filter_tpl, ctx, params)
+
+        group_cols, group_cards = (), ()
+        if q.group_by:
+            gcols = []
+            gcards = []
+            for g in q.group_by:
+                if not g.is_identifier or ctx.encoding(g.name) != Encoding.DICT:
+                    raise DeviceUnsupported("group-by must be dict columns on device")
+                gcols.append(g.name)
+                gcards.append(len(ctx.global_dict(g.name)))
+                params[f"rlut_{g.name}"] = ctx.remap_lut(g.name)
+            group_cols, group_cards = tuple(gcols), tuple(gcards)
+            total = 1
+            for c in group_cards:
+                total *= c
+            if total > MAX_DENSE_GROUPS:
+                raise DeviceUnsupported(f"dense group space too large ({total})")
+
+        agg_tpls = tuple(self._agg_template(a, ctx, params, counter) for a in aggs)
+        for name, argt, extra in agg_tpls:
+            if group_cols and name in ("distinctcount", "distinctcounthll"):
+                total = extra if name == "distinctcount" else (1 << extra)
+                for c in group_cards:
+                    total *= c
+                if total > MAX_PRESENCE_CELLS:
+                    raise DeviceUnsupported(f"{name} per-group state too large ({total})")
+
+        shape = "groupby" if group_cols else "agg"
+        template = (shape, filter_tpl, group_cols, group_cards, agg_tpls)
+
+        pipeline = self._pipelines.get(template)
+        if pipeline is None:
+            pipeline = build_pipeline(template)
+            self._pipelines[template] = pipeline
+
+        needed = self._needed_columns(filter_tpl) | set(group_cols)
+        for name, argt, extra in agg_tpls:
+            if name in ("distinctcount", "distinctcounthll"):
+                needed.add(argt)
+            elif argt is not None:
+                needed |= self._needed_columns(argt)
+        cols = {c: ctx.column(c) for c in sorted(needed)}
+        if not cols:  # COUNT(*) with no filter: still need one column for shape
+            first = segments[0].column_names()[0]
+            cols = {first: ctx.column(first)}
+
+        outs = {k: np.asarray(v) for k, v in pipeline(cols, ctx.n_docs_dev, params).items()}
+        return self._to_intermediate(q, ctx, template, outs, aggs)
+
+    def _register_filter_vluts(self, tpl, ctx, params):
+        if not isinstance(tpl, tuple):
+            return
+        if tpl[0] in ("eq_raw", "in_raw", "range_raw"):
+            self._register_vluts(tpl[1], ctx, params)
+        else:
+            for t in tpl[1:]:
+                self._register_filter_vluts(t, ctx, params)
+
+    @staticmethod
+    def _needed_columns(tpl) -> set:
+        out = set()
+
+        def walk(t):
+            if not isinstance(t, tuple):
+                return
+            if t[0] in ("raw", "dictval"):
+                out.add(t[1])
+                return
+            if t[0] in ("eq_dict", "in_dict", "range_dict", "lut_dict"):
+                out.add(t[1])
+            for x in t[1:]:
+                walk(x)
+
+        walk(tpl)
+        return out
+
+    # ---- device outputs → canonical IntermediateResult -------------------
+    def _to_intermediate(self, q, ctx: BatchContext, template, outs, aggs):
+        shape, _, group_cols, group_cards, agg_tpls = template
+        doc_count = int(outs["doc_count"])
+        # mirror the host executor's stats accounting so responses are
+        # backend-independent (host.py execute_segment)
+        entries_in_filter = 0
+        if q.filter is not None:
+            entries_in_filter = int(ctx.n_docs.sum()) * len(q.filter.columns())
+        entries_post = sum(
+            doc_count * len(aggspec.make_spec(a).args) for a in q.aggregations()
+        )
+        stats = ExecutionStats(
+            num_docs_scanned=doc_count,
+            num_entries_scanned_in_filter=entries_in_filter,
+            num_entries_scanned_post_filter=entries_post,
+            num_segments_processed=ctx.S,
+            num_segments_queried=ctx.S,
+            num_segments_matched=int((outs["seg_matched"] > 0).sum()),
+            total_docs=int(ctx.n_docs.sum()),
+        )
+
+        if shape == "agg":
+            partials = [
+                self._scalar_partial(i, t, outs, ctx) for i, t in enumerate(agg_tpls)
+            ]
+            return IntermediateResult("aggregation", agg_partials=partials, stats=stats)
+
+        gcount = outs["gcount"]
+        present = np.nonzero(gcount > 0)[0]
+        # decode dense gid → per-column global ids → values
+        keys = []
+        rem = present.copy()
+        for card in reversed(group_cards[1:]):
+            keys.append(rem % card)
+            rem = rem // card
+        keys.append(rem)
+        keys.reverse()
+        key_values = tuple(
+            ctx.global_dict(col)[k] for col, k in zip(group_cols, keys)
+        )
+        partials = [
+            self._group_partial(i, t, outs, ctx, present) for i, t in enumerate(agg_tpls)
+        ]
+        return IntermediateResult(
+            "group_by", group_keys=key_values, agg_partials=partials, stats=stats
+        )
+
+    def _scalar_partial(self, i, tpl, outs, ctx):
+        name, argt, extra = tpl
+        k = f"a{i}"
+        if name == "count":
+            return {"count": np.array([outs["doc_count"]], dtype=np.int64)}
+        if name == "sum":
+            return {"sum": np.asarray([outs[f"{k}_sum"]], dtype=np.float64)}
+        if name == "avg":
+            return {
+                "sum": np.asarray([outs[f"{k}_sum"]], dtype=np.float64),
+                "count": np.array([outs["doc_count"]], dtype=np.int64),
+            }
+        if name == "min":
+            return {"min": np.asarray([outs[f"{k}_min"]], dtype=np.float64)}
+        if name == "max":
+            return {"max": np.asarray([outs[f"{k}_max"]], dtype=np.float64)}
+        if name == "minmaxrange":
+            return {
+                "min": np.asarray([outs[f"{k}_min"]], dtype=np.float64),
+                "max": np.asarray([outs[f"{k}_max"]], dtype=np.float64),
+            }
+        if name == "distinctcount":
+            pres = outs[f"{k}_pres"]
+            vals = ctx.global_dict(argt)[np.nonzero(pres > 0)[0]]
+            s = np.empty(1, dtype=object)
+            s[0] = set(vals.tolist())
+            return {"sets": s}
+        if name == "distinctcounthll":
+            return {"regs": outs[f"{k}_regs"].reshape(1, -1)}
+        raise AssertionError(name)
+
+    def _group_partial(self, i, tpl, outs, ctx, present):
+        name, argt, extra = tpl
+        k = f"a{i}"
+        if name == "count":
+            return {"count": outs["gcount"][present].astype(np.int64)}
+        if name == "sum":
+            return {"sum": outs[f"{k}_sum"][present].astype(np.float64)}
+        if name == "avg":
+            return {
+                "sum": outs[f"{k}_sum"][present].astype(np.float64),
+                "count": outs["gcount"][present].astype(np.int64),
+            }
+        if name == "min":
+            return {"min": outs[f"{k}_min"][present].astype(np.float64)}
+        if name == "max":
+            return {"max": outs[f"{k}_max"][present].astype(np.float64)}
+        if name == "minmaxrange":
+            return {
+                "min": outs[f"{k}_min"][present].astype(np.float64),
+                "max": outs[f"{k}_max"][present].astype(np.float64),
+            }
+        if name == "distinctcount":
+            pres = outs[f"{k}_pres"][present]
+            gvals = ctx.global_dict(argt)
+            sets = np.empty(len(present), dtype=object)
+            for j in range(len(present)):
+                sets[j] = set(gvals[np.nonzero(pres[j] > 0)[0]].tolist())
+            return {"sets": sets}
+        if name == "distinctcounthll":
+            return {"regs": outs[f"{k}_regs"][present]}
+        raise AssertionError(name)

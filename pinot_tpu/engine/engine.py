@@ -104,11 +104,15 @@ class TableDataManager:
 class QueryEngine:
     """SQL in, response out, over in-process tables."""
 
-    def __init__(self, device_executor=None, num_groups_limit: int = 100_000):
+    def __init__(self, device_executor="auto", num_groups_limit: int = 100_000):
         self.tables: dict[str, TableDataManager] = {}
         self.host = HostExecutor(num_groups_limit=num_groups_limit)
         self.pruner = SegmentPruner()
-        self.device = device_executor  # engine/device.py DeviceExecutor
+        if device_executor == "auto":
+            from pinot_tpu.engine.device import DeviceExecutor
+
+            device_executor = DeviceExecutor()
+        self.device = device_executor  # None → host-only
 
     # ---- table management -----------------------------------------------
     def table(self, name: str) -> TableDataManager:

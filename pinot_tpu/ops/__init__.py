@@ -1,0 +1,5 @@
+"""Device kernel library (masks, aggregation scatters, top-k, HLL).
+
+x64 is enabled at the package root (pinot_tpu/__init__.py) — accumulators
+widen to int64/float64 while column data stays narrow in HBM.
+"""

@@ -30,7 +30,9 @@ def agg_count(mask):
 
 
 def agg_sum(values, mask):
-    dt = jnp.int64 if jnp.issubdtype(values.dtype, jnp.integer) else None
+    # int64 / float64 accumulation regardless of the narrow column dtype
+    # (reference sums into long/double)
+    dt = jnp.int64 if jnp.issubdtype(values.dtype, jnp.integer) else jnp.float64
     return jnp.sum(jnp.where(mask, values, 0), dtype=dt)
 
 
@@ -64,7 +66,7 @@ def group_count(gids, num_groups: int):
 def group_sum(gids, values, num_groups: int):
     flat = gids.reshape(-1)
     v = values.reshape(-1)
-    dt = jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer) else v.dtype
+    dt = jnp.int64 if jnp.issubdtype(v.dtype, jnp.integer) else jnp.float64
     out = jnp.zeros(num_groups + 1, dtype=dt).at[flat].add(v.astype(dt))
     return out[:num_groups]
 

@@ -32,20 +32,29 @@ def hash32(x):
     return h
 
 
-def hll_registers(keys, mask, log2m: int = DEFAULT_LOG2M):
-    """Scatter-max HLL register build over an (S, L) or (L,) key array.
-
-    Masked-out docs land in an overflow register that is sliced away.
-    Returns int32 (m,) registers.
-    """
-    m = 1 << log2m
-    h = hash32(keys)
+def hll_idx_rho(h, log2m: int):
+    """(register index, rank) from uint32 hashes — the one place the
+    register math lives; host parity depends on registers_np matching."""
     idx = (h >> (32 - log2m)).astype(jnp.int32)
     w = (h << log2m) | jnp.uint32(1 << (log2m - 1))  # sentinel caps rho
     rho = jax.lax.clz(w.astype(jnp.int32)).astype(jnp.int32) + 1
+    return idx, rho
+
+
+def hll_registers_prehashed(h, mask, log2m: int = DEFAULT_LOG2M):
+    """Register build from pre-computed uint32 hashes (e.g. a per-dictid hash
+    LUT gathered on device). Masked-out docs land in an overflow register that
+    is sliced away. Returns int32 (m,) registers."""
+    m = 1 << log2m
+    idx, rho = hll_idx_rho(h, log2m)
     idx = jnp.where(mask, idx, m)
     regs = jnp.zeros(m + 1, dtype=jnp.int32).at[idx.reshape(-1)].max(rho.reshape(-1))
     return regs[:m]
+
+
+def hll_registers(keys, mask, log2m: int = DEFAULT_LOG2M):
+    """Scatter-max HLL register build over an (S, L) or (L,) int32 key array."""
+    return hll_registers_prehashed(hash32(keys), mask, log2m)
 
 
 def hash32_np(values: np.ndarray) -> np.ndarray:

@@ -116,7 +116,9 @@ def _rows_equal(a, b):
                 if va is not vb and not (va is None and vb is None):
                     return False
             elif isinstance(va, float) and isinstance(vb, float):
-                if not math.isclose(va, vb, rel_tol=1e-9, abs_tol=1e-6):
+                # DOUBLE columns live as f32 on device (accumulated in f64),
+                # so device-path results carry ~1e-7 relative error
+                if not math.isclose(va, vb, rel_tol=1e-6, abs_tol=1e-6):
                     return False
             elif va != vb:
                 return False
