@@ -1,0 +1,142 @@
+"""Benchmark: the BASELINE.json workloads on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors the reference's JMH macro-bench
+(pinot-perf/.../BenchmarkQueries.java:159 — 1.5M-row synthetic segments) and
+BASELINE.json configs: a filtered range-scan SUM, a 2-dim GROUP BY with
+COUNT/SUM/AVG + DISTINCTCOUNTHLL (NYC-taxi shape), and an IN-filter
+aggregation. The headline value is rows scanned per second per chip on the
+group-by config; vs_baseline compares against the in-process numpy host
+executor on the same machine (stand-in for the CPU reference path until a
+real Pinot 32-vCPU run is recorded — BASELINE.md: "published": {}).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+N_SEGMENTS = 8
+ROWS_PER_SEGMENT = 1_500_000
+CACHE_DIR = os.path.join(tempfile.gettempdir(), "pinot_tpu_bench_v1")
+
+
+def build_dataset():
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.storage.creator import build_segment
+
+    schema = Schema.build(
+        name="bench",
+        dimensions=[
+            ("zone", DataType.STRING),      # 260 zones (taxi-like)
+            ("hour", DataType.INT),         # 24
+            ("vendor", DataType.STRING),    # 8
+        ],
+        metrics=[("fare", DataType.INT), ("distance", DataType.DOUBLE)],
+    )
+    cfg = TableConfig(table_name="bench")
+    rng = np.random.default_rng(42)
+    zones = np.array([f"zone_{i:03d}" for i in range(260)])
+    vendors = np.array([f"v{i}" for i in range(8)])
+    for i in range(N_SEGMENTS):
+        out = os.path.join(CACHE_DIR, f"s{i}")
+        if os.path.exists(os.path.join(out, "metadata.json")):
+            continue
+        n = ROWS_PER_SEGMENT
+        cols = {
+            "zone": zones[rng.integers(0, 260, n)],
+            "hour": rng.integers(0, 24, n).astype(np.int32),
+            "vendor": vendors[rng.integers(0, 8, n)],
+            "fare": rng.integers(100, 10_000, n).astype(np.int32),
+            "distance": np.round(rng.uniform(0.1, 50.0, n), 2),
+        }
+        build_segment(schema, cols, out, cfg, f"s{i}")
+    return schema
+
+
+QUERIES = {
+    "range_sum": "SELECT SUM(fare) FROM bench WHERE fare BETWEEN 1000 AND 5000",
+    "groupby": (
+        "SELECT zone, hour, COUNT(*), SUM(fare), AVG(distance) FROM bench "
+        "GROUP BY zone, hour ORDER BY SUM(fare) DESC, zone, hour LIMIT 10"
+    ),
+    "in_filter": (
+        "SELECT COUNT(*), SUM(fare) FROM bench WHERE "
+        "vendor IN ('v1','v3','v5') AND hour BETWEEN 7 AND 10"
+    ),
+    "hll": (
+        "SELECT vendor, COUNT(*), DISTINCTCOUNTHLL(zone) FROM bench "
+        "GROUP BY vendor ORDER BY vendor"
+    ),
+}
+
+
+def run(engine, sql, iters):
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        resp = engine.execute(sql)
+        lat.append(time.perf_counter() - t0)
+        if resp.get("exceptions"):
+            raise RuntimeError(resp["exceptions"])
+    return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
+
+
+def main():
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    build_dataset()
+
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    segments = [
+        ImmutableSegment(os.path.join(CACHE_DIR, f"s{i}")) for i in range(N_SEGMENTS)
+    ]
+    total_rows = sum(s.n_docs for s in segments)
+
+    dev = QueryEngine()
+    for s in segments:
+        dev.add_segment("bench", s)
+
+    # warm (compile + HBM upload), then measure
+    detail = {}
+    for name, sql in QUERIES.items():
+        run(dev, sql, 2)
+        p50, p99 = run(dev, sql, 7)
+        detail[name] = {"p50_ms": round(p50 * 1e3, 2), "p99_ms": round(p99 * 1e3, 2)}
+
+    headline_p50 = detail["groupby"]["p50_ms"] / 1e3
+    rows_per_sec = total_rows / headline_p50
+
+    # CPU stand-in baseline: same query, numpy host path, one segment scaled up
+    host = QueryEngine(device_executor=None)
+    for s in segments:
+        host.add_segment("bench", s)
+    host_p50, _ = run(host, QUERIES["groupby"], 3)
+    vs_baseline = host_p50 / headline_p50
+
+    print(
+        json.dumps(
+            {
+                "metric": "group-by scan throughput (12M rows, 2-dim groupby+agg)",
+                "value": round(rows_per_sec / 1e6, 2),
+                "unit": "Mrows/s/chip",
+                "vs_baseline": round(vs_baseline, 2),
+                "detail": detail,
+                "total_rows": total_rows,
+                "baseline_note": "vs in-process numpy host path (no published reference numbers; BASELINE.md)",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

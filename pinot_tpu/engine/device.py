@@ -25,7 +25,13 @@ import jax
 import jax.numpy as jnp
 
 from pinot_tpu.engine import aggspec
-from pinot_tpu.engine.params import BatchContext, DeviceUnsupported, build_expr, build_filter
+from pinot_tpu.engine.params import (
+    BatchContext,
+    DeviceUnsupported,
+    build_expr,
+    build_filter,
+    expr_bounds,
+)
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
 from pinot_tpu.ops import agg as agg_ops
 from pinot_tpu.ops import hll as hll_ops
@@ -56,9 +62,9 @@ def _eval_expr(tpl, cols, params):
     if kind == "raw":
         return cols[tpl[1]]
     if kind == "dictval":
-        lut = params[f"vlut_{tpl[1]}"]
-        ids = jnp.clip(cols[tpl[1]], 0, lut.shape[1] - 1)
-        return jnp.take_along_axis(lut, ids, axis=1)
+        lut = params[f"vlut_{tpl[1]}"]  # (C,) global-id value table
+        ids = jnp.clip(cols[tpl[1]], 0, lut.shape[0] - 1)
+        return lut[ids]
     if kind == "cast":
         return get_function("cast").jnp_fn(_eval_expr(tpl[1], cols, params), tpl[2])
     fn = get_function(kind)
@@ -105,10 +111,13 @@ def _eval_filter(tpl, cols, params, shape):
     raise AssertionError(f"bad filter template node {kind}")
 
 
-def _gids_for_col(col, cols, params):
-    rlut = params[f"rlut_{col}"]
-    ids = jnp.clip(cols[col], 0, rlut.shape[1] - 1)
-    return jnp.take_along_axis(rlut, ids, axis=1)
+def _rows_per_block(values, int_rpb):
+    """Two-stage sum block size at trace time: ints use the planner's
+    metadata-derived bound (None → single-stage 64-bit scatter, exact but
+    slow); floats always block at 2048 (f32 block partials, f64 reduce)."""
+    if jnp.issubdtype(values.dtype, jnp.integer):
+        return int_rpb if int_rpb else 1 << 62
+    return 2048
 
 
 def build_pipeline(template):
@@ -127,7 +136,8 @@ def build_pipeline(template):
         outs = {"doc_count": jnp.sum(seg_matched), "seg_matched": seg_matched}
 
         if shape == "groupby":
-            per_col = [_gids_for_col(c, cols, params) for c in group_cols]
+            # columns are already global ids: the group key IS the column
+            per_col = [cols[c] for c in group_cols]
             gid = agg_ops.group_ids_combine(per_col, group_cards, mask, num_groups)
             outs["gcount"] = agg_ops.group_count(gid, num_groups)
             for i, (name, argt, extra) in enumerate(aggs):
@@ -136,7 +146,8 @@ def build_pipeline(template):
                     pass  # gcount reused
                 elif name in ("sum", "avg"):
                     v = _eval_expr(argt, cols, params)
-                    outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups)
+                    rpb = _rows_per_block(v, extra)
+                    outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups, rpb)
                 elif name == "min":
                     v = _eval_expr(argt, cols, params)
                     outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
@@ -149,7 +160,7 @@ def build_pipeline(template):
                     outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
                 elif name == "distinctcount":
                     card = extra
-                    sub = _gids_for_col(argt, cols, params)
+                    sub = jnp.clip(cols[argt], 0, card - 1)
                     gid2 = jnp.where(mask, gid * card + sub, num_groups * card)
                     pres = jnp.zeros(num_groups * card + 1, dtype=jnp.int8)
                     pres = pres.at[gid2.reshape(-1)].max(1)
@@ -157,9 +168,9 @@ def build_pipeline(template):
                 elif name == "distinctcounthll":
                     log2m = extra
                     m = 1 << log2m
-                    hlut = params[f"hlut_{argt}"]
-                    ids = jnp.clip(cols[argt], 0, hlut.shape[1] - 1)
-                    h = jnp.take_along_axis(hlut, ids, axis=1)
+                    hlut = params[f"hlut_{argt}"]  # (C,) per-global-id hashes
+                    ids = jnp.clip(cols[argt], 0, hlut.shape[0] - 1)
+                    h = hlut[ids]
                     idx, rho = hll_ops.hll_idx_rho(h, log2m)
                     slot = jnp.where(mask, gid * m + idx, num_groups * m)
                     regs = jnp.zeros(num_groups * m + 1, dtype=jnp.int32)
@@ -185,18 +196,18 @@ def build_pipeline(template):
                 outs[f"{k}_max"] = agg_ops.agg_max(v, mask)
             elif name == "distinctcount":
                 card = extra
-                sub = _gids_for_col(argt, cols, params)
+                sub = jnp.clip(cols[argt], 0, card - 1)
                 slot = jnp.where(mask, sub, card)
                 outs[f"{k}_pres"] = agg_ops.distinct_presence(slot, card)
             elif name == "distinctcounthll":
                 log2m = extra
                 hlut = params[f"hlut_{argt}"]
-                ids = jnp.clip(cols[argt], 0, hlut.shape[1] - 1)
-                h = jnp.take_along_axis(hlut, ids, axis=1)
+                ids = jnp.clip(cols[argt], 0, hlut.shape[0] - 1)
+                h = hlut[ids]
                 outs[f"{k}_regs"] = hll_ops.hll_registers_prehashed(h, mask, log2m)
         return outs
 
-    return jax.jit(pipeline)
+    return pipeline  # caller jits (single-device) or shard_maps (mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -207,9 +218,13 @@ def build_pipeline(template):
 class DeviceExecutor:
     MAX_CACHED_BATCHES = 4  # LRU cap: a batch holds full columns in HBM
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        """``mesh``: optional jax Mesh — shard the segment axis over it with
+        psum-combined accumulators (parallel/mesh.py) instead of a
+        single-device batched launch."""
+        self.mesh = mesh
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
-        self._pipelines: dict = {}   # template -> jitted fn
+        self._pipelines: dict = {}   # template -> jitted/sharded fn
 
     # cheap static check (EXPLAIN backend display)
     def supports(self, q: QueryContext) -> bool:
@@ -249,9 +264,7 @@ class DeviceExecutor:
             arg = a.args[0]
             if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
                 raise DeviceUnsupported("distinctcount needs a dict column")
-            card = len(ctx.global_dict(arg.name))
-            params[f"rlut_{arg.name}"] = ctx.remap_lut(arg.name)
-            return ("distinctcount", arg.name, card)
+            return ("distinctcount", arg.name, ctx.cardinality(arg.name))
         if name == "distinctcounthll":
             arg = a.args[0]
             if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
@@ -262,7 +275,13 @@ class DeviceExecutor:
         # numeric-arg aggregations
         argt = build_expr(a.args[0], ctx, params, counter)
         self._register_vluts(argt, ctx, params)
-        return (name, argt, None)
+        rpb = None
+        if name in ("sum", "avg"):
+            # metadata interval arithmetic sizes the two-stage int32 blocks
+            bounds = expr_bounds(a.args[0], ctx)
+            if bounds is not None:
+                rpb = agg_ops.rows_per_block_for(max(abs(bounds[0]), abs(bounds[1])))
+        return (name, argt, rpb)
 
     def _register_vluts(self, tpl, ctx: BatchContext, params):
         if not isinstance(tpl, tuple):
@@ -298,8 +317,7 @@ class DeviceExecutor:
                 if not g.is_identifier or ctx.encoding(g.name) != Encoding.DICT:
                     raise DeviceUnsupported("group-by must be dict columns on device")
                 gcols.append(g.name)
-                gcards.append(len(ctx.global_dict(g.name)))
-                params[f"rlut_{g.name}"] = ctx.remap_lut(g.name)
+                gcards.append(ctx.cardinality(g.name))
             group_cols, group_cards = tuple(gcols), tuple(gcards)
             total = 1
             for c in group_cards:
@@ -321,7 +339,13 @@ class DeviceExecutor:
 
         pipeline = self._pipelines.get(template)
         if pipeline is None:
-            pipeline = build_pipeline(template)
+            raw = build_pipeline(template)
+            if self.mesh is not None:
+                from pinot_tpu.parallel.mesh import shard_pipeline
+
+                pipeline = shard_pipeline(raw, self.mesh)
+            else:
+                pipeline = jax.jit(raw)
             self._pipelines[template] = pipeline
 
         needed = self._needed_columns(filter_tpl) | set(group_cols)
@@ -335,7 +359,18 @@ class DeviceExecutor:
             first = segments[0].column_names()[0]
             cols = {first: ctx.column(first)}
 
-        outs = {k: np.asarray(v) for k, v in pipeline(cols, ctx.n_docs_dev, params).items()}
+        n_docs = ctx.n_docs_dev
+        if self.mesh is not None:
+            from pinot_tpu.parallel.mesh import pad_to_multiple
+
+            cols, n_docs, params, _ = pad_to_multiple(
+                cols, n_docs, params, self.mesh.devices.size
+            )
+
+        # single batched host transfer: per-leaf np.asarray costs one tunnel
+        # round-trip each, device_get overlaps them (measured 4-5x)
+        outs = jax.device_get(pipeline(cols, n_docs, params))
+        outs = {k: np.asarray(v) for k, v in outs.items()}
         return self._to_intermediate(q, ctx, template, outs, aggs)
 
     def _register_filter_vluts(self, tpl, ctx, params):
@@ -404,7 +439,7 @@ class DeviceExecutor:
         keys.append(rem)
         keys.reverse()
         key_values = tuple(
-            ctx.global_dict(col)[k] for col, k in zip(group_cols, keys)
+            ctx.global_dict(col).take(k) for col, k in zip(group_cols, keys)
         )
         partials = [
             self._group_partial(i, t, outs, ctx, present) for i, t in enumerate(agg_tpls)
@@ -436,9 +471,9 @@ class DeviceExecutor:
             }
         if name == "distinctcount":
             pres = outs[f"{k}_pres"]
-            vals = ctx.global_dict(argt)[np.nonzero(pres > 0)[0]]
+            vals = ctx.global_dict(argt).take(np.nonzero(pres > 0)[0])
             s = np.empty(1, dtype=object)
-            s[0] = set(vals.tolist())
+            s[0] = set(np.asarray(vals).tolist())
             return {"sets": s}
         if name == "distinctcounthll":
             return {"regs": outs[f"{k}_regs"].reshape(1, -1)}
@@ -467,7 +502,7 @@ class DeviceExecutor:
             }
         if name == "distinctcount":
             pres = outs[f"{k}_pres"][present]
-            gvals = ctx.global_dict(argt)
+            gvals = np.asarray(ctx.global_dict(argt).values)
             sets = np.empty(len(present), dtype=object)
             for j in range(len(present)):
                 sets[j] = set(gvals[np.nonzero(pres[j] > 0)[0]].tolist())

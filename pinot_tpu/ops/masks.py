@@ -6,9 +6,13 @@ SVScanDocIdIterator.java:56-94) and RoaringBitmap algebra
 boolean mask over the padded (S, L) segment batch — fixed shape, fuse-friendly
 — and AND/OR/NOT are elementwise ops XLA fuses into the surrounding kernel.
 
-Predicate literals arrive as *parameter arrays* resolved per segment on the
-host (dict-id space, see engine/params.py), so the jitted pipeline is reused
-across literal values — only shapes retrace.
+Dict-encoded columns arrive in **global dictionary id space** (the batch
+loader remapped them on upload, engine/params.py), so predicate literals
+resolve to batch-wide scalars/vectors on the host — one binary search over
+the global dictionary replaces the reference's per-segment
+PredicateEvaluator, and the kernel is a bare vector comparison with no
+per-segment indirection. Padding docs carry id -1 and literal params use -2
+for "absent", so padding never matches; callers still AND with valid_mask.
 
 All functions here are shape-polymorphic jnp ops, traced inside the engine's
 jitted pipeline; nothing allocates per-doc.
@@ -30,47 +34,32 @@ def valid_mask(n_docs, padded_len: int, batched: bool):
     return iota < n_docs
 
 
-# ---- dict-id space predicates (DICT-encoded columns) ----------------------
-# `ids` is the forward index: int32 (S, L); padding is -1.
-# Per-segment params use -2 (or empty ranges) as "no match in this segment".
+# ---- global-dict-id space predicates (DICT-encoded columns) ---------------
 
 
-def eq_dict(ids, target_ids):
-    """EQ: ``target_ids`` int32 (S,) — the literal's dict id per segment."""
-    return ids == target_ids[:, None]
+def eq_dict(ids, target_id):
+    """EQ: ``target_id`` int32 scalar global id (-2 if value absent)."""
+    return ids == target_id
 
 
-def in_dict(ids, id_matrix):
-    """IN: ``id_matrix`` int32 (S, K), padded with -2.
-
-    K is small (the literal count); the (S, L, K) broadcast stays in
-    registers under XLA fusion.
-    """
-    return jnp.any(ids[:, :, None] == id_matrix[:, None, :], axis=-1)
+def in_dict(ids, id_vector):
+    """IN: ``id_vector`` int32 (K,) global ids, padded with -2."""
+    return jnp.any(ids[..., None] == id_vector, axis=-1)
 
 
 def range_dict(ids, lo, hi):
-    """RANGE on a sorted dictionary: per-segment id interval [lo, hi).
-
-    ``lo``/``hi`` int32 (S,). The host resolved value bounds to id bounds via
-    binary search (Dictionary.range_ids) — the dictionary-based range
-    evaluator trick (RangePredicateEvaluatorFactory).
-    """
-    return (ids >= lo[:, None]) & (ids < hi[:, None])
+    """RANGE: global id interval [lo, hi) — a value range on the sorted
+    global dictionary is contiguous in id space (the dictionary-based range
+    evaluator trick, RangePredicateEvaluatorFactory)."""
+    return (ids >= lo) & (ids < hi)
 
 
 def lut_dict(ids, lut):
-    """Arbitrary predicate on a dict column via per-dictid boolean LUT.
-
-    ``lut``: bool (S, C_max) — entry [s, d] says whether dict id d of segment
-    s matches (host evaluated the predicate once per dictionary entry, e.g.
-    regex over a few thousand strings instead of millions of rows — the same
-    leverage the reference gets from dictionary-based predicate evaluators).
-    Padding ids (-1) index entry 0 after clamping; callers AND with
-    valid_mask at the top of the tree, so the value is irrelevant.
-    """
-    clamped = jnp.clip(ids, 0, lut.shape[1] - 1)
-    return jnp.take_along_axis(lut, clamped, axis=1)
+    """Arbitrary predicate via a (C,) boolean LUT over global ids: the host
+    evaluated the predicate once per dictionary entry (e.g. regex over a few
+    thousand strings instead of millions of rows). Padding ids clamp to 0;
+    callers AND with valid_mask, so the value is irrelevant."""
+    return lut[jnp.clip(ids, 0, lut.shape[0] - 1)]
 
 
 # ---- raw-value space predicates (RAW-encoded columns / computed exprs) ----

@@ -1,0 +1,116 @@
+"""Mesh-parallel execution: shard the segment axis over TPU chips.
+
+This is the distributed-combine layer — the TPU-native replacement for both
+of the reference's parallel layers (SURVEY.md §2.9):
+
+- intra-server combine (BaseCombineOperator's thread fan-out + BlockingQueue
+  merge, operator/combine/BaseCombineOperator.java:79-145) → the batched
+  (S, L) kernel already combines segments in one launch; here the S axis is
+  *sharded* over a ``jax.sharding.Mesh`` and partial accumulators merge with
+  XLA collectives riding ICI:
+    sums/counts → psum, min → pmin, max/presence/HLL-registers → pmax.
+- broker scatter-gather across servers stays host-side (broker/), exactly as
+  the reference keeps Netty between nodes.
+
+Because group-by accumulators live in *global dictionary id space*
+(engine/params.py), the cross-chip psum is a dense elementwise reduce — no
+key exchange, no IndexedTable merge, no all-to-all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEG_AXIS = "segments"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the segment axis (data-parallel OLAP scan)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (SEG_AXIS,))
+
+
+def _combine_out(key: str, v):
+    """Collective per output name — the psum-combine replacing the reference's
+    blocking-queue merge."""
+    if key == "seg_matched":
+        return v  # stays per-shard; out_spec P(SEG_AXIS) reassembles (S,)
+    if key.endswith("_min"):
+        return jax.lax.pmin(v, SEG_AXIS)
+    if key.endswith(("_max", "_pres", "_regs")):
+        return jax.lax.pmax(v, SEG_AXIS)
+    # doc_count, gcount, *_sum, counts
+    return jax.lax.psum(v, SEG_AXIS)
+
+
+def shard_pipeline(pipeline_fn, mesh: Mesh):
+    """Wrap a device pipeline (engine/device.py build_pipeline inner fn) in
+    shard_map over the segment axis.
+
+    Input convention: any param/column whose leading dim == n_segments is
+    sharded; everything else (literals, (K,) id lists) is replicated.
+    Output convention: 'seg_matched' is gathered back to (S,); all other
+    outputs are combined to replicated accumulators via psum/pmin/pmax.
+    """
+
+    def sharded(cols, n_docs, params):
+        outs = pipeline_fn(cols, n_docs, params)
+        return {k: _combine_out(k, v) for k, v in outs.items()}
+
+    # global-id design: every param (literals, (C,) LUTs) is batch-wide and
+    # replicated; only columns and n_docs carry the segment axis. The "ps"
+    # prefix remains reserved for any future per-segment param.
+    def param_spec(key: str, x) -> P:
+        if key.startswith("ps"):
+            return P(SEG_AXIS, *([None] * (x.ndim - 1)))
+        return P()
+
+    def wrapper(cols, n_docs, params):
+        in_specs = (
+            {k: P(SEG_AXIS, None) for k in cols},
+            P(SEG_AXIS),
+            {k: param_spec(k, v) for k, v in params.items()},
+        )
+        outs_shape = jax.eval_shape(pipeline_fn, cols, n_docs, params)
+        out_specs = {
+            k: (P(SEG_AXIS) if k == "seg_matched" else P()) for k in outs_shape
+        }
+        fn = jax.shard_map(
+            sharded, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+        return fn(cols, n_docs, params)
+
+    return jax.jit(wrapper)
+
+
+def pad_to_multiple(cols: dict, n_docs, params: dict, multiple: int):
+    """Pad the segment axis so it divides the mesh: extra segments carry
+    n_docs = 0, so every kernel masks them out."""
+    S = int(n_docs.shape[0])
+    rem = S % multiple
+    if rem == 0:
+        return cols, n_docs, params, S
+    pad = multiple - rem
+
+    def pad_arr(x):
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] == S:
+            widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+            return jnp.pad(x, widths)
+        return x
+
+    cols = {k: pad_arr(v) for k, v in cols.items()}
+    params = {
+        k: (pad_arr(v) if k.startswith("ps") else v) for k, v in params.items()
+    }
+    n_docs = jnp.pad(n_docs, (0, pad))
+    return cols, n_docs, params, S + pad

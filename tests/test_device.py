@@ -119,3 +119,24 @@ def test_host_fallback_for_unsupported(engines):
     rd = dev.execute("SELECT PERCENTILE(ivalue, 90) FROM t")
     rh = host.execute("SELECT PERCENTILE(ivalue, 90) FROM t")
     assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+
+def test_large_value_sum_exact(tmp_path):
+    """Regression: SUM over large int values must use the exact single-stage
+    path (two-stage int32 blocks would overflow)."""
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+
+    big = np.full(600, 2**30, dtype=np.int64)
+    keys = np.array(["a", "b"])[np.arange(600) % 2]
+    schema = Schema.build(
+        name="big", dimensions=[("k", DataType.STRING)], metrics=[("v", DataType.LONG)]
+    )
+    build_segment(schema, {"k": keys, "v": big}, str(tmp_path / "s0"),
+                  TableConfig(table_name="big"), "s0")
+    eng = QueryEngine()
+    eng.add_segment("big", ImmutableSegment(str(tmp_path / "s0")))
+    r = eng.execute("SELECT k, SUM(v) FROM big GROUP BY k ORDER BY k")
+    assert len(eng.device._pipelines) > 0  # device path taken
+    assert r["resultTable"]["rows"] == [["a", 300 * 2**30], ["b", 300 * 2**30]], r
