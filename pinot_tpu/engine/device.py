@@ -50,6 +50,15 @@ MAX_DENSE_GROUPS = 1 << 22        # ARRAY_BASED regime guard (~4M groups)
 MAX_PRESENCE_CELLS = 1 << 24      # distinctcount (G, C) presence guard
 
 
+def segment_device_eligible(seg) -> bool:
+    """Sealed, non-upsert-masked segments only: consuming (mutable) segments
+    and segments with a validDocIds mask execute on the host scan path (the
+    one place this rule lives — the engine partitions with it and the
+    executor guards with it)."""
+    return not getattr(seg, "is_mutable", False) and \
+        getattr(seg, "valid_docs_mask", None) is None
+
+
 # ---------------------------------------------------------------------------
 # template evaluation (traced inside jit)
 # ---------------------------------------------------------------------------
@@ -299,6 +308,9 @@ class DeviceExecutor:
         for a in aggs:
             if a.name not in DEVICE_AGGS:
                 raise DeviceUnsupported(f"agg {a.name}")
+        for s in segments:
+            if not segment_device_eligible(s):
+                raise DeviceUnsupported("mutable/upsert segment needs host scan path")
 
         ctx = self.batch_for(segments)
         params: dict = {}

@@ -170,14 +170,22 @@ class QueryEngine:
         results = []
         if kept:
             executed = kept
+            # consuming (mutable) and upsert-masked segments run on the host
+            # scan path; sealed immutables go to the device in one batch
+            from pinot_tpu.engine.device import segment_device_eligible
+
+            device_ok, host_segs = [], []
+            for s in kept:
+                (device_ok if segment_device_eligible(s) else host_segs).append(s)
             device_result = None
-            if self.device is not None:
-                device_result = self.device.try_execute(q, kept)
+            if self.device is not None and device_ok:
+                device_result = self.device.try_execute(q, device_ok)
             if device_result is not None:
                 results.extend(device_result)
             else:
-                for s in kept:
-                    results.append(self.host.execute_segment(q, s))
+                host_segs = kept
+            for s in host_segs:
+                results.append(self.host.execute_segment(q, s))
         else:
             # all pruned: empty result over schema of first segment
             executed = [segments[0]]

@@ -52,10 +52,14 @@ class SegmentEvaluator:
 
     def __init__(self, segment: ImmutableSegment):
         self.seg = segment
+        # snapshot the doc count ONCE: mutable (consuming) segments grow
+        # concurrently under a single-writer/multi-reader contract
+        # (MutableSegmentImpl volatile counter analog)
+        self.n = segment.n_docs
         self._cache: dict = {}
 
     def n_docs(self) -> int:
-        return self.seg.n_docs
+        return self.n
 
     # ---- expression evaluation ------------------------------------------
     def eval(self, expr: Expression, doc_idx=None):
@@ -80,7 +84,7 @@ class SegmentEvaluator:
         if expr.is_literal:
             return np.asarray(expr.value)
         if expr.is_identifier:
-            return self.seg.values(expr.name)
+            return np.asarray(self.seg.values(expr.name))[: self.n]
         fn = get_function(expr.name)
         if expr.name == "cast":
             arg = self._eval_all(expr.args[0])
@@ -90,7 +94,7 @@ class SegmentEvaluator:
 
     # ---- filter evaluation ----------------------------------------------
     def filter_mask(self, f: FilterNode) -> np.ndarray:
-        n = self.seg.n_docs
+        n = self.n
         if f is None:
             return np.ones(n, dtype=bool)
         t = f.type
@@ -121,12 +125,12 @@ class SegmentEvaluator:
                     p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
                 d = self.seg.dictionary(lhs.name)
                 lut = self._predicate_over_values(p, d.values)
-                fwd = np.asarray(self.seg.forward(lhs.name))
+                fwd = np.asarray(self.seg.forward(lhs.name))[: self.n]
                 return lut[fwd]
         if p.type is PredicateType.IS_NULL:
-            return np.zeros(self.seg.n_docs, dtype=bool)  # nulls: see creator
+            return np.zeros(self.n, dtype=bool)  # nulls: see creator
         if p.type is PredicateType.IS_NOT_NULL:
-            return np.ones(self.seg.n_docs, dtype=bool)
+            return np.ones(self.n, dtype=bool)
         values = self.eval(lhs)
         return self._predicate_over_values(p, np.asarray(values))
 
@@ -208,13 +212,23 @@ class HostExecutor:
     def execute_segment(self, q: QueryContext, seg: ImmutableSegment) -> IntermediateResult:
         ev = SegmentEvaluator(seg)
         stats = ExecutionStats(
-            num_segments_processed=1, num_segments_queried=1, total_docs=seg.n_docs
+            num_segments_processed=1, num_segments_queried=1, total_docs=ev.n
         )
+        # upsert validDocIds: snapshot BEFORE evaluating the filter
+        # (FilterPlanNode.java:85-88 ordering)
+        vd = getattr(seg, "valid_docs_mask", None)
+        if vd is not None:
+            vd = np.asarray(vd)[: ev.n].copy()
+        elif hasattr(seg, "valid_docs"):
+            m = seg.valid_docs(ev.n)
+            vd = None if m is None else np.asarray(m).copy()
         mask = ev.filter_mask(q.filter)
+        if vd is not None:
+            mask = mask & vd
         doc_idx = np.nonzero(mask)[0]
         stats.num_docs_scanned = int(len(doc_idx))
         if q.filter is not None:
-            stats.num_entries_scanned_in_filter = seg.n_docs * len(q.filter.columns())
+            stats.num_entries_scanned_in_filter = ev.n * len(q.filter.columns())
         if len(doc_idx) > 0:
             stats.num_segments_matched = 1
 

@@ -1,0 +1,198 @@
+"""Mutable (consuming) segment: row-at-a-time indexing, immediately queryable.
+
+Equivalent of the reference's ``MutableSegmentImpl``
+(pinot-segment-local/.../indexsegment/mutable/MutableSegmentImpl.java):
+single-writer / multi-reader via a volatile doc counter — readers snapshot
+``n_docs`` once and never see a partially-written row. Strings are
+dict-encoded with an *insertion-ordered* mutable dictionary (ids are arrival
+ranks, not sort ranks — same as the reference's mutable dictionaries), so
+consuming segments execute on the host scan path; sealing re-encodes into
+sorted dictionaries via the immutable segment creator
+(realtime/converter: RealtimeSegmentConverter.java analog).
+
+TPU stance (SURVEY.md §7 hard parts): the consuming tail is the slow path by
+design — it stays on host numpy until sealed to HBM blocks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from pinot_tpu.common.datatypes import DataType, FieldRole
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.storage.segment import ColumnMetadata, Encoding, SegmentMetadata
+
+_INITIAL_CAPACITY = 4096
+
+
+class MutableColumn:
+    def __init__(self, spec):
+        self.spec = spec
+        if not spec.single_value:
+            raise NotImplementedError("multi-value columns in mutable segments")
+        self.dict_encoded = spec.data_type.is_string_like
+        if self.dict_encoded:
+            self._dict: dict = {}
+            self._dict_values: list = []
+            self._data = np.empty(_INITIAL_CAPACITY, dtype=np.int32)
+        else:
+            self._data = np.empty(_INITIAL_CAPACITY, dtype=spec.data_type.np_dtype)
+        self.min_value = None
+        self.max_value = None
+
+    def _grow(self, n: int) -> None:
+        if n >= len(self._data):
+            new = np.empty(max(len(self._data) * 2, n + 1), dtype=self._data.dtype)
+            new[: len(self._data)] = self._data
+            self._data = new
+
+    def append(self, value, row_idx: int) -> None:
+        self._grow(row_idx)
+        if self.dict_encoded:
+            v = str(value) if self.spec.data_type is not DataType.BYTES else bytes(value)
+            did = self._dict.get(v)
+            if did is None:
+                did = len(self._dict_values)
+                self._dict[v] = did
+                self._dict_values.append(v)
+            self._data[row_idx] = did
+        else:
+            v = self.spec.data_type.convert(value)
+            self._data[row_idx] = v
+        if self.min_value is None or v < self.min_value:
+            self.min_value = v
+        if self.max_value is None or v > self.max_value:
+            self.max_value = v
+
+    def values(self, n: int) -> np.ndarray:
+        """Decoded raw values for the first n docs (reader snapshot)."""
+        if self.dict_encoded:
+            # snapshot the dict list first: it only appends
+            table = np.asarray(self._dict_values[:])
+            return table[self._data[:n]]
+        return self._data[:n]
+
+    @property
+    def cardinality(self) -> int:
+        return len(self._dict_values) if self.dict_encoded else -1
+
+
+class _MetadataView:
+    """Duck-typed SegmentMetadata for the host executor / pruner."""
+
+    def __init__(self, seg: "MutableSegment"):
+        self._seg = seg
+
+    @property
+    def columns(self) -> dict:
+        return {name: self._seg.column_metadata(name) for name in self._seg._cols}
+
+
+class MutableSegment:
+    is_mutable = True
+
+    def __init__(self, schema: Schema, segment_name: str,
+                 table_config: Optional[TableConfig] = None,
+                 enable_upsert: bool = False):
+        self.schema = schema
+        self.segment_name = segment_name
+        self.table_config = table_config or TableConfig(table_name=schema.name)
+        self._cols = {n: MutableColumn(schema.field(n)) for n in schema.column_names()}
+        self._count = 0  # volatile doc counter: bumped AFTER the row lands
+        self._lock = threading.Lock()  # single writer enforced defensively
+        self._valid = np.ones(_INITIAL_CAPACITY, dtype=bool) if enable_upsert else None
+        self.start_offset = None
+        self.end_offset = None
+
+    # ---- write path ------------------------------------------------------
+    def index(self, row: dict) -> int:
+        """Index one row; returns its doc id. Row values missing from the
+        schema default to the field's null value (recordtransformer analog)."""
+        with self._lock:
+            doc_id = self._count
+            for name, col in self._cols.items():
+                v = row.get(name)
+                if v is None:
+                    v = col.spec.null_value()
+                col.append(v, doc_id)
+            if self._valid is not None and doc_id >= len(self._valid):
+                new = np.ones(len(self._valid) * 2, dtype=bool)
+                new[: len(self._valid)] = self._valid
+                self._valid = new
+            self._count = doc_id + 1  # publish: readers never see doc_id
+            return doc_id
+
+    def invalidate(self, doc_id: int) -> None:
+        """Upsert: flip this doc out of validDocIds
+        (ThreadSafeMutableRoaringBitmap analog)."""
+        if self._valid is not None:
+            self._valid[doc_id] = False
+
+    # ---- reader protocol (host executor duck type) -----------------------
+    @property
+    def n_docs(self) -> int:
+        return self._count
+
+    @property
+    def name(self) -> str:
+        return self.segment_name
+
+    @property
+    def dir(self) -> str:
+        return f"<mutable:{self.segment_name}:{self._count}>"
+
+    @property
+    def metadata(self):
+        return _MetadataView(self)
+
+    def column_names(self) -> list:
+        return list(self._cols)
+
+    def column_metadata(self, col: str) -> ColumnMetadata:
+        c = self._cols[col]
+        return ColumnMetadata(
+            name=col,
+            data_type=c.spec.data_type,
+            encoding=Encoding.RAW,  # readers take the raw-value scan path
+            cardinality=c.cardinality,
+            min_value=c.min_value,
+            max_value=c.max_value,
+            is_sorted=False,
+            single_value=True,
+            has_dictionary=False,
+            total_number_of_entries=self._count,
+        )
+
+    def dictionary(self, col: str):
+        return None  # insertion-ordered dict is not binary-searchable
+
+    def bloom(self, col: str):
+        return None
+
+    def values(self, col: str) -> np.ndarray:
+        return self._cols[col].values(self._count)
+
+    def valid_docs(self, n: int):
+        if self._valid is None:
+            return None
+        return self._valid[:n]
+
+    # ---- seal ------------------------------------------------------------
+    def seal(self, out_dir: str):
+        """Consuming → immutable conversion (RealtimeSegmentConverter.java):
+        re-encodes through the two-pass creator, which rebuilds *sorted*
+        dictionaries and all configured indexes."""
+        from pinot_tpu.storage.creator import build_segment
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        n = self._count
+        columns = {name: self._cols[name].values(n) for name in self._cols}
+        build_segment(self.schema, columns, out_dir, self.table_config, self.segment_name)
+        seg = ImmutableSegment(out_dir)
+        if self._valid is not None:
+            seg.valid_docs_mask = self._valid[:n].copy()
+        return seg

@@ -131,6 +131,10 @@ class ImmutableSegment:
     batch ``readDictIds``/``readValuesSV`` (ForwardIndexReader.java:85,114).
     """
 
+    # upsert: in-memory validDocIds mask managed by the upsert metadata
+    # manager (realtime/upsert.py); None for non-upsert tables
+    valid_docs_mask = None
+
     def __init__(self, segment_dir: str):
         self.dir = segment_dir
         with open(os.path.join(segment_dir, METADATA_FILE)) as f:

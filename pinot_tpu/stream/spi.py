@@ -1,0 +1,155 @@
+"""Stream ingestion SPI: pluggable consumers, offsets, decoders.
+
+Equivalent of pinot-spi/.../stream/: ``StreamConsumerFactory``,
+``PartitionGroupConsumer``, ``MessageBatch``, ``StreamPartitionMsgOffset``
+(orderable opaque offsets), ``StreamMessageDecoder``. Concrete streams
+register under a type key (reference: StreamConsumerFactoryProvider +
+isolated plugin classloaders; here a plain registry — python imports are the
+plugin boundary).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import functools
+import json
+from typing import Callable, Optional, Sequence
+
+from pinot_tpu.common.table_config import StreamConfig
+
+
+@functools.total_ordering
+class StreamPartitionMsgOffset:
+    """Orderable opaque offset (StreamPartitionMsgOffset.java). Wraps a long
+    for the built-in streams; subclasses may carry richer state as long as
+    comparison and string round-trip hold."""
+
+    def __init__(self, value: int):
+        self.value = int(value)
+
+    def __eq__(self, other):
+        return isinstance(other, StreamPartitionMsgOffset) and self.value == other.value
+
+    def __lt__(self, other):
+        return self.value < other.value
+
+    def __repr__(self):
+        return f"Offset({self.value})"
+
+    def to_string(self) -> str:
+        return str(self.value)
+
+    @classmethod
+    def from_string(cls, s: str) -> "StreamPartitionMsgOffset":
+        return cls(int(s))
+
+
+@dataclasses.dataclass
+class StreamMessage:
+    offset: StreamPartitionMsgOffset
+    payload: bytes
+    key: Optional[bytes] = None
+    timestamp_ms: Optional[int] = None
+
+
+@dataclasses.dataclass
+class MessageBatch:
+    """One fetch result (MessageBatch.java): messages plus the offset to
+    resume from (next fetch's start)."""
+
+    messages: Sequence[StreamMessage]
+    next_offset: StreamPartitionMsgOffset
+
+    def __len__(self):
+        return len(self.messages)
+
+
+class PartitionGroupConsumer(abc.ABC):
+    """Consumer pinned to one stream partition (PartitionGroupConsumer.java)."""
+
+    @abc.abstractmethod
+    def fetch_messages(self, start_offset: StreamPartitionMsgOffset,
+                       timeout_ms: int) -> MessageBatch:
+        ...
+
+    def close(self) -> None:
+        pass
+
+
+class StreamConsumerFactory(abc.ABC):
+    """Per-table stream access (StreamConsumerFactory.java)."""
+
+    def __init__(self, config: StreamConfig):
+        self.config = config
+
+    @abc.abstractmethod
+    def partition_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def create_partition_consumer(self, partition: int) -> PartitionGroupConsumer:
+        ...
+
+    def earliest_offset(self, partition: int) -> StreamPartitionMsgOffset:
+        return StreamPartitionMsgOffset(0)
+
+
+# ---------------------------------------------------------------------------
+# decoders (input-format plugins: pinot-plugins/pinot-input-format/*)
+# ---------------------------------------------------------------------------
+
+
+def json_decoder(payload: bytes) -> dict:
+    return json.loads(payload.decode("utf-8"))
+
+
+def csv_decoder_for(columns: Sequence[str], delimiter: str = ",") -> Callable:
+    def decode(payload: bytes) -> dict:
+        parts = payload.decode("utf-8").rstrip("\n").split(delimiter)
+        return dict(zip(columns, parts))
+
+    return decode
+
+
+_DECODERS: dict[str, Callable] = {"json": json_decoder}
+
+
+def get_decoder(name: str, stream_config: StreamConfig) -> Callable:
+    if name == "csv":
+        cols = stream_config.properties.get("csv.columns", "")
+        return csv_decoder_for(cols.split(","),
+                               stream_config.properties.get("csv.delimiter", ","))
+    try:
+        return _DECODERS[name]
+    except KeyError:
+        raise KeyError(f"unknown decoder {name!r}") from None
+
+
+def register_decoder(name: str, fn: Callable) -> None:
+    _DECODERS[name] = fn
+
+
+# ---------------------------------------------------------------------------
+# factory registry (StreamConsumerFactoryProvider analog)
+# ---------------------------------------------------------------------------
+
+_FACTORIES: dict[str, type] = {}
+
+
+def register_stream_type(name: str, factory_cls: type) -> None:
+    _FACTORIES[name] = factory_cls
+
+
+def create_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
+    # built-ins register lazily so importing the SPI stays dependency-free
+    if config.stream_type == "memory" and "memory" not in _FACTORIES:
+        from pinot_tpu.stream import memory_stream  # noqa: F401  (registers)
+    try:
+        cls = _FACTORIES[config.stream_type]
+    except KeyError:
+        raise KeyError(
+            f"unknown stream type {config.stream_type!r}; registered: "
+            f"{sorted(_FACTORIES)}"
+        ) from None
+    return cls(config)

@@ -1,0 +1,287 @@
+"""Realtime ingestion tests: mutable segments, stream consume loop, commit,
+restart-resume, flaky consumers, upsert.
+
+Reference analogs: MutableSegmentImpl tests, LLCRealtimeClusterIntegrationTest
+(rows queryable while consuming, segment commit), FlakyConsumerRealtime-
+ClusterIntegrationTest (consumer that randomly throws must not lose data),
+upsert integration tests.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType, UpsertConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.realtime.manager import RealtimeTableDataManager
+from pinot_tpu.storage.mutable import MutableSegment
+from pinot_tpu.stream.memory_stream import TopicRegistry
+from pinot_tpu.stream.spi import create_consumer_factory
+
+
+def make_schema(pk=False):
+    return Schema.build(
+        name="events",
+        dimensions=[("user", DataType.STRING), ("action", DataType.STRING)],
+        metrics=[("amount", DataType.INT)],
+        datetimes=[("ts", DataType.LONG)],
+        primary_key_columns=["user"] if pk else [],
+    )
+
+
+def wait_until(cond, timeout=10.0, interval=0.02):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestMutableSegment:
+    def test_index_and_query(self):
+        seg = MutableSegment(make_schema(), "m0")
+        for i in range(100):
+            seg.index({"user": f"u{i % 5}", "action": "click", "amount": i, "ts": i})
+        assert seg.n_docs == 100
+        eng = QueryEngine()
+        eng.table("events").add_segment(seg)
+        r = eng.execute("SELECT user, SUM(amount) FROM events GROUP BY user ORDER BY user")
+        assert len(r["resultTable"]["rows"]) == 5
+        assert r["resultTable"]["rows"][0][0] == "u0"
+        # selection + filter on the consuming segment
+        r = eng.execute("SELECT COUNT(*) FROM events WHERE action = 'click' AND amount >= 50")
+        assert r["resultTable"]["rows"][0][0] == 50
+
+    def test_seal_equivalence(self, tmp_path):
+        seg = MutableSegment(make_schema(), "m1")
+        rng = np.random.default_rng(5)
+        for i in range(500):
+            seg.index({"user": f"u{rng.integers(0, 20)}", "action": "a", "amount": int(rng.integers(0, 100)), "ts": i})
+        eng = QueryEngine()
+        eng.table("events").add_segment(seg)
+        before = eng.execute("SELECT user, SUM(amount), COUNT(*) FROM events GROUP BY user ORDER BY user LIMIT 100")
+        sealed = seg.seal(str(tmp_path / "sealed"))
+        eng2 = QueryEngine()
+        eng2.table("events").add_segment(sealed)
+        after = eng2.execute("SELECT user, SUM(amount), COUNT(*) FROM events GROUP BY user ORDER BY user LIMIT 100")
+        assert before["resultTable"]["rows"] == after["resultTable"]["rows"]
+        assert sealed.column_metadata("user").is_sorted in (True, False)  # real metadata present
+
+    def test_missing_column_gets_null_default(self):
+        seg = MutableSegment(make_schema(), "m2")
+        seg.index({"user": "u1", "ts": 1})  # no action/amount
+        assert seg.n_docs == 1
+        assert seg.values("amount")[0] == make_schema().field("amount").null_value()
+
+
+def _realtime_setup(tmp_path, topic_name, n_partitions=2, flush_rows=200, upsert=False):
+    TopicRegistry.delete(topic_name)
+    topic = TopicRegistry.create(topic_name, n_partitions)
+    cfg = TableConfig(
+        table_name="events",
+        table_type=TableType.REALTIME,
+        upsert=UpsertConfig(mode="FULL", comparison_column="ts") if upsert else UpsertConfig(),
+        stream=StreamConfig(
+            stream_type="memory",
+            topic=topic_name,
+            decoder="json",
+            segment_flush_threshold_rows=flush_rows,
+            segment_flush_threshold_seconds=3600,
+        ),
+    )
+    eng = QueryEngine()
+    mgr = RealtimeTableDataManager(
+        make_schema(pk=upsert), cfg, eng.table("events"), str(tmp_path / "rt")
+    )
+    return topic, cfg, eng, mgr
+
+
+class TestRealtimeConsumption:
+    def test_consume_query_commit(self, tmp_path):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_consume", flush_rows=150)
+        mgr.start()
+        try:
+            for i in range(500):
+                topic.publish_json(
+                    {"user": f"u{i % 10}", "action": "view", "amount": i % 50, "ts": i},
+                    partition=i % 2,
+                )
+            assert wait_until(lambda: _count(eng) == 500), _count(eng)
+            # commits happened (150-row flush threshold, 250 rows/partition)
+            assert wait_until(
+                lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 2
+            )
+            # data correct across sealed + consuming segments
+            r = eng.execute("SELECT user, COUNT(*) FROM events GROUP BY user ORDER BY user LIMIT 20")
+            assert [row[1] for row in r["resultTable"]["rows"]] == [50] * 10
+        finally:
+            mgr.stop()
+
+    def test_restart_resumes_from_checkpoint(self, tmp_path):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_resume", n_partitions=1, flush_rows=100)
+        mgr.start()
+        for i in range(250):
+            topic.publish_json({"user": "u1", "action": "a", "amount": 1, "ts": i})
+        assert wait_until(lambda: _count(eng) == 250)
+        mgr.stop(commit_remaining=True)  # commits the 50-row tail too
+
+        # "restart": new engine+manager over the same data dir and topic
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        # committed segments are reloaded from disk by the server layer in a
+        # real deployment; here we verify the consume loop resumes at the
+        # checkpointed offset (no re-consumption of committed rows)
+        mgr2.start()
+        try:
+            for i in range(50):
+                topic.publish_json({"user": "u2", "action": "b", "amount": 1, "ts": 250 + i})
+            assert wait_until(lambda: _count(eng2) == 50), _count(eng2)
+            r = eng2.execute("SELECT COUNT(*) FROM events WHERE user = 'u2'")
+            assert r["resultTable"]["rows"][0][0] == 50
+        finally:
+            mgr2.stop(commit_remaining=False)
+
+    def test_flaky_consumer_loses_nothing(self, tmp_path, monkeypatch):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_flaky", n_partitions=1, flush_rows=10_000)
+        # wrap the factory to produce consumers that fail every 3rd fetch
+        real_factory = mgr._factory
+        calls = {"n": 0}
+
+        class FlakyConsumer:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def fetch_messages(self, offset, timeout_ms):
+                calls["n"] += 1
+                if calls["n"] % 3 == 0:
+                    raise RuntimeError("flaky!")
+                return self.inner.fetch_messages(offset, timeout_ms)
+
+            def close(self):
+                self.inner.close()
+
+        class FlakyFactory:
+            def partition_count(self):
+                return real_factory.partition_count()
+
+            def earliest_offset(self, p):
+                return real_factory.earliest_offset(p)
+
+            def create_partition_consumer(self, p):
+                return FlakyConsumer(real_factory.create_partition_consumer(p))
+
+        mgr._factory = FlakyFactory()
+        mgr.start()
+        try:
+            for i in range(300):
+                topic.publish_json({"user": f"u{i}", "action": "x", "amount": 1, "ts": i})
+            assert wait_until(lambda: _count(eng) == 300, timeout=15), _count(eng)
+            assert calls["n"] >= 3  # flakiness actually exercised
+        finally:
+            mgr.stop(commit_remaining=False)
+
+
+class TestUpsert:
+    def test_latest_record_wins(self, tmp_path):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert", n_partitions=1,
+                                               flush_rows=10_000, upsert=True)
+        mgr.start()
+        try:
+            topic.publish_json({"user": "alice", "action": "a", "amount": 10, "ts": 100})
+            topic.publish_json({"user": "bob", "action": "b", "amount": 20, "ts": 100})
+            topic.publish_json({"user": "alice", "action": "c", "amount": 99, "ts": 200})
+            assert wait_until(lambda: _total_indexed(mgr) == 3)
+            r = eng.execute("SELECT COUNT(*) FROM events")
+            assert r["resultTable"]["rows"][0][0] == 2  # one alive row per key
+            r = eng.execute("SELECT SUM(amount) FROM events WHERE user = 'alice'")
+            assert r["resultTable"]["rows"][0][0] == 99  # latest ts wins
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_out_of_order_ignored(self, tmp_path):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert2", n_partitions=1,
+                                               flush_rows=10_000, upsert=True)
+        mgr.start()
+        try:
+            topic.publish_json({"user": "x", "action": "new", "amount": 5, "ts": 500})
+            topic.publish_json({"user": "x", "action": "old", "amount": 7, "ts": 100})
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            r = eng.execute("SELECT SUM(amount) FROM events WHERE user = 'x'")
+            assert r["resultTable"]["rows"][0][0] == 5  # older comparison loses
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_upsert_survives_commit(self, tmp_path):
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert3", n_partitions=1,
+                                               flush_rows=3, upsert=True)
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "action": "1", "amount": 1, "ts": 1})
+            topic.publish_json({"user": "b", "action": "1", "amount": 2, "ts": 1})
+            topic.publish_json({"user": "c", "action": "1", "amount": 3, "ts": 1})  # flush
+            assert wait_until(
+                lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 1
+            )
+            # override a key that now lives in the SEALED segment
+            topic.publish_json({"user": "a", "action": "2", "amount": 100, "ts": 2})
+            assert wait_until(lambda: _total(eng, "SELECT SUM(amount) FROM events") == 105)
+            r = eng.execute("SELECT COUNT(*) FROM events")
+            assert r["resultTable"]["rows"][0][0] == 3
+        finally:
+            mgr.stop(commit_remaining=False)
+
+
+def _count(eng):
+    r = eng.execute("SELECT COUNT(*) FROM events")
+    if r.get("exceptions"):
+        return -1
+    return r["resultTable"]["rows"][0][0]
+
+
+def _total(eng, sql):
+    r = eng.execute(sql)
+    if r.get("exceptions"):
+        return None
+    return r["resultTable"]["rows"][0][0]
+
+
+def _total_indexed(mgr):
+    """Docs in the current consuming segments (tests using this don't flush)."""
+    return sum(m.segment.n_docs for m in mgr.partition_managers.values())
+
+
+class TestUpsertRestart:
+    def test_rebuild_from_sealed_segments(self, tmp_path):
+        """Restart recovery: add_segment over disk-loaded segments (no masks
+        yet) must materialize validDocIds and hide stale rows."""
+        from pinot_tpu.realtime.upsert import PartitionUpsertMetadataManager
+        from pinot_tpu.storage.creator import build_segment
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        schema = make_schema(pk=True)
+        cfg = TableConfig(table_name="events")
+        s0_cols = {"user": ["alice", "bob"], "action": ["a", "b"],
+                   "amount": [10, 20], "ts": [100, 100]}
+        s1_cols = {"user": ["alice"], "action": ["c"], "amount": [99], "ts": [200]}
+        build_segment(schema, s0_cols, str(tmp_path / "s0"), cfg, "s0")
+        build_segment(schema, s1_cols, str(tmp_path / "s1"), cfg, "s1")
+        s0 = ImmutableSegment(str(tmp_path / "s0"))
+        s1 = ImmutableSegment(str(tmp_path / "s1"))
+
+        upsert = PartitionUpsertMetadataManager("ts")
+        for seg, cols in ((s0, s0_cols), (s1, s1_cols)):  # commit order
+            upsert.add_segment(seg, [(u,) for u in cols["user"]], cols["ts"])
+
+        eng = QueryEngine()
+        eng.table("events").add_segment(s0)
+        eng.table("events").add_segment(s1)
+        r = eng.execute("SELECT COUNT(*) FROM events")
+        assert r["resultTable"]["rows"][0][0] == 2  # alice deduped
+        r = eng.execute("SELECT SUM(amount) FROM events WHERE user = 'alice'")
+        assert r["resultTable"]["rows"][0][0] == 99
