@@ -1,0 +1,274 @@
+"""Broker role: SQL endpoint → route → scatter/gather → reduce.
+
+Equivalent of the reference's broker stack (pinot-broker/:
+BaseBrokerRequestHandler.java:169,194-400 parse→rewrite→route→scatter→reduce,
+BrokerRoutingManager + instance selectors, failuredetector/ with exponential
+backoff, SingleConnectionBrokerRequestHandler netty scatter-gather). The
+scatter rides gRPC channels (transport/grpc_transport.py); the reduce is the
+same value-space merge used in-process (engine/reduce.py), since servers ship
+canonical DataTable partials.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+from pinot_tpu.cluster.registry import ClusterRegistry, Role, SegmentState
+from pinot_tpu.engine.datatable import decode
+from pinot_tpu.engine.reduce import finalize, merge_intermediates
+from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
+from pinot_tpu.query.context import QueryContext
+from pinot_tpu.query.optimizer import optimize_query
+from pinot_tpu.sql.compiler import compile_query
+from pinot_tpu.transport.grpc_transport import QueryRouterChannel, make_instance_request
+
+log = logging.getLogger("pinot_tpu.broker")
+
+
+class FailureDetector:
+    """Connection-level failure detector with exponential backoff retry
+    (pinot-broker/.../failuredetector/BaseExponentialBackoffRetryFailureDetector)."""
+
+    def __init__(self, initial_backoff_s: float = 1.0, max_backoff_s: float = 30.0):
+        self._unhealthy: dict[str, tuple[float, float]] = {}  # id -> (retry_at, backoff)
+        self._initial = initial_backoff_s
+        self._max = max_backoff_s
+        self._lock = threading.Lock()
+
+    def mark_failure(self, instance_id: str) -> None:
+        with self._lock:
+            _, backoff = self._unhealthy.get(instance_id, (0.0, self._initial / 2))
+            backoff = min(backoff * 2, self._max)
+            self._unhealthy[instance_id] = (time.time() + backoff, backoff)
+
+    def mark_success(self, instance_id: str) -> None:
+        with self._lock:
+            self._unhealthy.pop(instance_id, None)
+
+    def is_healthy(self, instance_id: str) -> bool:
+        with self._lock:
+            entry = self._unhealthy.get(instance_id)
+            if entry is None:
+                return True
+            return time.time() >= entry[0]  # retry window open
+
+
+class RoutingManager:
+    """table → {instance: [segments]} from the registry's assignment
+    (BrokerRoutingManager.java:87 + balanced instance selection: one replica
+    per segment, round-robin across queries)."""
+
+    def __init__(self, registry: ClusterRegistry, failure_detector: FailureDetector):
+        self.registry = registry
+        self.failures = failure_detector
+        self._rr = itertools.count()
+
+    def routing_table(self, table: str) -> Optional[dict]:
+        # route on the EXTERNAL VIEW (what servers actually serve), not the
+        # ideal-state assignment — assignment may race ahead of loading
+        view = self.registry.external_view(table)
+        if not view:
+            return None
+        records = self.registry.segments(table)
+        offset = next(self._rr)
+        out: dict[str, list] = {}
+        for segment, instances in view.items():
+            rec = records.get(segment)
+            if rec is not None and rec.state == SegmentState.OFFLINE:
+                continue
+            candidates = [i for i in instances if self.failures.is_healthy(i)]
+            if not candidates:
+                candidates = instances  # all unhealthy: try anyway
+            pick = candidates[offset % len(candidates)]
+            out.setdefault(pick, []).append(segment)
+        return out
+
+
+class Broker:
+    def __init__(self, registry: ClusterRegistry, broker_id: str = "broker_0",
+                 timeout_s: float = 10.0):
+        self.registry = registry
+        self.broker_id = broker_id
+        self.timeout_s = timeout_s
+        self.failures = FailureDetector()
+        self.routing = RoutingManager(registry, self.failures)
+        self._channels: dict[str, QueryRouterChannel] = {}
+        self._channels_lock = threading.Lock()
+        self._request_id = itertools.count(1)
+        self._pool = futures.ThreadPoolExecutor(max_workers=16)
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._pool.shutdown(wait=False)
+
+    def _channel(self, instance_id: str) -> Optional[QueryRouterChannel]:
+        info = {i.instance_id: i for i in self.registry.instances(Role.SERVER)}.get(
+            instance_id
+        )
+        if info is None:
+            return None
+        with self._channels_lock:  # pool threads race per-instance channels
+            ch = self._channels.get(instance_id)
+            if ch is None or ch.endpoint != info.endpoint:
+                if ch is not None:
+                    ch.close()
+                ch = QueryRouterChannel(info.endpoint)
+                self._channels[instance_id] = ch
+            return ch
+
+    # ---- request handling ------------------------------------------------
+    def execute(self, sql: str) -> dict:
+        """HTTP POST /query/sql equivalent (PinotClientRequest →
+        BaseBrokerRequestHandler.handleRequest)."""
+        t0 = time.time()
+        try:
+            q = optimize_query(compile_query(sql))
+            if q.explain:
+                from pinot_tpu.engine.explain import explain_plan
+
+                class _NoDevice:  # broker-side explain has no local executor
+                    device = None
+
+                return explain_plan(_NoDevice(), q)
+            resp = self._scatter_gather(q, sql)
+        except Exception as e:  # noqa: BLE001 — in-band errors like the reference
+            return {"exceptions": [{"errorCode": 450, "message": f"{type(e).__name__}: {e}"}]}
+        resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
+        return resp
+
+    def _expand_star(self, q: QueryContext) -> QueryContext:
+        """SELECT * resolves against the registry schema (looked up via the
+        physical table key) so the broker's reduce sees the same select
+        positions the servers produced."""
+        from pinot_tpu.query.rewrite import expand_star
+
+        schema = None
+        for key in (q.table_name, f"{q.table_name}_OFFLINE", f"{q.table_name}_REALTIME"):
+            schema = self.registry.table_schema(key)
+            if schema is not None:
+                break
+        if schema is None:
+            return q
+        return expand_star(q, schema.column_names())
+
+    def _physical_tables(self, raw: str) -> list:
+        """Raw table name → [(physical key, time filter or None)].
+
+        A hybrid table (both _OFFLINE and _REALTIME registered) is split at
+        the time boundary = max offline segment end time: offline answers
+        time <= boundary, realtime answers time > boundary
+        (routing/timeboundary/TimeBoundaryManager.java +
+        BaseBrokerRequestHandler.java:387-395)."""
+        tables = set(self.registry.tables())
+        if raw in tables:
+            return [(raw, None)]
+        off, rt = f"{raw}_OFFLINE", f"{raw}_REALTIME"
+        out = []
+        boundary = None
+        if off in tables and rt in tables:
+            cfg = self.registry.table_config(off)
+            if cfg is not None and cfg.time_column is not None:
+                ends = [
+                    r.end_time
+                    for r in self.registry.segments(off).values()
+                    if r.end_time is not None
+                ]
+                if ends:
+                    boundary = (cfg.time_column, max(ends))
+        if off in tables:
+            tf = None if boundary is None else                 {"column": boundary[0], "op": "le", "value": boundary[1]}
+            out.append((off, tf))
+        if rt in tables:
+            tf = None if boundary is None else                 {"column": boundary[0], "op": "gt", "value": boundary[1]}
+            out.append((rt, tf))
+        if not out:
+            raise KeyError(f"table {raw!r} not found")
+        return out
+
+    def _scatter_gather(self, q: QueryContext, sql: str) -> dict:
+        q = self._expand_star(q)
+        request_id = next(self._request_id)
+
+        scatter = []  # (instance, physical table, segments, time_filter)
+        n_servers = set()
+        for physical, time_filter in self._physical_tables(q.table_name):
+            routing = self.routing.routing_table(physical)
+            if not routing:
+                continue
+            for inst, segs in routing.items():
+                scatter.append((inst, physical, segs, time_filter))
+                n_servers.add(inst)
+        if not scatter:
+            raise KeyError(f"no routing entry for table {q.table_name!r}")
+
+        def call(instance_id: str, physical: str, segments: list, time_filter):
+            ch = self._channel(instance_id)
+            if ch is None:
+                raise ConnectionError(f"server {instance_id} not registered")
+            payload = make_instance_request(
+                sql, segments, request_id, self.broker_id,
+                table=physical, time_filter=time_filter,
+            )
+            return decode(ch.submit(payload, self.timeout_s))
+
+        futs = {
+            self._pool.submit(call, inst, phys, segs, tf): inst
+            for inst, phys, segs, tf in scatter
+        }
+        from pinot_tpu.engine.datatable import NoSegmentsHosted, ServerQueryError
+
+        results, exceptions = [], []
+        query_errors = []
+        for fut, inst in futs.items():
+            try:
+                results.append(fut.result(timeout=self.timeout_s + 1))
+                self.failures.mark_success(inst)
+            except NoSegmentsHosted:
+                # benign routing/sync race: segments moved between the
+                # external-view read and the RPC; not a server failure
+                self.failures.mark_success(inst)
+            except ServerQueryError as e:
+                # query-level error (bad column etc.): the server is healthy;
+                # report in-band without poisoning the failure detector
+                self.failures.mark_success(inst)
+                query_errors.append(
+                    {"errorCode": 200, "message": f"{inst}: {e}"}
+                )
+            except Exception as e:  # noqa: BLE001 — transport-level failure
+                self.failures.mark_failure(inst)
+                exceptions.append(
+                    {"errorCode": 427, "message": f"SERVER_NOT_RESPONDING: {inst}: {e}"}
+                )
+        if query_errors:
+            return {"exceptions": query_errors}
+        if not results:
+            raise ConnectionError(f"all servers failed: {exceptions}")
+
+        merged = merge_intermediates(q, results)
+        table = finalize(q, merged)
+        resp = table.to_json()
+        stats = merged.stats
+        resp.update(
+            {
+                "exceptions": exceptions,
+                "partialResult": bool(exceptions),
+                "numServersQueried": len(n_servers),
+                "numServersResponded": len(results),
+                "numDocsScanned": stats.num_docs_scanned,
+                "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,
+                "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,
+                "numSegmentsQueried": stats.num_segments_queried,
+                "numSegmentsProcessed": stats.num_segments_processed,
+                "numSegmentsMatched": stats.num_segments_matched,
+                "totalDocs": stats.total_docs,
+                "requestId": request_id,
+            }
+        )
+        return resp

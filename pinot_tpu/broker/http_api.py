@@ -1,0 +1,70 @@
+"""Broker HTTP endpoint: POST /query/sql, the reference's public query API.
+
+Equivalent of pinot-broker/.../api/resources/PinotClientRequest.java (the
+jersey resource brokering HTTP to BaseBrokerRequestHandler) — stdlib
+ThreadingHTTPServer; each request body is {"sql": "..."} and the response is
+the BrokerResponse JSON. /health mirrors the reference's health resource.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class BrokerHttpServer:
+    def __init__(self, broker, host: str = "127.0.0.1", port: int = 0):
+        self.broker = broker
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._send(200, {"status": "OK"})
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path not in ("/query/sql", "/query"):
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    sql = payload.get("sql", "")
+                    self._send(200, outer.broker.execute(sql))
+                except Exception as e:  # noqa: BLE001
+                    self._send(
+                        200,
+                        {"exceptions": [{"errorCode": 450,
+                                         "message": f"{type(e).__name__}: {e}"}]},
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="broker-http", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

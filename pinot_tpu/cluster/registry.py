@@ -1,0 +1,313 @@
+"""Cluster registry: the Helix/ZooKeeper replacement.
+
+The reference coordinates everything through Helix IdealState/ExternalView in
+ZK (SURVEY.md §1: PinotHelixResourceManager writes IdealState, brokers watch
+ExternalView, servers run the OFFLINE/CONSUMING/ONLINE state model). The TPU
+build replaces that with a small registry of durable maps:
+
+- instances (role, endpoint, heartbeat)       — LiveInstance analog
+- tables (config + schema JSON)               — PROPERTYSTORE configs
+- segments (metadata + deep-store URI + state)— SegmentZKMetadata
+- assignment {table: {segment: [instanceId]}} — IdealState
+- external view {table: {segment: [instanceId]}} — what servers actually
+  serve (brokers route on this, exactly like the reference's brokers watch
+  ExternalView, BrokerRoutingManager.java:87)
+- partition assignment for realtime tables    — LLC partition → server
+
+Two implementations share the interface: in-memory (single process, tests)
+and file-backed JSON-with-lock (multi-process on a shared filesystem). A
+proper multi-host deployment would swap in an etcd-backed impl behind the
+same surface — state transitions are polled by servers (sync loop), not
+pushed, which replaces Helix messages with level-triggered reconciliation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fcntl
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+
+
+class Role:
+    SERVER = "SERVER"
+    BROKER = "BROKER"
+    CONTROLLER = "CONTROLLER"
+    MINION = "MINION"
+
+
+class SegmentState:
+    ONLINE = "ONLINE"
+    CONSUMING = "CONSUMING"
+    OFFLINE = "OFFLINE"
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    instance_id: str
+    role: str
+    host: str = "127.0.0.1"
+    grpc_port: int = 0
+    last_heartbeat_ms: int = 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.grpc_port}"
+
+
+@dataclasses.dataclass
+class SegmentRecord:
+    name: str
+    table: str
+    n_docs: int = 0
+    location: str = ""          # deep-store URI (directory path for localfs)
+    state: str = SegmentState.ONLINE
+    start_time: Optional[int] = None
+    end_time: Optional[int] = None
+    partition_column: Optional[str] = None
+    partition_ids: Optional[list] = None
+    crc: Optional[str] = None
+    push_time_ms: int = 0
+
+
+def _to_json(state: dict) -> dict:
+    return {
+        "instances": {k: dataclasses.asdict(v) for k, v in state["instances"].items()},
+        "tables": state["tables"],
+        "schemas": state["schemas"],
+        "segments": {
+            t: {n: dataclasses.asdict(r) for n, r in segs.items()}
+            for t, segs in state["segments"].items()
+        },
+        "assignment": state["assignment"],
+        "external_view": state["external_view"],
+        "partition_assignment": state["partition_assignment"],
+    }
+
+
+def _from_json(d: dict) -> dict:
+    return {
+        "instances": {k: InstanceInfo(**v) for k, v in d.get("instances", {}).items()},
+        "tables": d.get("tables", {}),
+        "schemas": d.get("schemas", {}),
+        "segments": {
+            t: {n: SegmentRecord(**r) for n, r in segs.items()}
+            for t, segs in d.get("segments", {}).items()
+        },
+        "assignment": d.get("assignment", {}),
+        "external_view": d.get("external_view", {}),
+        "partition_assignment": d.get("partition_assignment", {}),
+    }
+
+
+class ClusterRegistry:
+    """In-memory registry (single-process clusters and tests)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._state = {
+            "instances": {},
+            "tables": {},
+            "schemas": {},
+            "segments": {},
+            "assignment": {},
+            "external_view": {},
+            "partition_assignment": {},
+        }
+
+    # ---- tx plumbing (overridden by FileRegistry) ------------------------
+    def _read(self) -> dict:
+        return self._state
+
+    def _write(self, state: dict) -> None:
+        self._state = state
+
+    def _tx(self, fn, write: bool = True):
+        with self._lock:
+            state = self._read()
+            out = fn(state)
+            if write:
+                self._write(state)
+            return out
+
+    def _tx_read(self, fn):
+        return self._tx(fn, write=False)
+
+    # ---- instances -------------------------------------------------------
+    def register_instance(self, info: InstanceInfo) -> None:
+        info.last_heartbeat_ms = int(time.time() * 1000)
+        self._tx(lambda s: s["instances"].__setitem__(info.instance_id, info))
+
+    def heartbeat(self, instance_id: str) -> None:
+        def fn(s):
+            if instance_id in s["instances"]:
+                s["instances"][instance_id].last_heartbeat_ms = int(time.time() * 1000)
+
+        self._tx(fn)
+
+    def instances(self, role: Optional[str] = None, live_ttl_ms: Optional[int] = None):
+        def fn(s):
+            out = list(s["instances"].values())
+            if role is not None:
+                out = [i for i in out if i.role == role]
+            if live_ttl_ms is not None:
+                now = int(time.time() * 1000)
+                out = [i for i in out if now - i.last_heartbeat_ms <= live_ttl_ms]
+            return out
+
+        return self._tx_read(fn)
+
+    def drop_instance(self, instance_id: str) -> None:
+        def fn(s):
+            s["instances"].pop(instance_id, None)
+            for table, ev in s["external_view"].items():
+                for seg in list(ev):
+                    if instance_id in ev[seg]:
+                        ev[seg] = [i for i in ev[seg] if i != instance_id]
+
+        self._tx(fn)
+
+    # ---- tables ----------------------------------------------------------
+    def add_table(self, config: TableConfig, schema: Schema,
+                  key: Optional[str] = None) -> None:
+        key = key or config.table_name
+
+        def fn(s):
+            s["tables"][key] = config.to_json()
+            s["schemas"][key] = schema.to_json()
+            s["segments"].setdefault(key, {})
+            s["assignment"].setdefault(key, {})
+
+        self._tx(fn)
+
+    def drop_table(self, table: str) -> None:
+        def fn(s):
+            for key in ("tables", "schemas", "segments", "assignment",
+                        "external_view", "partition_assignment"):
+                s[key].pop(table, None)
+
+        self._tx(fn)
+
+    def table_config(self, table: str) -> Optional[TableConfig]:
+        d = self._tx_read(lambda s: s["tables"].get(table))
+        return None if d is None else TableConfig.from_json(d)
+
+    def table_schema(self, table: str) -> Optional[Schema]:
+        d = self._tx_read(lambda s: s["schemas"].get(table))
+        return None if d is None else Schema.from_json(d)
+
+    def tables(self) -> list:
+        return self._tx_read(lambda s: list(s["tables"]))
+
+    # ---- segments + assignment ------------------------------------------
+    def add_segment(self, record: SegmentRecord, instance_ids: list) -> None:
+        record.push_time_ms = record.push_time_ms or int(time.time() * 1000)
+
+        def fn(s):
+            s["segments"].setdefault(record.table, {})[record.name] = record
+            s["assignment"].setdefault(record.table, {})[record.name] = list(instance_ids)
+
+        self._tx(fn)
+
+    def remove_segment(self, table: str, name: str) -> None:
+        def fn(s):
+            s["segments"].get(table, {}).pop(name, None)
+            s["assignment"].get(table, {}).pop(name, None)
+
+        self._tx(fn)
+
+    def segments(self, table: str) -> dict:
+        return self._tx_read(lambda s: dict(s["segments"].get(table, {})))
+
+    def assignment(self, table: str) -> dict:
+        return self._tx_read(lambda s: {k: list(v) for k, v in s["assignment"].get(table, {}).items()})
+
+    def set_assignment(self, table: str, mapping: dict) -> None:
+        self._tx(lambda s: s["assignment"].__setitem__(
+            table, {k: list(v) for k, v in mapping.items()}
+        ))
+
+    def assigned_segments(self, instance_id: str) -> dict:
+        """{table: [segment names]} hosted by this instance (server sync)."""
+
+        def fn(s):
+            out: dict = {}
+            for table, mapping in s["assignment"].items():
+                names = [seg for seg, inst in mapping.items() if instance_id in inst]
+                if names:
+                    out[table] = names
+            return out
+
+        return self._tx_read(fn)
+
+    # ---- external view (server-reported serving state) -------------------
+    def update_external_view(self, instance_id: str, serving: dict) -> None:
+        """``serving``: {table: [segment names]} this instance can answer
+        for right now (loaded immutable + live consuming segments)."""
+
+        def fn(s):
+            ev_all = s["external_view"]
+            for table, ev in ev_all.items():
+                for seg in list(ev):
+                    if instance_id in ev[seg]:
+                        ev[seg] = [i for i in ev[seg] if i != instance_id]
+            for table, names in serving.items():
+                ev = ev_all.setdefault(table, {})
+                for name in names:
+                    lst = ev.setdefault(name, [])
+                    if instance_id not in lst:
+                        lst.append(instance_id)
+
+        self._tx(fn)
+
+    def external_view(self, table: str) -> dict:
+        return self._tx_read(
+            lambda s: {k: list(v) for k, v in s["external_view"].get(table, {}).items() if v}
+        )
+
+    # ---- realtime partition assignment ----------------------------------
+    def set_partition_assignment(self, table: str, mapping: dict) -> None:
+        """{partition(str): instance_id}"""
+        self._tx(lambda s: s["partition_assignment"].__setitem__(
+            table, {str(k): v for k, v in mapping.items()}
+        ))
+
+    def partition_assignment(self, table: str) -> dict:
+        return self._tx_read(lambda s: dict(s["partition_assignment"].get(table, {})))
+
+
+class FileRegistry(ClusterRegistry):
+    """JSON-file-backed registry with advisory locking: the durable cluster
+    state for multi-process single-host clusters (the role ZK plays)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                json.dump(_to_json(self._state), f)
+
+    def _tx(self, fn, write: bool = True):
+        with self._lock:
+            with open(self.path, "r+") as f:
+                fcntl.flock(f, fcntl.LOCK_EX if write else fcntl.LOCK_SH)
+                try:
+                    try:
+                        state = _from_json(json.load(f))
+                    except json.JSONDecodeError:
+                        state = _from_json({})
+                    out = fn(state)
+                    if write:
+                        f.seek(0)
+                        f.truncate()
+                        json.dump(_to_json(state), f)
+                    return out
+                finally:
+                    fcntl.flock(f, fcntl.LOCK_UN)

@@ -1,0 +1,201 @@
+"""Controller role: table/segment lifecycle + assignment + maintenance.
+
+Equivalent of the reference's controller (pinot-controller/:
+PinotHelixResourceManager table/segment/instance CRUD, segment assignment
+strategies under assignment/segment/, TableRebalancer minimal-movement
+rebalance, RetentionManager, PinotLLCRealtimeSegmentManager creating
+consuming partitions). Helix writes become registry transactions; servers
+reconcile by polling (server/server.py sync loop).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import Optional
+
+from pinot_tpu.cluster.registry import (
+    ClusterRegistry,
+    InstanceInfo,
+    Role,
+    SegmentRecord,
+    SegmentState,
+)
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig, TableType
+from pinot_tpu.storage.segment import ImmutableSegment
+
+log = logging.getLogger("pinot_tpu.controller")
+
+
+class SegmentAssigner:
+    """Balanced assignment: each segment gets `replication` replicas on the
+    least-loaded live servers (assignment/segment/OfflineSegmentAssignment +
+    SegmentAssignmentUtils balanced strategy). Liveness = heartbeat within
+    ``live_ttl_ms`` (servers heartbeat from their sync loop), so hard-dead
+    instances never receive new segments."""
+
+    def __init__(self, registry: ClusterRegistry, live_ttl_ms: int = 30_000):
+        self.registry = registry
+        self.live_ttl_ms = live_ttl_ms
+
+    def _live_servers(self):
+        return self.registry.instances(Role.SERVER, live_ttl_ms=self.live_ttl_ms)
+
+    def _load(self) -> dict:
+        counts: dict[str, int] = {
+            i.instance_id: 0 for i in self._live_servers()
+        }
+        for table in self.registry.tables():
+            for seg, instances in self.registry.assignment(table).items():
+                for inst in instances:
+                    if inst in counts:
+                        counts[inst] += 1
+        return counts
+
+    def assign(self, replication: int) -> list:
+        counts = self._load()
+        if not counts:
+            raise RuntimeError("no live servers to assign to")
+        ordered = sorted(counts, key=lambda i: counts[i])
+        return ordered[: max(1, min(replication, len(ordered)))]
+
+    def rebalance(self, table: str, replication: int) -> dict:
+        """Minimal-movement rebalance (rebalance/TableRebalancer.java): keep
+        existing replicas where possible, move only to fix replication or
+        heavy skew."""
+        servers = [i.instance_id for i in self._live_servers()]
+        if not servers:
+            return {}
+        current = self.registry.assignment(table)
+        target_total = sum(max(1, min(replication, len(servers))) for _ in current)
+        per_server = -(-target_total // len(servers))  # ceil: balanced load cap
+        counts = {s: 0 for s in servers}
+        new: dict[str, list] = {}
+        # first pass: keep existing placements that still fit
+        for seg, instances in current.items():
+            kept = []
+            for inst in instances:
+                if inst in counts and counts[inst] < per_server and len(kept) < replication:
+                    kept.append(inst)
+                    counts[inst] += 1
+            new[seg] = kept
+        # second pass: top up replication from least-loaded servers
+        for seg, kept in new.items():
+            want = max(1, min(replication, len(servers)))
+            for inst in sorted(counts, key=lambda s: counts[s]):
+                if len(kept) >= want:
+                    break
+                if inst not in kept:
+                    kept.append(inst)
+                    counts[inst] += 1
+        self.registry.set_assignment(table, new)
+        return new
+
+
+class Controller:
+    def __init__(self, registry: ClusterRegistry, deep_store_dir: str,
+                 controller_id: str = "controller_0"):
+        self.registry = registry
+        self.deep_store = deep_store_dir
+        os.makedirs(deep_store_dir, exist_ok=True)
+        self.assigner = SegmentAssigner(registry)
+        registry.register_instance(InstanceInfo(controller_id, Role.CONTROLLER))
+
+    # ---- table lifecycle -------------------------------------------------
+    def add_table(self, config: TableConfig, schema: Schema) -> None:
+        """Tables register under their type-suffixed physical name
+        (sales_OFFLINE / sales_REALTIME) — a raw name with both parts is a
+        hybrid table and the broker splits queries at the time boundary."""
+        self.registry.add_table(config, schema, key=config.table_name_with_type)
+        if config.table_type == TableType.REALTIME and config.stream is not None:
+            self._assign_stream_partitions(config)
+
+    def drop_table(self, table: str) -> None:
+        self.registry.drop_table(table)
+
+    def _assign_stream_partitions(self, config: TableConfig) -> None:
+        """Stream partition → server round-robin
+        (PinotLLCRealtimeSegmentManager's consuming-segment creation)."""
+        from pinot_tpu.stream.spi import create_consumer_factory
+
+        servers = [
+            i.instance_id
+            for i in self.registry.instances(Role.SERVER,
+                                             live_ttl_ms=self.assigner.live_ttl_ms)
+        ]
+        if not servers:
+            raise RuntimeError("no servers available for realtime partitions")
+        n = create_consumer_factory(config.stream).partition_count()
+        mapping = {p: servers[p % len(servers)] for p in range(n)}
+        self.registry.set_partition_assignment(config.table_name_with_type, mapping)
+
+    # ---- segment lifecycle -----------------------------------------------
+    def resolve(self, table: str) -> str:
+        """Raw name → physical registry key (OFFLINE preferred for pushes)."""
+        tables = set(self.registry.tables())
+        if table in tables:
+            return table
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if f"{table}{suffix}" in tables:
+                return f"{table}{suffix}"
+        raise KeyError(f"table {table!r} not found")
+
+    def upload_segment(self, table: str, segment_dir: str,
+                       copy_to_deep_store: bool = True) -> SegmentRecord:
+        """Segment push (PinotSegmentUploadDownloadRestletResource →
+        PinotHelixResourceManager.addNewSegment → IdealState update)."""
+        table = self.resolve(table)
+        cfg = self.registry.table_config(table)
+        if cfg is None:
+            raise KeyError(f"table {table!r} not found")
+        seg = ImmutableSegment(segment_dir)
+        location = segment_dir
+        if copy_to_deep_store:
+            location = os.path.join(self.deep_store, table, seg.name)
+            if os.path.abspath(location) != os.path.abspath(segment_dir):
+                os.makedirs(os.path.dirname(location), exist_ok=True)
+                if os.path.exists(location):
+                    shutil.rmtree(location)
+                shutil.copytree(segment_dir, location)
+        meta = seg.metadata
+        record = SegmentRecord(
+            name=seg.name, table=table, n_docs=seg.n_docs, location=location,
+            state=SegmentState.ONLINE, start_time=meta.start_time,
+            end_time=meta.end_time, crc=meta.crc,
+        )
+        instances = self.assigner.assign(cfg.replication)
+        self.registry.add_segment(record, instances)
+        return record
+
+    def delete_segment(self, table: str, name: str) -> None:
+        table = self.resolve(table)
+        rec = self.registry.segments(table).get(name)
+        self.registry.remove_segment(table, name)
+        if rec is not None and rec.location.startswith(self.deep_store):
+            shutil.rmtree(rec.location, ignore_errors=True)
+
+    def rebalance(self, table: str) -> dict:
+        table = self.resolve(table)
+        cfg = self.registry.table_config(table)
+        if cfg is None:
+            raise KeyError(f"table {table!r} not found")
+        return self.assigner.rebalance(table, cfg.replication)
+
+    # ---- periodic maintenance (RetentionManager analog) ------------------
+    def run_retention(self, now_ms: Optional[int] = None) -> list:
+        """Drop segments whose time range fell out of the retention window."""
+        now_ms = now_ms or int(time.time() * 1000)
+        dropped = []
+        for table in self.registry.tables():
+            cfg = self.registry.table_config(table)
+            if cfg is None or cfg.retention_days is None:
+                continue
+            cutoff = now_ms - cfg.retention_days * 86_400_000
+            for name, rec in self.registry.segments(table).items():
+                if rec.end_time is not None and rec.end_time < cutoff:
+                    self.delete_segment(table, name)
+                    dropped.append((table, name))
+        return dropped

@@ -158,6 +158,14 @@ class QueryEngine:
         segments = tdm.acquire()
         if not segments:
             raise ValueError(f"table {q.table_name!r} has no segments")
+        merged = self.execute_segments(q, segments)
+        q = self._expand_star(q, segments[0])
+        return finalize(q, merged), merged.stats
+
+    def execute_segments(self, q: QueryContext, segments):
+        """Server-side partial execution over an explicit segment list →
+        merged (unfinalized) IntermediateResult — what a server ships to the
+        broker as a DataTable (ServerQueryExecutorV1Impl.processQuery)."""
         q = self._expand_star(q, segments[0])
 
         kept, pruned = [], 0
@@ -195,30 +203,18 @@ class QueryEngine:
         merged.stats.num_segments_pruned = pruned
         merged.stats.num_segments_queried = len(segments)
         # pruned segments still count toward totalDocs (reference semantics)
+        executed_ids = {id(s) for s in executed}
         for s in segments:
-            if s not in executed:
+            if id(s) not in executed_ids:
                 merged.stats.total_docs += s.n_docs
-        return finalize(q, merged), merged.stats
+        return merged
 
     # ---- helpers ---------------------------------------------------------
     @staticmethod
     def _expand_star(q: QueryContext, seg: ImmutableSegment) -> QueryContext:
-        import dataclasses
+        from pinot_tpu.query.rewrite import expand_star
 
-        if not any(e.is_identifier and e.name == "*" for e in q.select_expressions):
-            return q
-        cols = [Expression.identifier(c) for c in seg.column_names()]
-        new_select, new_aliases = [], []
-        for e, a in zip(q.select_expressions, q.aliases or [None] * len(q.select_expressions)):
-            if e.is_identifier and e.name == "*":
-                new_select.extend(cols)
-                new_aliases.extend([None] * len(cols))
-            else:
-                new_select.append(e)
-                new_aliases.append(a)
-        return dataclasses.replace(
-            q, select_expressions=tuple(new_select), aliases=tuple(new_aliases)
-        )
+        return expand_star(q, seg.column_names())
 
     def _explain(self, q: QueryContext) -> dict:
         from pinot_tpu.engine.explain import explain_plan

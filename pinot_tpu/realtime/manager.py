@@ -258,8 +258,15 @@ class RealtimeTableDataManager:
         self._factory = create_consumer_factory(table_config.stream)
         self._decoder = get_decoder(table_config.stream.decoder, table_config.stream)
 
-    def start(self) -> None:
-        for p in range(self._factory.partition_count()):
+    def start(self, partitions=None, on_commit=None, on_consuming=None) -> None:
+        """``partitions``: subset to consume (cluster mode: only the
+        partitions assigned to this server); callbacks let the server layer
+        publish segment state to the cluster registry."""
+        self._on_commit_cb = on_commit
+        self._on_consuming_cb = on_consuming
+        parts = list(partitions) if partitions is not None \
+            else range(self._factory.partition_count())
+        for p in parts:
             upsert = None
             if self.table_config.upsert.mode != "NONE":
                 if not self.schema.primary_key_columns:
@@ -291,11 +298,17 @@ class RealtimeTableDataManager:
     # ---- engine wiring ---------------------------------------------------
     def _on_consuming(self, partition: int, segment: MutableSegment) -> None:
         self.engine_table.add_segment(segment)
+        cb = getattr(self, "_on_consuming_cb", None)
+        if cb is not None:
+            cb(self.table_config.table_name, partition, segment)
 
     def _on_committed(self, partition: int, mutable, sealed) -> None:
         # same segment name: registering the sealed segment atomically
         # replaces the consuming one in the table's dict
         self.engine_table.add_segment(sealed)
+        cb = getattr(self, "_on_commit_cb", None)
+        if cb is not None:
+            cb(self.table_config.table_name, partition, sealed)
 
     def total_docs_indexed(self) -> int:
         return sum(m.segment.n_docs for m in self.partition_managers.values())

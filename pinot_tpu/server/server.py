@@ -1,0 +1,220 @@
+"""Server role: segment hosting + per-segment query execution.
+
+Equivalent of the reference's server stack (pinot-server/: BaseServerStarter
+wiring InstanceDataManager + QueryExecutor + transport, ServerInstance.java:
+79-128; the Helix OFFLINE→ONLINE/CONSUMING state model,
+SegmentOnlineOfflineStateModelFactory.java:75-235) — re-shaped for the
+registry's level-triggered model: a sync loop reconciles locally-loaded
+segments against the registry's assignment (download/load new, unload
+removed), replacing push-based Helix state transitions, and starts stream
+consumers for assigned realtime partitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from pinot_tpu.cluster.registry import (
+    ClusterRegistry,
+    InstanceInfo,
+    Role,
+    SegmentRecord,
+    SegmentState,
+)
+from pinot_tpu.engine.datatable import encode, encode_error
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.query.optimizer import optimize_query
+from pinot_tpu.sql.compiler import compile_query
+from pinot_tpu.storage.segment import ImmutableSegment
+from pinot_tpu.transport.grpc_transport import QueryServerTransport, parse_instance_request
+
+log = logging.getLogger("pinot_tpu.server")
+
+
+class ServerInstance:
+    def __init__(self, instance_id: str, registry: ClusterRegistry,
+                 data_dir: str, host: str = "127.0.0.1", port: int = 0,
+                 sync_interval_s: float = 0.2, device_executor="auto"):
+        self.instance_id = instance_id
+        self.registry = registry
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self.engine = QueryEngine(device_executor=device_executor)
+        self.transport = QueryServerTransport(self._handle_submit, host=host, port=port)
+        self.sync_interval_s = sync_interval_s
+        self._stop = threading.Event()
+        self._sync_thread: Optional[threading.Thread] = None
+        self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
+        self.queries_served = 0
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        self.transport.start()
+        self.registry.register_instance(
+            InstanceInfo(self.instance_id, Role.SERVER,
+                         host=self.transport.host, grpc_port=self.transport.port)
+        )
+        self._sync_once()  # load assigned segments before serving
+        self._sync_thread = threading.Thread(
+            target=self._sync_loop, name=f"sync-{self.instance_id}", daemon=True
+        )
+        self._sync_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sync_thread is not None:
+            self._sync_thread.join(5)
+        for mgr in self._realtime_managers.values():
+            mgr.stop(commit_remaining=False)
+        self.transport.stop()
+        self.registry.drop_instance(self.instance_id)
+
+    # ---- query path ------------------------------------------------------
+    def _handle_submit(self, request: bytes) -> bytes:
+        req = parse_instance_request(request)
+        try:
+            return self._handle_submit_inner(req)
+        except Exception as e:  # noqa: BLE001 — query errors ship in-band
+            return encode_error("query_error", f"{type(e).__name__}: {e}")
+
+    def _handle_submit_inner(self, req: dict) -> bytes:
+        import dataclasses
+
+        from pinot_tpu.query.context import (
+            Expression,
+            FilterNode,
+            Predicate,
+            PredicateType,
+        )
+
+        q = optimize_query(compile_query(req["sql"]))
+        if req.get("table"):
+            q = dataclasses.replace(q, table_name=req["table"])
+        tf = req.get("timeFilter")
+        if tf:  # hybrid time-boundary predicate, AND-ed into the filter
+            pred = Predicate(
+                PredicateType.RANGE, Expression.identifier(tf["column"]),
+                upper=tf["value"] if tf["op"] == "le" else None,
+                lower=tf["value"] if tf["op"] == "gt" else None,
+                lower_inclusive=False,
+            )
+            node = FilterNode.pred(pred)
+            new_filter = node if q.filter is None else FilterNode.and_(q.filter, node)
+            q = dataclasses.replace(q, filter=new_filter)
+        tdm = self.engine.tables.get(q.table_name)
+        wanted = set(req["segments"])
+        segments = [] if tdm is None else [
+            s for s in tdm.acquire() if s.name in wanted
+        ]
+        if not segments:
+            # benign routing race (segments moved since the broker's
+            # external-view read): tell the broker to skip this partial
+            return encode_error(
+                "no_segments",
+                f"server {self.instance_id} hosts none of the requested "
+                f"segments for table {q.table_name!r}",
+            )
+        # requested-but-missing segments (assignment raced ahead of loading)
+        # are simply absent from this partial, like the reference's
+        # missing-segment accounting
+        merged = self.engine.execute_segments(q, segments)
+        self.queries_served += 1
+        return encode(merged)
+
+    # ---- segment sync (state model replacement) --------------------------
+    def _sync_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._sync_once()
+                self.registry.heartbeat(self.instance_id)
+            except Exception:
+                log.exception("segment sync failed")
+            self._stop.wait(self.sync_interval_s)
+
+    def _sync_once(self) -> None:
+        assigned = self.registry.assigned_segments(self.instance_id)
+        # load newly-assigned sealed segments (OFFLINE→ONLINE)
+        for table, names in assigned.items():
+            records = self.registry.segments(table)
+            tdm = self.engine.table(table)
+            for name in names:
+                rec = records.get(name)
+                if rec is None or rec.state != SegmentState.ONLINE:
+                    continue
+                if name not in tdm.segments:
+                    try:
+                        tdm.add_segment(ImmutableSegment(rec.location))
+                    except Exception:
+                        log.exception("failed to load segment %s from %s",
+                                      name, rec.location)
+        # unload segments no longer assigned (ONLINE→OFFLINE/DROPPED);
+        # consuming (mutable) segments belong to the realtime managers
+        for table, tdm in list(self.engine.tables.items()):
+            keep = set(assigned.get(table, ()))
+            for name, seg in list(tdm.segments.items()):
+                if name not in keep and not getattr(seg, "is_mutable", False):
+                    tdm.remove_segment(name)
+        self._sync_realtime()
+        # publish what this instance can actually answer for (ExternalView)
+        serving = {
+            table: list(tdm.segments) for table, tdm in self.engine.tables.items()
+            if tdm.segments
+        }
+        self.registry.update_external_view(self.instance_id, serving)
+
+    def _sync_realtime(self) -> None:
+        """Start consumers for realtime tables with partitions assigned to
+        this instance (CONSUMING state analog)."""
+        for table in self.registry.tables():
+            if table in self._realtime_managers:
+                continue
+            pa = self.registry.partition_assignment(table)
+            mine = [int(p) for p, inst in pa.items() if inst == self.instance_id]
+            if not mine:
+                continue
+            cfg = self.registry.table_config(table)
+            schema = self.registry.table_schema(table)
+            if cfg is None or cfg.stream is None:
+                continue
+            from pinot_tpu.realtime.manager import RealtimeTableDataManager
+
+            mgr = RealtimeTableDataManager(
+                schema, cfg, self.engine.table(table),
+                os.path.join(self.data_dir, f"rt_{table}"),
+            )
+            # callbacks publish under the PHYSICAL registry key
+            # (clicks_REALTIME), not the raw table name the manager carries
+            mgr.start(
+                partitions=mine,
+                on_commit=lambda _t, p, seg, _k=table: self._publish_committed(_k, p, seg),
+                on_consuming=lambda _t, p, seg, _k=table: self._publish_consuming(_k, p, seg),
+            )
+            self._realtime_managers[table] = mgr
+
+    def _publish_consuming(self, table: str, partition: int, segment) -> None:
+        """Consuming segments are routable (brokers send them queries while
+        rows stream in — RealtimeSegmentSelector analog)."""
+        self.registry.add_segment(
+            SegmentRecord(
+                name=segment.name, table=table, n_docs=0,
+                location="", state=SegmentState.CONSUMING,
+            ),
+            [self.instance_id],
+        )
+
+    def _publish_committed(self, table: str, partition: int, sealed) -> None:
+        """Committed realtime segments become cluster-visible (the
+        Server2Controller commit → ZK metadata step)."""
+        meta = sealed.metadata
+        self.registry.add_segment(
+            SegmentRecord(
+                name=sealed.name, table=table, n_docs=sealed.n_docs,
+                location=sealed.dir, state=SegmentState.ONLINE,
+                start_time=meta.start_time, end_time=meta.end_time,
+            ),
+            [self.instance_id],
+        )
