@@ -176,14 +176,48 @@ class QueryEngine:
                 kept.append(s)
 
         results = []
+        executed = list(kept)
         if kept:
-            executed = kept
+            # per-segment fast paths first: metadata-only aggregation, then
+            # star-tree substitution (AggregationPlanNode.java:186-210).
+            # Star-tree-eligible segments are GROUPED by tree signature and
+            # executed as one batch — a single device launch over all
+            # pre-aggregated child segments.
+            from pinot_tpu.engine.startree_exec import (
+                execute_star_tree_group,
+                fitting_tree,
+                try_metadata_only,
+            )
+
+            remaining = []
+            st_groups: dict = {}
+            for s in kept:
+                r = try_metadata_only(q, s)
+                if r is not None:
+                    results.append(r)
+                    continue
+                hit = fitting_tree(q, s)
+                if hit is not None:
+                    sig, meta, st_seg = hit
+                    grp = st_groups.setdefault(sig, {"meta": meta, "sts": [], "docs": 0})
+                    grp["sts"].append(st_seg)
+                    grp["docs"] += s.n_docs
+                else:
+                    remaining.append(s)
+            for grp in st_groups.values():
+                results.append(
+                    execute_star_tree_group(self, q, grp["meta"], grp["sts"], grp["docs"])
+                )
+            scan = remaining
+        else:
+            scan = []
+        if scan:
             # consuming (mutable) and upsert-masked segments run on the host
             # scan path; sealed immutables go to the device in one batch
             from pinot_tpu.engine.device import segment_device_eligible
 
             device_ok, host_segs = [], []
-            for s in kept:
+            for s in scan:
                 (device_ok if segment_device_eligible(s) else host_segs).append(s)
             device_result = None
             if self.device is not None and device_ok:
@@ -191,11 +225,11 @@ class QueryEngine:
             if device_result is not None:
                 results.extend(device_result)
             else:
-                host_segs = kept
+                host_segs = scan
             for s in host_segs:
                 results.append(self.host.execute_segment(q, s))
-        else:
-            # all pruned: empty result over schema of first segment
+        if not results:
+            # everything pruned: empty result over schema of first segment
             executed = [segments[0]]
             results.append(self.host.execute_segment(_impossible(q), segments[0]))
 

@@ -1,0 +1,212 @@
+"""Star-tree query substitution + metadata-only aggregation fast paths.
+
+Reference: AggregationPlanNode.java:186-210 — before planning a scan, try
+(a) the metadata-only path (NonScanBasedAggregationOperator, :234-259:
+COUNT(*) from segment doc count, MIN/MAX from column metadata) and (b) the
+star-tree substitution (StarTreeUtils.isFitForStarTree → swap the plan onto
+pre-aggregated docs).
+
+Here (b) re-enters the NORMAL engine over the materialized aggregate segment
+(storage/startree.py) with a rewritten query — sum(x) → sum(sum__x),
+count(*) → sum(count__star) — then converts the resulting partials back to
+the original aggregation's canonical state layout so reduce/merge cannot
+tell the difference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from pinot_tpu.engine.result import IntermediateResult
+from pinot_tpu.query.context import Expression, QueryContext
+from pinot_tpu.storage.startree import load_star_trees, pair_column, parse_pair
+
+_REWRITABLE = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+
+
+@dataclasses.dataclass
+class StarTreePlan:
+    q2: QueryContext
+    st_segment: object
+    # per original agg: list of (q2-agg expression, role) where role names the
+    # canonical partial field the q2 partial feeds
+    mapping: list
+
+
+def _available_pairs(meta: dict) -> set:
+    return {tuple(parse_pair(p)) for p in meta["function_column_pairs"]}
+
+
+def fit(q: QueryContext, meta: dict) -> Optional[list]:
+    """StarTreeUtils.isFitForStarTree analog. Returns the per-agg rewrite
+    mapping, or None."""
+    if q.distinct or not q.aggregations():
+        return None
+    if dict(q.options).get("useStarTree") is False:
+        return None
+    dims = set(meta["dimensions_split_order"])
+    if q.filter is not None and not q.filter.columns() <= dims:
+        return None
+    for g in q.group_by:
+        if not g.is_identifier or g.name not in dims:
+            return None
+    pairs = _available_pairs(meta)
+    mapping = []
+    for a in q.aggregations():
+        name = a.name
+        if name not in _REWRITABLE:
+            return None
+        if name == "count":
+            if ("count", "*") not in pairs:
+                return None
+            mapping.append([("sum", pair_column("count", "*"), "count")])
+            continue
+        arg = a.args[0]
+        if not arg.is_identifier:
+            return None
+        col = arg.name
+        need = {
+            "sum": [("sum", col, "sum")],
+            "min": [("min", col, "min")],
+            "max": [("max", col, "max")],
+            "avg": [("sum", col, "sum"), ("count", "*", "count")],
+            "minmaxrange": [("min", col, "min"), ("max", col, "max")],
+        }[name]
+        for fn, c, _role in need:
+            if (fn, c) not in pairs:
+                return None
+        mapping.append(
+            [
+                (("sum" if fn == "count" else fn), pair_column(fn, c), role)
+                for fn, c, role in need
+            ]
+        )
+    return mapping
+
+
+def build_plan(q: QueryContext, meta: dict, st_segment) -> Optional[StarTreePlan]:
+    mapping = fit(q, meta)
+    if mapping is None:
+        return None
+    # dedup q2 aggregations, preserving order
+    q2_aggs: dict = {}
+    for entries in mapping:
+        for fn, col, _role in entries:
+            expr = Expression.function(fn, Expression.identifier(col))
+            q2_aggs.setdefault(expr)
+    q2 = dataclasses.replace(
+        q,
+        select_expressions=tuple(q2_aggs),
+        aliases=tuple([None] * len(q2_aggs)),
+        having=None,
+        order_by=(),
+    )
+    return StarTreePlan(q2=q2, st_segment=st_segment, mapping=mapping)
+
+
+def convert(result: IntermediateResult, plan: StarTreePlan, q: QueryContext,
+            parent_total_docs: int) -> IntermediateResult:
+    """q2 partials → the original aggregations' canonical partial layout."""
+    q2_aggs = list(plan.q2.aggregations())
+    index = {a: i for i, a in enumerate(q2_aggs)}
+    out_partials = []
+    for orig, entries in zip(q.aggregations(), plan.mapping):
+        partial: dict = {}
+        for fn, col, role in entries:
+            expr = Expression.function(fn, Expression.identifier(col))
+            p2 = result.agg_partials[index[expr]]
+            if role == "count":
+                partial["count"] = np.rint(p2["sum"]).astype(np.int64)
+            else:
+                partial[role] = p2[role if role in p2 else "sum"]
+        out_partials.append(partial)
+    stats = result.stats
+    stats.total_docs = parent_total_docs
+    return IntermediateResult(
+        result.shape,
+        agg_partials=out_partials,
+        group_keys=result.group_keys,
+        stats=stats,
+    )
+
+
+def _trees_for(segment) -> list:
+    if getattr(segment, "is_mutable", False):
+        return []
+    trees = getattr(segment, "_star_trees_cache", None)
+    if trees is None:
+        try:
+            trees = load_star_trees(segment)
+        except Exception:
+            trees = []
+        segment._star_trees_cache = trees
+    return trees
+
+
+def fitting_tree(q: QueryContext, segment):
+    """(meta_signature, meta, st_segment) for the first fitting star-tree."""
+    for meta, st_seg in _trees_for(segment):
+        if fit(q, meta) is not None:
+            sig = (
+                tuple(meta["dimensions_split_order"]),
+                tuple(sorted(meta["function_column_pairs"])),
+            )
+            return sig, meta, st_seg
+    return None
+
+
+def execute_star_tree_group(engine, q: QueryContext, meta: dict, st_segments: list,
+                            parent_total_docs: int) -> IntermediateResult:
+    """One batched execution over MANY segments' star-trees sharing a
+    signature — a single device launch replaces per-segment tree traversals
+    (and per-segment kernel dispatches, which dominate when the pre-agg data
+    is tiny)."""
+    plan = build_plan(q, meta, st_segments[0])
+    r2 = engine.execute_segments(plan.q2, st_segments)
+    return convert(r2, plan, q, parent_total_docs)
+
+
+# ---------------------------------------------------------------------------
+# metadata-only aggregation (NonScanBasedAggregationOperator analog)
+# ---------------------------------------------------------------------------
+
+
+def try_metadata_only(q: QueryContext, segment) -> Optional[IntermediateResult]:
+    """COUNT(*)/MIN/MAX with no filter and no group-by answer straight from
+    segment metadata — zero scan (AggregationPlanNode.java:234-259)."""
+    from pinot_tpu.engine.result import ExecutionStats
+
+    if q.filter is not None or q.group_by or q.distinct:
+        return None
+    aggs = q.aggregations()
+    if not aggs:
+        return None
+    if getattr(segment, "is_mutable", False) or \
+            getattr(segment, "valid_docs_mask", None) is not None:
+        return None
+    partials = []
+    for a in aggs:
+        if a.name == "count":
+            partials.append({"count": np.array([segment.n_docs], dtype=np.int64)})
+            continue
+        if a.name not in ("min", "max") or not a.args or not a.args[0].is_identifier:
+            return None
+        col = a.args[0].name
+        if col not in segment.metadata.columns:
+            return None
+        meta = segment.column_metadata(col)
+        v = meta.min_value if a.name == "min" else meta.max_value
+        if v is None or isinstance(v, str) or segment.n_docs == 0:
+            return None
+        partials.append({a.name: np.array([float(v)])})
+    stats = ExecutionStats(
+        num_docs_scanned=segment.n_docs,  # reference counts docs "matched"
+        num_segments_processed=1,
+        num_segments_queried=1,
+        num_segments_matched=1 if segment.n_docs else 0,
+        total_docs=segment.n_docs,
+    )
+    return IntermediateResult("aggregation", agg_partials=partials, stats=stats)

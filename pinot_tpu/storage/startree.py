@@ -1,18 +1,135 @@
-"""Star-tree pre-aggregation index (placeholder until the index milestone).
+"""Star-tree pre-aggregation index, re-designed TPU-first.
 
-Target design (reference: pinot-segment-local/.../startree/v2/builder/
-BaseSingleTreeBuilder.java + StarTreeV2): sort docs by the dimension split
-order, build a tree whose nodes pre-aggregate doc ranges, materialize
-star-nodes for "dimension unconstrained" traversal, and store the
-pre-aggregated docs as a child segment under ``<segment>/startree/`` so the
-normal device pipeline can scan it.
+Reference (pinot-segment-local/.../startree/v2/builder/BaseSingleTreeBuilder,
+pinot-segment-spi/.../index/startree/StarTreeV2.java): sort by a dimension
+split order, build an on-disk tree whose star-nodes pre-aggregate doc ranges;
+queries traverse the tree level by level (StarTreeFilterOperator.java:53-87).
+
+Pointer-chasing tree traversal is the wrong shape for a TPU. The equivalent
+capability here is a **materialized aggregate segment**: docs grouped by the
+full split-order dimension set, with one pre-aggregated metric column per
+function-column pair (``sum__revenue``, ``count__star``, ...), stored as a
+normal child segment under ``<segment>/startree/st<i>/``. A fitting query
+(engine/startree_exec.py — StarTreeUtils.isFitForStarTree analog) executes
+against this segment through the SAME device pipeline, re-aggregating the
+pre-aggregated rows: filters/group-bys on split dimensions remain exact
+because every split dimension is carried through, and the dense global-id
+re-aggregation that replaces tree traversal is exactly what the hardware is
+good at. Work drops from O(rows) to O(distinct dimension combinations) — the
+same asymptotic win the reference's tree gives, without star-node plumbing.
+
+max_leaf_records guards materialization bloat: if the cube has more groups
+than rows/2 the index is skipped (pre-aggregation would not pay).
 """
 
 from __future__ import annotations
 
+import json
+import os
+
+import numpy as np
+
+STARTREE_DIR = "startree"
+META_FILE = "startree_meta.json"
+
+# function-column pair name separator (reference: AggregationFunctionColumnPair)
+SEP = "__"
+
+SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max"}
+
+
+def parse_pair(pair: str):
+    """'SUM__revenue' → ('sum', 'revenue'); 'COUNT__*' → ('count', '*')."""
+    fn, col = pair.split(SEP, 1)
+    return fn.lower(), col
+
+
+def pair_column(fn: str, col: str) -> str:
+    return f"{fn.lower()}{SEP}{'star' if col == '*' else col}"
+
 
 def build_star_trees(segment, star_tree_configs) -> None:
-    raise NotImplementedError(
-        "star-tree index build is not implemented yet; remove star_tree_configs "
-        "from IndexingConfig or wait for the star-tree milestone"
-    )
+    """Build all configured star-tree aggregate segments for a sealed
+    segment (SegmentIndexCreationDriverImpl.java:290,316 build step)."""
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.engine.host import factorize_multi
+    from pinot_tpu.storage.creator import build_segment
+
+    for i, cfg in enumerate(star_tree_configs):
+        dims = list(cfg.dimensions_split_order)
+        pairs = [parse_pair(p) for p in cfg.function_column_pairs]
+        for fn, col in pairs:
+            if fn not in SUPPORTED_FUNCTIONS:
+                raise ValueError(f"star-tree function {fn} unsupported")
+
+        dim_values = [np.asarray(segment.values(d)) for d in dims]
+        keys, ginv = factorize_multi(dim_values)
+        n_groups = len(keys[0])
+        if n_groups > max(1, segment.n_docs // 2):
+            continue  # cube nearly as big as the data: not worth it
+
+        out_cols: dict = {d: k for d, k in zip(dims, keys)}
+        dim_specs = []
+        metric_specs = []
+        for d in dims:
+            meta = segment.column_metadata(d)
+            dim_specs.append((d, meta.data_type))
+        for fn, col in pairs:
+            name = pair_column(fn, col)
+            if fn == "count":
+                acc = np.zeros(n_groups, dtype=np.int64)
+                np.add.at(acc, ginv, 1)
+                metric_specs.append((name, DataType.LONG))
+            else:
+                v = np.asarray(segment.values(col), dtype=np.float64)
+                if fn == "sum":
+                    acc = np.zeros(n_groups)
+                    np.add.at(acc, ginv, v)
+                elif fn == "min":
+                    acc = np.full(n_groups, np.inf)
+                    np.minimum.at(acc, ginv, v)
+                else:
+                    acc = np.full(n_groups, -np.inf)
+                    np.maximum.at(acc, ginv, v)
+                metric_specs.append((name, DataType.DOUBLE))
+            out_cols[name] = acc
+
+        st_schema = Schema.build(
+            name=f"{segment.name}_st{i}",
+            dimensions=dim_specs,
+            metrics=metric_specs,
+        )
+        out_dir = os.path.join(segment.dir, STARTREE_DIR, f"st{i}")
+        build_segment(
+            st_schema, out_cols, out_dir,
+            TableConfig(table_name=st_schema.name), f"{segment.name}_st{i}",
+        )
+        with open(os.path.join(out_dir, META_FILE), "w") as f:
+            json.dump(
+                {
+                    "dimensions_split_order": dims,
+                    "function_column_pairs": list(cfg.function_column_pairs),
+                    "max_leaf_records": cfg.max_leaf_records,
+                },
+                f,
+            )
+
+
+def load_star_trees(segment) -> list:
+    """[(metadata dict, ImmutableSegment)] for a sealed segment."""
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    root = os.path.join(segment.dir, STARTREE_DIR)
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in sorted(os.listdir(root)):
+        d = os.path.join(root, name)
+        meta_path = os.path.join(d, META_FILE)
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            out.append((meta, ImmutableSegment(d)))
+    return out

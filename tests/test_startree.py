@@ -1,0 +1,141 @@
+"""Star-tree index tests: build, fit check, substitution correctness.
+
+Reference analogs: StarTreeV2 builder tests + StarTreeClusterIntegrationTest
+(star-tree answers must equal non-star-tree answers) + the metadata-only
+NonScanBasedAggregationOperator path.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, StarTreeIndexConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+from pinot_tpu.storage.startree import load_star_trees
+
+
+@pytest.fixture(scope="module")
+def engines(tmp_path_factory):
+    rng = np.random.default_rng(31)
+    n = 20_000
+    cols = {
+        "d_year": rng.integers(1992, 1999, n).astype(np.int32),
+        "d_region": np.array(["AMERICA", "ASIA", "EUROPE", "AFRICA"])[rng.integers(0, 4, n)],
+        "d_category": np.array([f"cat{i}" for i in range(12)])[rng.integers(0, 12, n)],
+        "revenue": rng.integers(100, 100_000, n).astype(np.int64),
+        "quantity": rng.integers(1, 50, n).astype(np.int32),
+    }
+    schema = Schema.build(
+        name="ssb",
+        dimensions=[
+            ("d_year", DataType.INT),
+            ("d_region", DataType.STRING),
+            ("d_category", DataType.STRING),
+        ],
+        metrics=[("revenue", DataType.LONG), ("quantity", DataType.INT)],
+    )
+    st_cfg = StarTreeIndexConfig(
+        dimensions_split_order=["d_year", "d_region", "d_category"],
+        function_column_pairs=[
+            "SUM__revenue", "COUNT__*", "MIN__revenue", "MAX__revenue",
+            "SUM__quantity",
+        ],
+    )
+    cfg = TableConfig(
+        table_name="ssb",
+        indexing=IndexingConfig(star_tree_configs=[st_cfg]),
+    )
+    plain_cfg = TableConfig(table_name="ssb")
+    base = tmp_path_factory.mktemp("stseg")
+    st_engine = QueryEngine()
+    plain_engine = QueryEngine()
+    half = n // 2
+    for i, sl in enumerate([slice(0, half), slice(half, n)]):
+        part = {k: v[sl] for k, v in cols.items()}
+        build_segment(schema, part, str(base / f"st{i}"), cfg, f"s{i}")
+        build_segment(schema, part, str(base / f"plain{i}"), plain_cfg, f"s{i}")
+        st_engine.add_segment("ssb", ImmutableSegment(str(base / f"st{i}")))
+        plain_engine.add_segment("ssb", ImmutableSegment(str(base / f"plain{i}")))
+    return st_engine, plain_engine, cols
+
+
+def test_star_tree_built(engines, tmp_path_factory):
+    st_engine, _, _ = engines
+    seg = next(iter(st_engine.tables["ssb"].segments.values()))
+    trees = load_star_trees(seg)
+    assert len(trees) == 1
+    meta, st_seg = trees[0]
+    assert meta["dimensions_split_order"] == ["d_year", "d_region", "d_category"]
+    assert st_seg.n_docs < seg.n_docs  # actually pre-aggregated
+    assert "sum__revenue" in st_seg.column_names()
+
+
+ST_QUERIES = [
+    "SELECT SUM(revenue) FROM ssb",
+    "SELECT SUM(revenue), COUNT(*) FROM ssb WHERE d_region = 'ASIA'",
+    "SELECT d_year, SUM(revenue) FROM ssb GROUP BY d_year ORDER BY d_year",
+    "SELECT d_region, d_year, SUM(revenue), COUNT(*) FROM ssb "
+    "WHERE d_category IN ('cat1','cat5') GROUP BY d_region, d_year "
+    "ORDER BY d_region, d_year LIMIT 50",
+    "SELECT MIN(revenue), MAX(revenue) FROM ssb WHERE d_year BETWEEN 1994 AND 1996",
+    "SELECT d_region, AVG(revenue) FROM ssb GROUP BY d_region ORDER BY d_region",
+    "SELECT d_year, MINMAXRANGE(revenue) FROM ssb GROUP BY d_year ORDER BY d_year",
+    "SELECT SUM(quantity) FROM ssb WHERE d_region != 'AFRICA'",
+]
+
+
+@pytest.mark.parametrize("sql", ST_QUERIES)
+def test_star_tree_matches_scan(engines, sql):
+    """StarTreeClusterIntegrationTest semantics: identical answers with and
+    without the index."""
+    st_engine, plain_engine, _ = engines
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert not a.get("exceptions"), a
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"], (
+        a["resultTable"]["rows"][:4],
+        b["resultTable"]["rows"][:4],
+    )
+
+
+def test_star_tree_actually_used(engines):
+    st_engine, plain_engine, _ = engines
+    a = st_engine.execute("SELECT d_year, SUM(revenue) FROM ssb GROUP BY d_year")
+    b = plain_engine.execute("SELECT d_year, SUM(revenue) FROM ssb GROUP BY d_year")
+    # pre-aggregated docs scanned << raw docs scanned
+    assert a["numDocsScanned"] < b["numDocsScanned"] / 3, (
+        a["numDocsScanned"], b["numDocsScanned"],
+    )
+
+
+def test_unfit_queries_fall_through(engines):
+    st_engine, plain_engine, _ = engines
+    # filter on a metric column: not covered by split dims → scan path
+    sql = "SELECT SUM(revenue) FROM ssb WHERE quantity > 25"
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
+    assert a["numDocsScanned"] == b["numDocsScanned"]  # full scan both
+
+    # opt-out via query option (reference: useStarTree=false)
+    opt = st_engine.execute(
+        "SET useStarTree = false; SELECT SUM(revenue) FROM ssb WHERE d_region = 'ASIA'"
+    )
+    assert opt["resultTable"]["rows"] == plain_engine.execute(
+        "SELECT SUM(revenue) FROM ssb WHERE d_region = 'ASIA'"
+    )["resultTable"]["rows"]
+
+
+def test_metadata_only_path(engines):
+    st_engine, _, cols = engines
+    r = st_engine.execute("SELECT COUNT(*), MIN(revenue), MAX(revenue) FROM ssb")
+    assert r["resultTable"]["rows"][0] == [
+        len(cols["revenue"]),
+        float(cols["revenue"].min()),
+        float(cols["revenue"].max()),
+    ]
+    # zero entries scanned: straight off metadata
+    assert r["numEntriesScannedPostFilter"] == 0
