@@ -181,7 +181,22 @@ class Broker:
                     if r.end_time is not None
                 ]
                 if ends:
-                    boundary = (cfg.time_column, max(ends))
+                    # TimeBoundaryManager semantics: back off one time unit
+                    # from the max offline end time — realtime rows with
+                    # ts <= maxEnd not yet pushed offline would otherwise be
+                    # invisible to both sides (offline lacks them, gt filter
+                    # excludes them).
+                    bval = max(ends)
+                    if isinstance(bval, int):
+                        bval -= 1
+                    else:
+                        # float time columns: back off one ULP so ts == maxEnd
+                        # rows route to realtime (same semantics as minus one
+                        # unit at float resolution)
+                        import math
+
+                        bval = math.nextafter(float(bval), -math.inf)
+                    boundary = (cfg.time_column, bval)
         if off in tables:
             tf = None if boundary is None else                 {"column": boundary[0], "op": "le", "value": boundary[1]}
             out.append((off, tf))

@@ -79,6 +79,15 @@ class TableConfig:
     upsert: UpsertConfig = dataclasses.field(default_factory=UpsertConfig)
     stream: Optional[StreamConfig] = None
 
+    def __post_init__(self):
+        # TableConfigUtils analog: star-trees pre-aggregate over all rows at
+        # seal time, which an upsert validDocIds mask would silently falsify.
+        if self.upsert.mode != "NONE" and self.indexing.star_tree_configs:
+            raise ValueError(
+                "star_tree_configs are not supported on upsert tables "
+                "(pre-aggregated partials ignore validDocIds)"
+            )
+
     @property
     def table_name_with_type(self) -> str:
         return f"{self.table_name}_{self.table_type}"

@@ -287,6 +287,11 @@ class ModeSpec(AggSpec):
 
     def host_groups(self, arg_values, group_idx, n):
         v = np.asarray(arg_values[0])
+        if v.dtype.kind not in "iuf":
+            raise ValueError(
+                "MODE requires a numeric column (reference ModeAggregationFunction "
+                "supports INT/LONG/FLOAT/DOUBLE only)"
+            )
         counters = _obj_array(n, dict)
         for g, val in zip(group_idx, v.tolist()):
             d = counters[g]
@@ -306,9 +311,10 @@ class ModeSpec(AggSpec):
         out = np.full(len(part["counts"]), np.nan)
         for i, d in enumerate(part["counts"]):
             if d:
-                # max count; ties broken by smallest value (reference default)
-                best = max(d.items(), key=lambda kv: (kv[1], -float(kv[0])))
-                out[i] = float(best[0])
+                # max count; ties broken by smallest value (reference default),
+                # without float-coercing keys in the sort key
+                best_count = max(d.values())
+                out[i] = min(k for k, c in d.items() if c == best_count)
         return out
 
 

@@ -136,6 +136,11 @@ def convert(result: IntermediateResult, plan: StarTreePlan, q: QueryContext,
 def _trees_for(segment) -> list:
     if getattr(segment, "is_mutable", False):
         return []
+    # Upsert guard: the star-tree was pre-aggregated over ALL rows at seal
+    # time; a validDocIds mask invalidates those partials (the reference
+    # forbids star-tree on upsert tables — TableConfigUtils validation).
+    if getattr(segment, "valid_docs_mask", None) is not None:
+        return []
     trees = getattr(segment, "_star_trees_cache", None)
     if trees is None:
         try:

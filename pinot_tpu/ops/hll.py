@@ -57,13 +57,60 @@ def hll_registers(keys, mask, log2m: int = DEFAULT_LOG2M):
     return hll_registers_prehashed(hash32(keys), mask, log2m)
 
 
+def murmur3_32(data: bytes, seed: int = 0) -> int:
+    """Canonical murmur3_32 over bytes — deterministic across processes and
+    restarts, unlike builtin ``hash()`` (PYTHONHASHSEED-salted), so HLL
+    register partials for string columns built on different servers merge to
+    the union, not the sum. Matches the reference's murmur-based hashing of
+    raw values (clearspring HyperLogLog via DistinctCountHLLAggregationFunction)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data) & ~3
+    for i in range(0, n, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[n:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
 def hash32_np(values: np.ndarray) -> np.ndarray:
     """Host-side canonical hash, bit-identical to :func:`hash32` so host and
     device HLL partials merge consistently. 64-bit inputs fold hi^lo;
-    strings hash via python hash (stable within a process)."""
+    strings/bytes hash via deterministic murmur3_32 over UTF-8 bytes
+    (hashed once per unique value, mapped back through the inverse index)."""
     v = np.asarray(values)
     if v.dtype.kind in ("U", "S", "O"):
-        h = np.array([hash(x) & 0xFFFFFFFF for x in v.tolist()], dtype=np.uint32)
+        uniq, inv = np.unique(v, return_inverse=True)
+        uh = np.array(
+            [
+                murmur3_32(x.encode("utf-8") if isinstance(x, str) else bytes(x))
+                for x in uniq.tolist()
+            ],
+            dtype=np.uint32,
+        )
+        h = uh[inv.reshape(v.shape)]
     elif v.dtype.itemsize == 8:
         bits = v.view(np.uint64)
         h = ((bits >> np.uint64(32)) ^ (bits & np.uint64(0xFFFFFFFF))).astype(np.uint32)

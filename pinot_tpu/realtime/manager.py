@@ -222,18 +222,26 @@ class RealtimePartitionManager:
         )
 
     def _commit(self) -> None:
-        """Seal → swap → checkpoint (the single-process commit protocol)."""
+        """Seal → checkpoint → publish (the single-process commit protocol).
+
+        Checkpoint BEFORE publishing: a crash between the two must not leave
+        a live registered segment whose offset range the restarted consumer
+        re-consumes into a duplicate segment (double counting). The sealed
+        dir + checkpoint entry are the durable commit — the reference makes
+        segment metadata + offset one atomic ZK write; here restart
+        reconciliation (RealtimeTableDataManager.start) republishes a
+        committed-but-unpublished segment."""
         mutable = self.segment
         mutable.end_offset = self._offset.to_string()
         out = os.path.join(self.segment_dir, mutable.segment_name)
         sealed = mutable.seal(out)
-        if self.upsert is not None:
-            self.upsert.replace_segment(mutable, sealed)
-        self.on_committed_segment(self.partition, mutable, sealed)
         self.checkpoint.record_commit(
             self.table, self.partition, mutable.segment_name,
             self._offset.to_string(), self._sequence,
         )
+        if self.upsert is not None:
+            self.upsert.replace_segment(mutable, sealed)
+        self.on_committed_segment(self.partition, mutable, sealed)
         self._sequence += 1
         self.commits += 1
 
@@ -275,6 +283,7 @@ class RealtimeTableDataManager:
                     self.table_config.upsert.comparison_column
                 )
                 self.upsert_managers[p] = upsert
+            self._reconcile_committed(p, upsert)
             mgr = RealtimePartitionManager(
                 table=self.table_config.table_name,
                 schema=self.schema,
@@ -295,6 +304,43 @@ class RealtimeTableDataManager:
         for mgr in self.partition_managers.values():
             mgr.stop(commit_remaining=commit_remaining)
 
+    def _reconcile_committed(self, partition: int, upsert=None) -> None:
+        """Close the crash window between checkpoint and publication: if the
+        checkpoint names a sealed segment that exists on disk but was never
+        registered (crash after record_commit, before on_committed), load and
+        publish it now. Only the LAST committed segment per partition can be
+        in this state; earlier ones were published or are reloaded from the
+        cluster registry by the server layer.
+
+        For upsert tables the sealed dir holds ALL rows with no persisted
+        validDocIds — replay its primary keys through the fresh upsert
+        manager so stale duplicates are re-invalidated and later stream
+        updates can keep invalidating them."""
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        prior = self.checkpoint.committed(self.table_config.table_name, partition)
+        if prior is None:
+            return
+        name = prior["segment"]
+        seg_dir = os.path.join(self.data_dir, name)
+        if not os.path.isdir(seg_dir):
+            return
+        # The server layer may have loaded the segment from the registry
+        # already; the upsert replay must then target THAT instance (the
+        # valid_docs_mask attaches to the object the engine queries).
+        existing = getattr(self.engine_table, "segments", {}).get(name)
+        sealed = existing if existing is not None else ImmutableSegment(seg_dir)
+        if upsert is not None:
+            pk_cols = [sealed.values(c) for c in self.schema.primary_key_columns]
+            keys = list(zip(*pk_cols))
+            if upsert.comparison_column is not None:
+                cmps = sealed.values(upsert.comparison_column)
+            else:
+                cmps = range(sealed.n_docs)  # doc order == offset order
+            upsert.add_segment(sealed, keys, cmps)
+        if existing is None:
+            self._publish_committed(partition, sealed)
+
     # ---- engine wiring ---------------------------------------------------
     def _on_consuming(self, partition: int, segment: MutableSegment) -> None:
         self.engine_table.add_segment(segment)
@@ -303,6 +349,9 @@ class RealtimeTableDataManager:
             cb(self.table_config.table_name, partition, segment)
 
     def _on_committed(self, partition: int, mutable, sealed) -> None:
+        self._publish_committed(partition, sealed)
+
+    def _publish_committed(self, partition: int, sealed) -> None:
         # same segment name: registering the sealed segment atomically
         # replaces the consuming one in the table's dict
         self.engine_table.add_segment(sealed)

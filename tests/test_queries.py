@@ -373,3 +373,46 @@ class TestMisc:
         engine, _ = setup
         resp = engine.execute("SELECT COUNT(*) FROM nope")
         assert resp["exceptions"]
+
+
+class TestHashing:
+    def test_murmur3_32_known_vectors(self):
+        """Deterministic murmur3_32 (ADVICE r1: builtin hash() is
+        PYTHONHASHSEED-salted, breaking cross-process HLL merges)."""
+        from pinot_tpu.ops.hll import murmur3_32
+
+        assert murmur3_32(b"") == 0
+        assert murmur3_32(b"hello") == 0x248BFA47
+        assert murmur3_32(b"The quick brown fox jumps over the lazy dog") == 0x2E4FF723
+
+    def test_string_hash_deterministic_across_calls(self):
+        from pinot_tpu.ops.hll import hash32_np
+        import numpy as np
+
+        v = np.array(["alpha", "beta", "gamma", "alpha"])
+        h1, h2 = hash32_np(v), hash32_np(v)
+        assert np.array_equal(h1, h2)
+        assert h1[0] == h1[3] and len({int(x) for x in h1[:3]}) == 3
+
+    def test_star_tree_rejected_on_upsert_table(self):
+        from pinot_tpu.common.table_config import (
+            IndexingConfig,
+            StarTreeIndexConfig,
+            TableConfig,
+            UpsertConfig,
+        )
+        import pytest
+
+        with pytest.raises(ValueError, match="star_tree"):
+            TableConfig(
+                table_name="t",
+                upsert=UpsertConfig(mode="FULL", comparison_column="ts"),
+                indexing=IndexingConfig(
+                    star_tree_configs=[
+                        StarTreeIndexConfig(
+                            dimensions_split_order=["a"],
+                            function_column_pairs=["COUNT__*"],
+                        )
+                    ]
+                ),
+            )

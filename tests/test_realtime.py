@@ -134,16 +134,20 @@ class TestRealtimeConsumption:
         mgr2 = RealtimeTableDataManager(
             make_schema(), cfg, eng2.table("events"), str(tmp_path / "rt")
         )
-        # committed segments are reloaded from disk by the server layer in a
-        # real deployment; here we verify the consume loop resumes at the
-        # checkpointed offset (no re-consumption of committed rows)
+        # earlier committed segments are reloaded from the registry by the
+        # server layer in a real deployment, but the manager itself reconciles
+        # the LAST checkpointed segment (crash-window repair between
+        # record_commit and publication); the consume loop then resumes at
+        # the checkpointed offset (no re-consumption of committed rows)
         mgr2.start()
         try:
+            reconciled = _count(eng2)  # docs of the last committed segment
+            assert 0 < reconciled <= 250, reconciled
             for i in range(50):
                 topic.publish_json({"user": "u2", "action": "b", "amount": 1, "ts": 250 + i})
-            assert wait_until(lambda: _count(eng2) == 50), _count(eng2)
+            assert wait_until(lambda: _count(eng2) == reconciled + 50), _count(eng2)
             r = eng2.execute("SELECT COUNT(*) FROM events WHERE user = 'u2'")
-            assert r["resultTable"]["rows"][0][0] == 50
+            assert r["resultTable"]["rows"][0][0] == 50  # u1 rows never duplicated
         finally:
             mgr2.stop(commit_remaining=False)
 
@@ -216,6 +220,38 @@ class TestUpsert:
             assert r["resultTable"]["rows"][0][0] == 5  # older comparison loses
         finally:
             mgr.stop(commit_remaining=False)
+
+    def test_upsert_restart_reconcile_dedupes(self, tmp_path):
+        """Crash-window reconcile on an upsert table: the republished sealed
+        segment must replay its keys through the fresh upsert manager so
+        stale duplicates stay invalid and remain overridable."""
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert_rc", n_partitions=1,
+                                               flush_rows=10_000, upsert=True)
+        mgr.start()
+        topic.publish_json({"user": "a", "action": "1", "amount": 1, "ts": 1})
+        topic.publish_json({"user": "a", "action": "2", "amount": 50, "ts": 2})
+        topic.publish_json({"user": "b", "action": "1", "amount": 7, "ts": 1})
+        assert wait_until(lambda: _total_indexed(mgr) == 3)
+        mgr.stop(commit_remaining=True)  # seals the 3-row segment + checkpoint
+
+        # "restart": fresh engine + manager over the same dir; reconcile
+        # republishes the sealed segment (no persisted validDocIds)
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(pk=True), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            assert _count(eng2) == 2  # a deduped (ts=2 wins), b
+            assert _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 50
+            # the reconciled rows must still be overridable by new stream rows
+            topic.publish_json({"user": "a", "action": "3", "amount": 900, "ts": 3})
+            assert wait_until(
+                lambda: _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 900
+            )
+            assert _count(eng2) == 2
+        finally:
+            mgr2.stop(commit_remaining=False)
 
     def test_upsert_survives_commit(self, tmp_path):
         topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert3", n_partitions=1,
