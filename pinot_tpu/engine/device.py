@@ -71,9 +71,9 @@ def _eval_expr(tpl, cols, params):
     if kind == "raw":
         return cols[tpl[1]]
     if kind == "dictval":
-        lut = params[f"vlut_{tpl[1]}"]  # (C,) global-id value table
-        ids = jnp.clip(cols[tpl[1]], 0, lut.shape[0] - 1)
-        return lut[ids]
+        # decoded on the host at upload (BatchContext.decoded_column) — a
+        # device (C,)-LUT gather here costs ~80ms/query at 12M docs on v5e
+        return cols["dv::" + tpl[1]]
     if kind == "cast":
         return get_function("cast").jnp_fn(_eval_expr(tpl[1], cols, params), tpl[2])
     fn = get_function(kind)
@@ -129,9 +129,120 @@ def _rows_per_block(values, int_rpb):
     return 2048
 
 
-def build_pipeline(template):
-    """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict."""
+def _legacy_rpb(extra):
+    """Agg-template ``extra`` is (nplanes, rpb) since the matmul kernel;
+    accept the bare legacy rpb int/None (older templates, __graft_entry__)."""
+    return extra[1] if isinstance(extra, tuple) else extra
+
+
+def _hll_regs(slot, rho, num_groups, log2m, mm_mode):
+    """(num_groups, m) HLL registers: matmul threshold-channel build when
+    VMEM allows, else the scatter-max (both exact max-of-rho)."""
+    from pinot_tpu.ops import groupby_mm as mm
+
+    m = 1 << log2m
+    n_total = 1
+    for d in slot.shape:
+        n_total *= d
+    use_mm = (
+        mm_mode != "off"
+        and mm.hll_supported(num_groups, log2m)
+        and (mm_mode == "interpret" or n_total >= mm.MM_MIN_ROWS)
+    )
+    if use_mm:
+        return mm.hll_registers(
+            slot.reshape(-1), rho.reshape(-1), num_groups, log2m,
+            interpret=(mm_mode == "interpret"),
+        )
+    regs = jnp.zeros(num_groups * m + 1, dtype=jnp.int32)
+    regs = regs.at[slot.reshape(-1)].max(rho.reshape(-1))
+    return regs[: num_groups * m].reshape(num_groups, m)
+
+
+def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs):
+    """Route COUNT/SUM/AVG through ONE factored one-hot matmul launch
+    (ops/groupby_mm.py) when eligible. Fills outs["gcount"] +
+    outs[f"a{i}_sum"] and returns the set of agg indexes handled; scatter
+    code covers the rest. All decisions are trace-time static."""
+    from pinot_tpu.ops import groupby_mm as mm
+
+    if mm_mode == "off":
+        return set()
+    n_total = 1
+    for d in gid.shape:
+        n_total *= d
+    if mm_mode == "tpu" and n_total < mm.MM_MIN_ROWS:
+        return set()
+
+    # plan: which aggs become channels, and how many
+    plans = []  # (i, kind, nplanes, values)
+    total_ch = 1  # ones channel
+    for i, (name, argt, extra) in enumerate(aggs):
+        if name not in ("sum", "avg") or not isinstance(extra, tuple):
+            continue
+        nplanes_int = extra[0]
+        v = _eval_expr(argt, cols, params)
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            if nplanes_int is None:  # unknown range → exact scatter instead
+                continue
+            kind, nplanes = "int", nplanes_int
+        else:
+            kind, nplanes = "float", 3
+        if total_ch + nplanes > mm.MAX_CHANNELS + 1:
+            continue
+        plans.append((i, kind, nplanes, v))
+        total_ch += nplanes
+    if not mm.mm_supported(num_groups, total_ch - 1):
+        return set()
+    has_count_or_avg = any(a[0] in ("count", "avg") for a in aggs)
+    if not plans and not has_count_or_avg:
+        return set()
+
+    channels = [jnp.ones(n_total, dtype=jnp.bfloat16)]
+    specs = []  # (i, kind, slice into channel rows, offset param key)
+    row = 1
+    for i, kind, nplanes, v in plans:
+        flat = v.reshape(-1)
+        if kind == "int":
+            off = params[f"off{i}"]
+            channels.extend(mm.int_planes(flat, off, nplanes))
+        else:
+            channels.extend(mm.float_planes(flat))
+        specs.append((i, kind, slice(row, row + nplanes)))
+        row += nplanes
+
+    sums = mm.group_sums(
+        gid.reshape(-1), jnp.stack(channels), num_groups,
+        interpret=(mm_mode == "interpret"),
+    )
+    gcount = jnp.round(sums[0]).astype(jnp.int64)
+    outs["gcount"] = gcount
+    done = set()
+    for i, kind, sl in specs:
+        planes = [sums[j] for j in range(sl.start, sl.stop)]
+        if kind == "int":
+            outs[f"a{i}_sum"] = mm.recombine_int(planes, gcount, params[f"off{i}"])
+        else:
+            outs[f"a{i}_sum"] = mm.recombine_float(planes)
+        done.add(i)
+    return done
+
+
+def _resolve_mm_mode(mm_mode: str) -> str:
+    if mm_mode == "auto":
+        return "tpu" if jax.default_backend() == "tpu" else "off"
+    return mm_mode
+
+
+def build_pipeline(template, mm_mode: str = "auto"):
+    """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
+
+    ``mm_mode``: "auto" → the factored one-hot matmul kernel
+    (ops/groupby_mm.py) on TPU, scatter elsewhere; "interpret" forces the
+    kernel in Pallas interpret mode (CPU tests); "off" forces scatter.
+    """
     shape, filter_tpl, group_cols, group_cards, aggs = template
+    mm_mode = _resolve_mm_mode(mm_mode)
     num_groups = 1
     for c in group_cards:
         num_groups *= c
@@ -148,14 +259,18 @@ def build_pipeline(template):
             # columns are already global ids: the group key IS the column
             per_col = [cols[c] for c in group_cols]
             gid = agg_ops.group_ids_combine(per_col, group_cards, mask, num_groups)
-            outs["gcount"] = agg_ops.group_count(gid, num_groups)
+            mm_done = _try_mm_groupby(
+                aggs, gid, cols, params, num_groups, mm_mode, outs
+            )
+            if "gcount" not in outs:
+                outs["gcount"] = agg_ops.group_count(gid, num_groups)
             for i, (name, argt, extra) in enumerate(aggs):
                 k = f"a{i}"
-                if name == "count":
-                    pass  # gcount reused
+                if i in mm_done or name == "count":
+                    pass  # produced by the matmul kernel / gcount reused
                 elif name in ("sum", "avg"):
                     v = _eval_expr(argt, cols, params)
-                    rpb = _rows_per_block(v, extra)
+                    rpb = _rows_per_block(v, _legacy_rpb(extra))
                     outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups, rpb)
                 elif name == "min":
                     v = _eval_expr(argt, cols, params)
@@ -177,14 +292,13 @@ def build_pipeline(template):
                 elif name == "distinctcounthll":
                     log2m = extra
                     m = 1 << log2m
-                    hlut = params[f"hlut_{argt}"]  # (C,) per-global-id hashes
-                    ids = jnp.clip(cols[argt], 0, hlut.shape[0] - 1)
-                    h = hlut[ids]
+                    # per-doc value hashes, gathered host-side at upload
+                    h = cols["hh::" + argt]
                     idx, rho = hll_ops.hll_idx_rho(h, log2m)
                     slot = jnp.where(mask, gid * m + idx, num_groups * m)
-                    regs = jnp.zeros(num_groups * m + 1, dtype=jnp.int32)
-                    regs = regs.at[slot.reshape(-1)].max(rho.reshape(-1))
-                    outs[f"{k}_regs"] = regs[: num_groups * m].reshape(num_groups, m)
+                    outs[f"{k}_regs"] = _hll_regs(
+                        slot, rho, num_groups, log2m, mm_mode
+                    )
             return outs
 
         # scalar aggregation shape
@@ -210,10 +324,11 @@ def build_pipeline(template):
                 outs[f"{k}_pres"] = agg_ops.distinct_presence(slot, card)
             elif name == "distinctcounthll":
                 log2m = extra
-                hlut = params[f"hlut_{argt}"]
-                ids = jnp.clip(cols[argt], 0, hlut.shape[0] - 1)
-                h = hlut[ids]
-                outs[f"{k}_regs"] = hll_ops.hll_registers_prehashed(h, mask, log2m)
+                m = 1 << log2m
+                h = cols["hh::" + argt]
+                idx, rho = hll_ops.hll_idx_rho(h, log2m)
+                slot = jnp.where(mask, idx, m)
+                outs[f"{k}_regs"] = _hll_regs(slot, rho, 1, log2m, mm_mode)[0]
         return outs
 
     return pipeline  # caller jits (single-device) or shard_maps (mesh)
@@ -227,13 +342,14 @@ def build_pipeline(template):
 class DeviceExecutor:
     MAX_CACHED_BATCHES = 4  # LRU cap: a batch holds full columns in HBM
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, mm_mode: str = "auto"):
         """``mesh``: optional jax Mesh — shard the segment axis over it with
         psum-combined accumulators (parallel/mesh.py) instead of a
-        single-device batched launch."""
+        single-device batched launch. ``mm_mode``: see build_pipeline."""
         self.mesh = mesh
+        self.mm_mode = mm_mode
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
-        self._pipelines: dict = {}   # template -> jitted/sharded fn
+        self._pipelines: dict = {}   # (template, mm_mode) -> jitted/sharded fn
 
     # cheap static check (EXPLAIN backend display)
     def supports(self, q: QueryContext) -> bool:
@@ -261,7 +377,7 @@ class DeviceExecutor:
             return None
 
     # ---- template build --------------------------------------------------
-    def _agg_template(self, a: Expression, ctx: BatchContext, params, counter):
+    def _agg_template(self, i: int, a: Expression, ctx: BatchContext, params, counter):
         name = a.name
         if name in ("distinctcountbitmap", "segmentpartitioneddistinctcount"):
             name = "distinctcount"
@@ -279,27 +395,25 @@ class DeviceExecutor:
             if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
                 raise DeviceUnsupported("distinctcounthll device path needs a dict column")
             spec = aggspec.make_spec(a)
-            params[f"hlut_{arg.name}"] = ctx.hash_lut(arg.name)
             return ("distinctcounthll", arg.name, spec.log2m)
         # numeric-arg aggregations
         argt = build_expr(a.args[0], ctx, params, counter)
-        self._register_vluts(argt, ctx, params)
         rpb = None
+        nplanes = None
         if name in ("sum", "avg"):
-            # metadata interval arithmetic sizes the two-stage int32 blocks
+            # metadata interval arithmetic sizes the two-stage scatter blocks
+            # AND the matmul kernel's byte planes (ops/groupby_mm.py)
             bounds = expr_bounds(a.args[0], ctx)
             if bounds is not None:
-                rpb = agg_ops.rows_per_block_for(max(abs(bounds[0]), abs(bounds[1])))
-        return (name, argt, rpb)
+                from pinot_tpu.ops import groupby_mm as mm
 
-    def _register_vluts(self, tpl, ctx: BatchContext, params):
-        if not isinstance(tpl, tuple):
-            return
-        if tpl[0] == "dictval":
-            params[f"vlut_{tpl[1]}"] = ctx.value_lut(tpl[1])
-            return
-        for t in tpl[1:]:
-            self._register_vluts(t, ctx, params)
+                rpb = agg_ops.rows_per_block_for(max(abs(bounds[0]), abs(bounds[1])))
+                nplanes = mm.int_planes_needed(bounds[0], bounds[1])
+                import math
+
+                params[f"off{i}"] = jnp.int64(math.floor(bounds[0]))
+            return (name, argt, (nplanes, rpb))
+        return (name, argt, rpb)
 
     def _execute(self, q: QueryContext, segments) -> IntermediateResult:
         aggs = q.aggregations()
@@ -319,7 +433,6 @@ class DeviceExecutor:
         filter_tpl = ("true",) if q.filter is None else build_filter(
             q.filter, ctx, params, counter
         )
-        self._register_filter_vluts(filter_tpl, ctx, params)
 
         group_cols, group_cards = (), ()
         if q.group_by:
@@ -337,7 +450,9 @@ class DeviceExecutor:
             if total > MAX_DENSE_GROUPS:
                 raise DeviceUnsupported(f"dense group space too large ({total})")
 
-        agg_tpls = tuple(self._agg_template(a, ctx, params, counter) for a in aggs)
+        agg_tpls = tuple(
+            self._agg_template(i, a, ctx, params, counter) for i, a in enumerate(aggs)
+        )
         for name, argt, extra in agg_tpls:
             if group_cols and name in ("distinctcount", "distinctcounthll"):
                 total = extra if name == "distinctcount" else (1 << extra)
@@ -349,24 +464,33 @@ class DeviceExecutor:
         shape = "groupby" if group_cols else "agg"
         template = (shape, filter_tpl, group_cols, group_cards, agg_tpls)
 
-        pipeline = self._pipelines.get(template)
+        pipeline = self._pipelines.get((template, self.mm_mode))
         if pipeline is None:
-            raw = build_pipeline(template)
+            raw = build_pipeline(template, self.mm_mode)
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
 
                 pipeline = shard_pipeline(raw, self.mesh)
             else:
                 pipeline = jax.jit(raw)
-            self._pipelines[template] = pipeline
+            self._pipelines[(template, self.mm_mode)] = pipeline
 
         needed = self._needed_columns(filter_tpl) | set(group_cols)
         for name, argt, extra in agg_tpls:
-            if name in ("distinctcount", "distinctcounthll"):
+            if name == "distinctcount":
                 needed.add(argt)
+            elif name == "distinctcounthll":
+                needed.add("hh::" + argt)
             elif argt is not None:
                 needed |= self._needed_columns(argt)
-        cols = {c: ctx.column(c) for c in sorted(needed)}
+        cols = {}
+        for c in sorted(needed):
+            if c.startswith("dv::"):
+                cols[c] = ctx.decoded_column(c[4:])
+            elif c.startswith("hh::"):
+                cols[c] = ctx.prehashed_column(c[4:])
+            else:
+                cols[c] = ctx.column(c)
         if not cols:  # COUNT(*) with no filter: still need one column for shape
             first = segments[0].column_names()[0]
             cols = {first: ctx.column(first)}
@@ -385,15 +509,6 @@ class DeviceExecutor:
         outs = {k: np.asarray(v) for k, v in outs.items()}
         return self._to_intermediate(q, ctx, template, outs, aggs)
 
-    def _register_filter_vluts(self, tpl, ctx, params):
-        if not isinstance(tpl, tuple):
-            return
-        if tpl[0] in ("eq_raw", "in_raw", "range_raw"):
-            self._register_vluts(tpl[1], ctx, params)
-        else:
-            for t in tpl[1:]:
-                self._register_filter_vluts(t, ctx, params)
-
     @staticmethod
     def _needed_columns(tpl) -> set:
         out = set()
@@ -401,8 +516,11 @@ class DeviceExecutor:
         def walk(t):
             if not isinstance(t, tuple):
                 return
-            if t[0] in ("raw", "dictval"):
+            if t[0] == "raw":
                 out.add(t[1])
+                return
+            if t[0] == "dictval":
+                out.add("dv::" + t[1])
                 return
             if t[0] in ("eq_dict", "in_dict", "range_dict", "lut_dict"):
                 out.add(t[1])

@@ -1,0 +1,235 @@
+"""Factored one-hot matmul group-by: the Pallas TPU kernel for dense
+COUNT/SUM/AVG aggregation.
+
+Replaces the per-channel scatter-add (ops/agg.py group_sum / group_count —
+the DefaultGroupByExecutor.java:116-147 aggregateGroupBySV analog) for the
+hot group-by shapes. Measured on v5e at 12M rows, G=6240, 6 channels:
+scatter path ~250ms compute, this kernel ~26ms — channels are nearly free
+because they ride the MXU.
+
+Design (radix-128 factored one-hot):
+    gid = hi*128 + lo.  Per row-block of ``blk`` rows:
+      oh_lo (blk, 128)  : oh_lo[l, j] = (lo_l == j)   — lo on sublanes
+      oh_hi (hpad, blk) : oh_hi[h, l] = (hi_l == h)   — hi on lanes
+      per channel a:     chh_a = oh_hi * ch_a(1, blk)  (masked channel)
+                         acc[a] += chh_a @ oh_lo       (MXU contracts rows)
+    acc[a, h, j] == sum over rows with gid == h*128+j of channel a.
+
+The 3-way contraction channel×hi-onehot×lo-onehot never materializes the
+full (blk, G) one-hot: VPU builds two small one-hots (~0.3 cycles/row),
+the MXU does the G-wide work. ids are fed twice (column- and row-major)
+because Mosaic cannot relayout lanes→sublanes in-kernel.
+
+Exactness: channels are bf16 *planes* — one-hot(bf16) x plane(bf16)
+products are exact for plane values <= 255, and f32 accumulation over one
+superblock (65536 rows x 255 < 2^24) stays exact; superblock partials
+reduce in f64 outside the kernel, and integer recombination happens in
+int64. Float channels use an exact 3-way bf16 split built by bit-masking
+(immune to XLA excess-precision folding of bf16 round-trips), giving
+~2e-12 relative error on f32 sums — tighter than the f32 scatter path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLK = 2048              # rows per grid step (16 lane-rows of 128)
+NINNER = 32             # steps per superblock: 65536 rows (f32-exact bound)
+SUPERBLOCK = BLK * NINNER
+MM_MIN_ROWS = 1 << 17   # below this the scatter path's fixed cost wins
+MAX_CHANNELS = 15       # + the count channel; bounded by VMEM acc size
+MAX_ACC_CELLS = 1 << 19 # A * hpad * 128 f32 cells (2MB VMEM accumulator)
+
+_i32 = jnp.int32
+
+
+def mm_supported(num_groups: int, n_channels: int) -> bool:
+    hpad = _hpad(num_groups)
+    return (n_channels + 1) * hpad * 128 <= MAX_ACC_CELLS
+
+
+def _hpad(num_groups: int) -> int:
+    return max(8, ((num_groups // 128 + 1 + 7) // 8) * 8)
+
+
+def _kernel(ids_col_ref, ids_row_ref, ch_ref, out_ref, acc_ref,
+            *, ninner, hpad, a_real, blk):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ids_c = ids_col_ref[:]                          # (blk, 1) int32
+    ids_r = ids_row_ref[:].reshape(1, blk)          # (blk//128,128)→(1,blk)
+    lo_c = ids_c & 127
+    hi_r = ids_r >> 7
+
+    jlane = jax.lax.broadcasted_iota(jnp.int32, (blk, 128), 1)
+    oh_lo = jnp.where(lo_c == jlane, jnp.float32(1), jnp.float32(0)) \
+        .astype(jnp.bfloat16)
+    hsub = jax.lax.broadcasted_iota(jnp.int32, (hpad, blk), 0)
+    oh_hi = jnp.where(hi_r == hsub, jnp.float32(1), jnp.float32(0)) \
+        .astype(jnp.bfloat16)
+
+    for a in range(a_real):
+        ch_a = ch_ref[pl.ds(a, 1), :]               # (1, blk) bf16
+        chh = oh_hi * ch_a
+        acc_ref[a] += jnp.dot(chh, oh_lo, preferred_element_type=jnp.float32)
+
+    @pl.when(i == ninner - 1)
+    def _():
+        out_ref[0] = acc_ref[:]
+
+
+def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
+    """Dense per-group sums of bf16 plane channels.
+
+    gid: (n,) int32 in [0, num_groups]; id == num_groups is the overflow
+    slot for masked/padded rows (sliced off).
+    channels: (A, n) bf16 planes, |value| <= 255 for exact integer sums.
+    Returns (A, num_groups) float64.
+    """
+    a_real, n = channels.shape
+    hpad = _hpad(num_groups)
+    n_pad = ((n + SUPERBLOCK - 1) // SUPERBLOCK) * SUPERBLOCK
+    nsuper = n_pad // SUPERBLOCK
+
+    ids = jnp.concatenate(
+        [gid.astype(jnp.int32), jnp.full(n_pad - n, num_groups, dtype=jnp.int32)]
+    )
+    ids_col = ids[:, None]
+    ids_row = ids.reshape(-1, 128)
+    ch = jnp.concatenate(
+        [channels, jnp.zeros((a_real, n_pad - n), channels.dtype)], axis=1
+    )
+
+    kern = functools.partial(
+        _kernel, ninner=NINNER, hpad=hpad, a_real=a_real, blk=BLK
+    )
+    out = pl.pallas_call(
+        kern,
+        grid=(nsuper, NINNER),
+        in_specs=[
+            pl.BlockSpec((BLK, 1), lambda s, i: (s * NINNER + i, _i32(0)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((a_real, BLK), lambda s, i: (_i32(0), s * NINNER + i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, a_real, hpad, 128),
+            lambda s, i: (s, _i32(0), _i32(0), _i32(0)),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nsuper, a_real, hpad, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((a_real, hpad, 128), jnp.float32)],
+        interpret=interpret,
+    )(ids_col, ids_row, ch)
+    tot = jnp.sum(out, axis=0, dtype=jnp.float64)
+    return tot.reshape(a_real, hpad * 128)[:, :num_groups]
+
+
+# ---------------------------------------------------------------------------
+# channel planes: values → bf16 channels + recombination
+# ---------------------------------------------------------------------------
+
+
+def int_planes_needed(lo: float, hi: float) -> int:
+    """Byte planes needed for ints in [lo, hi] after offset-by-floor(lo).
+    Ceil/floor (not truncation) so fractional metadata bounds — e.g. from a
+    float column behind a CAST — can't under-count the span."""
+    import math
+
+    rng = math.ceil(hi) - math.floor(lo)
+    planes = 1
+    while rng > (1 << (8 * planes)) - 1:
+        planes += 1
+    return planes
+
+
+def int_planes(values, offset, nplanes: int):
+    """values - offset split into ``nplanes`` byte planes (bf16-exact)."""
+    v = values.astype(jnp.int64) - offset
+    out = []
+    for k in range(nplanes):
+        out.append(((v >> (8 * k)) & 0xFF).astype(jnp.bfloat16))
+    return out
+
+
+def recombine_int(plane_sums, count, offset):
+    """int64 recombination: Σv = Σ_k 256^k·S_k + count·offset (exact)."""
+    tot = jnp.zeros_like(plane_sums[0], dtype=jnp.int64)
+    for k, s in enumerate(plane_sums):
+        tot = tot + (s.astype(jnp.int64) << (8 * k))
+    return tot + count.astype(jnp.int64) * offset
+
+
+def hll_nrho(log2m: int) -> int:
+    """Max rho value: clz over (32 - log2m) value bits + 1 (sentinel caps)."""
+    return 32 - log2m + 1
+
+
+def hll_supported(num_groups: int, log2m: int) -> bool:
+    nslots = num_groups * (1 << log2m)
+    return mm_supported(nslots, hll_nrho(log2m)) and nslots <= (1 << 20)
+
+
+def hll_registers(slot, rho, num_groups: int, log2m: int, *,
+                  interpret: bool = False):
+    """HLL register build as rho-threshold indicator channels through the
+    factored matmul kernel: counts[r, slot] = #rows with rho == r, register
+    = max r with count > 0. Replaces the 12M-row scatter-max (~100ms on
+    v5e) with a ~20ms matmul when G·m is small enough for VMEM.
+
+    slot: (n,) int32 = gid * m + idx, masked rows → num_groups * m.
+    rho:  (n,) int32 in [1, nrho].
+    Returns (num_groups, m) int32 registers.
+    """
+    m = 1 << log2m
+    nslots = num_groups * m
+    nrho = hll_nrho(log2m)
+    channels = jnp.stack(
+        [
+            jnp.where(rho == r, jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
+            for r in range(1, nrho + 1)
+        ]
+    )
+    counts = group_sums(slot, channels, nslots, interpret=interpret)
+    rvals = jnp.arange(1, nrho + 1, dtype=jnp.int32)[:, None]
+    regs = jnp.max(jnp.where(counts > 0.5, rvals, 0), axis=0).astype(jnp.int32)
+    return regs.reshape(num_groups, m)
+
+
+def _bf16_hi(v):
+    """Top-16-bit truncation of f32 — exactly bf16-representable, built by
+    bit-masking so XLA's excess-precision pass cannot fold it away."""
+    bits = jax.lax.bitcast_convert_type(v, jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits & jnp.uint32(0xFFFF0000), jnp.float32)
+
+
+def float_planes(values):
+    """f32 → 3 bf16 channels summing exactly to the f32 value."""
+    v = values.astype(jnp.float32)
+    m0 = _bf16_hi(v)
+    r1 = v - m0
+    m1 = _bf16_hi(r1)
+    r2 = r1 - m1
+    m2 = _bf16_hi(r2)
+    return [m0.astype(jnp.bfloat16), m1.astype(jnp.bfloat16),
+            m2.astype(jnp.bfloat16)]
+
+
+def recombine_float(plane_sums):
+    tot = plane_sums[0]
+    for s in plane_sums[1:]:
+        tot = tot + s
+    return tot

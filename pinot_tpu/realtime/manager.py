@@ -304,42 +304,68 @@ class RealtimeTableDataManager:
         for mgr in self.partition_managers.values():
             mgr.stop(commit_remaining=commit_remaining)
 
-    def _reconcile_committed(self, partition: int, upsert=None) -> None:
-        """Close the crash window between checkpoint and publication: if the
-        checkpoint names a sealed segment that exists on disk but was never
-        registered (crash after record_commit, before on_committed), load and
-        publish it now. Only the LAST committed segment per partition can be
-        in this state; earlier ones were published or are reloaded from the
-        cluster registry by the server layer.
+    def _sealed_on_disk(self, partition: int) -> list:
+        """(sequence, name) of this partition's sealed segment dirs, in
+        commit order (LLCSegmentName: table__partition__sequence__ts)."""
+        prefix = f"{self.table_config.table_name}__{partition}__"
+        out = []
+        try:
+            entries = os.listdir(self.data_dir)
+        except OSError:
+            return []
+        for name in entries:
+            if not name.startswith(prefix):
+                continue
+            if not os.path.isdir(os.path.join(self.data_dir, name)):
+                continue
+            try:
+                seq = int(name.split("__")[2])
+            except (IndexError, ValueError):
+                continue
+            out.append((seq, name))
+        out.sort()
+        return out
 
-        For upsert tables the sealed dir holds ALL rows with no persisted
-        validDocIds — replay its primary keys through the fresh upsert
-        manager so stale duplicates are re-invalidated and later stream
-        updates can keep invalidating them."""
+    def _reconcile_committed(self, partition: int, upsert=None) -> None:
+        """Restart reconciliation, two duties:
+
+        1. Crash-window repair: if the checkpoint names a sealed segment that
+           exists on disk but was never registered (crash after record_commit,
+           before publication), publish it now.
+        2. Upsert replay: sealed dirs hold ALL rows with no persisted
+           validDocIds, and the server layer's registry sync loads them with
+           bare add_segment — so replay EVERY sealed segment's primary keys
+           through the fresh upsert manager, in commit (sequence) order, so
+           stale duplicates are re-invalidated and later stream updates keep
+           invalidating them."""
         from pinot_tpu.storage.segment import ImmutableSegment
 
         prior = self.checkpoint.committed(self.table_config.table_name, partition)
         if prior is None:
             return
-        name = prior["segment"]
-        seg_dir = os.path.join(self.data_dir, name)
-        if not os.path.isdir(seg_dir):
-            return
-        # The server layer may have loaded the segment from the registry
-        # already; the upsert replay must then target THAT instance (the
-        # valid_docs_mask attaches to the object the engine queries).
-        existing = getattr(self.engine_table, "segments", {}).get(name)
-        sealed = existing if existing is not None else ImmutableSegment(seg_dir)
-        if upsert is not None:
-            pk_cols = [sealed.values(c) for c in self.schema.primary_key_columns]
-            keys = list(zip(*pk_cols))
-            if upsert.comparison_column is not None:
-                cmps = sealed.values(upsert.comparison_column)
-            else:
-                cmps = range(sealed.n_docs)  # doc order == offset order
-            upsert.add_segment(sealed, keys, cmps)
-        if existing is None:
-            self._publish_committed(partition, sealed)
+        committed_seq = prior["sequence"]
+        engine_segs = getattr(self.engine_table, "segments", {})
+        for seq, name in self._sealed_on_disk(partition):
+            if seq > committed_seq:
+                continue  # sealed dir past the checkpoint: orphan, not committed
+            # Replay must target the instance the engine queries (the
+            # valid_docs_mask attaches to the object), not a fresh load.
+            existing = engine_segs.get(name)
+            sealed = existing
+            if sealed is None:
+                sealed = ImmutableSegment(os.path.join(self.data_dir, name))
+            if upsert is not None:
+                pk_cols = [sealed.values(c) for c in self.schema.primary_key_columns]
+                keys = list(zip(*pk_cols))
+                if upsert.comparison_column is not None:
+                    cmps = sealed.values(upsert.comparison_column)
+                else:
+                    cmps = range(sealed.n_docs)  # doc order == offset order
+                upsert.add_segment(sealed, keys, cmps)
+            if existing is None and (upsert is not None or seq == committed_seq):
+                # non-upsert: only the checkpointed segment can be in the
+                # crash window; earlier ones come from the registry sync
+                self._publish_committed(partition, sealed)
 
     # ---- engine wiring ---------------------------------------------------
     def _on_consuming(self, partition: int, segment: MutableSegment) -> None:

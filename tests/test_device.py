@@ -140,3 +140,41 @@ def test_large_value_sum_exact(tmp_path):
     r = eng.execute("SELECT k, SUM(v) FROM big GROUP BY k ORDER BY k")
     assert len(eng.device._pipelines) > 0  # device path taken
     assert r["resultTable"]["rows"] == [["a", 300 * 2**30], ["b", 300 * 2**30]], r
+
+
+@pytest.fixture(scope="module")
+def mm_engine(engines, tmp_path_factory):
+    """Device engine with the factored matmul group-by kernel forced on
+    (Pallas interpret mode on the CPU test mesh)."""
+    from pinot_tpu.engine.device import DeviceExecutor
+
+    dev, _, _ = engines
+    eng = QueryEngine(device_executor=DeviceExecutor(mm_mode="interpret"))
+    for seg in dev.tables["t"].segments.values():
+        eng.add_segment("t", seg)
+    return eng
+
+
+MM_QUERIES = [
+    "SELECT dim2, COUNT(*), SUM(ivalue) FROM t GROUP BY dim2 ORDER BY dim2",
+    "SELECT dim2, DISTINCTCOUNTHLL(dim1) FROM t GROUP BY dim2 ORDER BY dim2",
+    "SELECT DISTINCTCOUNTHLL(dim1) FROM t",
+    "SELECT dim1, dim2, COUNT(*), AVG(fvalue) FROM t GROUP BY dim1, dim2 "
+    "ORDER BY dim1, dim2 LIMIT 200",
+    "SELECT dim1, SUM(ivalue), SUM(fvalue), MAX(ivalue) FROM t "
+    "WHERE dim2 != 'b' GROUP BY dim1 ORDER BY dim1 LIMIT 50",
+]
+
+
+@pytest.mark.parametrize("sql", MM_QUERIES)
+def test_matmul_groupby_parity(mm_engine, engines, sql):
+    """The factored one-hot matmul kernel must agree with the host path
+    (exact ints, float sums to f32-level tolerance)."""
+    _, host, _ = engines
+    rd = mm_engine.execute(sql)
+    rh = host.execute(sql)
+    assert not rd.get("exceptions"), rd
+    rows_d, rows_h = rd["resultTable"]["rows"], rh["resultTable"]["rows"]
+    assert len(rows_d) == len(rows_h)
+    for a, b in zip(rows_d, rows_h):
+        assert all(_close(x, y) for x, y in zip(a, b)), (a, b)

@@ -253,6 +253,32 @@ class TestUpsert:
         finally:
             mgr2.stop(commit_remaining=False)
 
+    def test_upsert_restart_replays_all_sealed_segments(self, tmp_path):
+        """A key overridden across segment boundaries must stay deduped
+        after restart: EVERY sealed segment's keys replay in commit order,
+        not just the checkpointed one (r2 review finding)."""
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert_multi", n_partitions=1,
+                                               flush_rows=2, upsert=True)
+        mgr.start()
+        topic.publish_json({"user": "a", "action": "1", "amount": 1, "ts": 1})
+        topic.publish_json({"user": "b", "action": "1", "amount": 2, "ts": 1})  # seals S0
+        assert wait_until(lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 1)
+        topic.publish_json({"user": "a", "action": "2", "amount": 70, "ts": 2})
+        topic.publish_json({"user": "c", "action": "1", "amount": 5, "ts": 1})  # seals S1
+        assert wait_until(lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 2)
+        mgr.stop(commit_remaining=False)
+
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(pk=True), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            assert _count(eng2) == 3  # a (ts=2 wins), b, c
+            assert _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 70
+        finally:
+            mgr2.stop(commit_remaining=False)
+
     def test_upsert_survives_commit(self, tmp_path):
         topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert3", n_partitions=1,
                                                flush_rows=3, upsert=True)
