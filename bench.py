@@ -1,15 +1,21 @@
 """Benchmark: the BASELINE.json workloads on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Workload mirrors the reference's JMH macro-bench
-(pinot-perf/.../BenchmarkQueries.java:159 — 1.5M-row synthetic segments) and
-BASELINE.json configs: a filtered range-scan SUM, a 2-dim GROUP BY with
-COUNT/SUM/AVG + DISTINCTCOUNTHLL (NYC-taxi shape), and an IN-filter
-aggregation. The headline value is rows scanned per second per chip on the
-group-by config; vs_baseline compares against the in-process numpy host
-executor on the same machine (stand-in for the CPU reference path until a
-real Pinot 32-vCPU run is recorded — BASELINE.md: "published": {}).
+Two suites (BASELINE.md):
+- **ssb100m**: an SSB-shaped 100M-row lineorder table, the five BASELINE
+  configs — (1) full-scan group-by SUM (baseballStats shape), (2) range
+  filter + SUM (Q1.x shape), (3) IN + BETWEEN filter agg (inverted-index
+  shape), (4) high-cardinality group-by with COUNT/AVG/DISTINCTCOUNTHLL
+  (NYC-taxi shape), (5) star-tree-accelerated 3-dim group-by (Q4.x shape).
+- **taxi12m**: round-1's 12M-row suite, kept as a regression guard.
+
+The headline is rows-scanned/s/chip on the 100M high-cardinality group-by.
+vs_baseline compares against the in-process numpy host executor on one
+segment, scaled to the full table (stand-in until a real Pinot 32-vCPU run
+is recorded — BASELINE.md: "published": {}).
+
+Reference harness shape: pinot-perf/.../BenchmarkQueries.java:78,159-167.
 """
 
 from __future__ import annotations
@@ -22,12 +28,21 @@ import time
 
 import numpy as np
 
-N_SEGMENTS = 8
-ROWS_PER_SEGMENT = 1_500_000
-CACHE_DIR = os.path.join(tempfile.gettempdir(), "pinot_tpu_bench_v2")
+CACHE = os.path.join(tempfile.gettempdir(), "pinot_tpu_bench_v3")
+
+TAXI_SEGMENTS = 8
+TAXI_ROWS = 1_500_000
+SSB_SEGMENTS = 8
+SSB_ROWS = 12_500_000  # x8 = 100M
 
 
-def build_dataset():
+def _built(d, n):
+    return all(
+        os.path.exists(os.path.join(d, f"s{i}", "metadata.json")) for i in range(n)
+    )
+
+
+def build_taxi():
     from pinot_tpu.common.datatypes import DataType
     from pinot_tpu.common.schema import Schema
     from pinot_tpu.common.table_config import (
@@ -37,12 +52,15 @@ def build_dataset():
     )
     from pinot_tpu.storage.creator import build_segment
 
+    out_base = os.path.join(CACHE, "taxi")
+    if _built(out_base, TAXI_SEGMENTS):
+        return
     schema = Schema.build(
         name="bench",
         dimensions=[
-            ("zone", DataType.STRING),      # 260 zones (taxi-like)
-            ("hour", DataType.INT),         # 24
-            ("vendor", DataType.STRING),    # 8
+            ("zone", DataType.STRING),
+            ("hour", DataType.INT),
+            ("vendor", DataType.STRING),
         ],
         metrics=[("fare", DataType.INT), ("distance", DataType.DOUBLE)],
     )
@@ -60,11 +78,11 @@ def build_dataset():
     rng = np.random.default_rng(42)
     zones = np.array([f"zone_{i:03d}" for i in range(260)])
     vendors = np.array([f"v{i}" for i in range(8)])
-    for i in range(N_SEGMENTS):
-        out = os.path.join(CACHE_DIR, f"s{i}")
+    for i in range(TAXI_SEGMENTS):
+        out = os.path.join(out_base, f"s{i}")
         if os.path.exists(os.path.join(out, "metadata.json")):
             continue
-        n = ROWS_PER_SEGMENT
+        n = TAXI_ROWS
         cols = {
             "zone": zones[rng.integers(0, 260, n)],
             "hour": rng.integers(0, 24, n).astype(np.int32),
@@ -73,13 +91,70 @@ def build_dataset():
             "distance": np.round(rng.uniform(0.1, 50.0, n), 2),
         }
         build_segment(schema, cols, out, cfg, f"s{i}")
-    return schema
 
 
-QUERIES = {
+def build_ssb():
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import (
+        IndexingConfig,
+        StarTreeIndexConfig,
+        TableConfig,
+    )
+    from pinot_tpu.storage.creator import build_segment
+
+    out_base = os.path.join(CACHE, "ssb")
+    if _built(out_base, SSB_SEGMENTS):
+        return
+    schema = Schema.build(
+        name="lineorder",
+        dimensions=[
+            ("d_year", DataType.INT),
+            ("c_region", DataType.STRING),
+            ("s_nation", DataType.STRING),
+            ("lo_suppkey", DataType.INT),
+            ("lo_custkey", DataType.INT),
+            ("lo_orderdate", DataType.INT),
+            ("lo_discount", DataType.INT),
+        ],
+        metrics=[("lo_quantity", DataType.INT), ("lo_revenue", DataType.INT)],
+    )
+    cfg = TableConfig(
+        table_name="lineorder",
+        indexing=IndexingConfig(
+            inverted_index_columns=["lo_suppkey"],
+            star_tree_configs=[
+                StarTreeIndexConfig(
+                    dimensions_split_order=["d_year", "c_region", "s_nation"],
+                    function_column_pairs=["SUM__lo_revenue", "COUNT__*"],
+                )
+            ],
+        ),
+    )
+    rng = np.random.default_rng(7)
+    nations = np.array([f"nation_{i:02d}" for i in range(25)])
+    regions = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDEAST"])
+    for i in range(SSB_SEGMENTS):
+        out = os.path.join(out_base, f"s{i}")
+        if os.path.exists(os.path.join(out, "metadata.json")):
+            continue
+        n = SSB_ROWS
+        cols = {
+            "d_year": rng.integers(1992, 1999, n).astype(np.int32),
+            "c_region": regions[rng.integers(0, 5, n)],
+            "s_nation": nations[rng.integers(0, 25, n)],
+            "lo_suppkey": rng.integers(0, 2000, n).astype(np.int32),
+            "lo_custkey": rng.integers(0, 100_000, n).astype(np.int32),
+            "lo_orderdate": (19920000 + rng.integers(0, 2406, n)).astype(np.int32),
+            "lo_discount": rng.integers(0, 11, n).astype(np.int32),
+            "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
+            "lo_revenue": rng.integers(1000, 6_000_000, n).astype(np.int32),
+        }
+        build_segment(schema, cols, out, cfg, f"s{i}")
+
+
+TAXI_QUERIES = {
     "range_sum": "SELECT SUM(fare) FROM bench WHERE fare BETWEEN 1000 AND 5000",
-    # the headline raw-scan group-by opts out of the star-tree so the metric
-    # measures scan throughput; startree_groupby measures the index path
     "groupby": (
         "SET useStarTree = false; "
         "SELECT zone, hour, COUNT(*), SUM(fare), AVG(distance) FROM bench "
@@ -99,6 +174,39 @@ QUERIES = {
     ),
 }
 
+SSB_QUERIES = {
+    # 1. baseballStats shape: full scan-agg group-by
+    "q1_scan_agg": (
+        "SET useStarTree = false; "
+        "SELECT lo_suppkey, SUM(lo_revenue) FROM lineorder "
+        "GROUP BY lo_suppkey ORDER BY SUM(lo_revenue) DESC LIMIT 10"
+    ),
+    # 2. SSB Q1.x shape: date range + discount/quantity bands
+    "q2_range_sum": (
+        "SELECT SUM(lo_revenue) FROM lineorder WHERE "
+        "lo_orderdate BETWEEN 19930101 AND 19931231 "
+        "AND lo_discount BETWEEN 1 AND 3 AND lo_quantity < 25"
+    ),
+    # 3. inverted-index shape: IN + range
+    "q3_in_range": (
+        "SELECT COUNT(*), SUM(lo_revenue) FROM lineorder WHERE "
+        "lo_suppkey IN (11, 234, 567, 890, 1203, 1456, 1789) "
+        "AND lo_discount BETWEEN 4 AND 6"
+    ),
+    # 4. NYC-taxi shape: high-cardinality group-by + HLL
+    "q4_highcard_hll": (
+        "SET useStarTree = false; "
+        "SELECT lo_suppkey, COUNT(*), AVG(lo_quantity), "
+        "DISTINCTCOUNTHLL(lo_custkey) FROM lineorder "
+        "GROUP BY lo_suppkey ORDER BY COUNT(*) DESC LIMIT 10"
+    ),
+    # 5. SSB Q4.x shape: star-tree 3-dim pre-aggregated group-by
+    "q5_startree": (
+        "SELECT d_year, c_region, SUM(lo_revenue), COUNT(*) FROM lineorder "
+        "GROUP BY d_year, c_region ORDER BY d_year, c_region LIMIT 50"
+    ),
+}
+
 
 def run(engine, sql, iters):
     lat = []
@@ -111,49 +219,72 @@ def run(engine, sql, iters):
     return float(np.percentile(lat, 50)), float(np.percentile(lat, 99))
 
 
+def bench_suite(engine, queries, warm=2, iters=7):
+    detail = {}
+    for name, sql in queries.items():
+        run(engine, sql, warm)
+        p50, p99 = run(engine, sql, iters)
+        detail[name] = {"p50_ms": round(p50 * 1e3, 2), "p99_ms": round(p99 * 1e3, 2)}
+    return detail
+
+
 def main():
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    build_dataset()
+    os.makedirs(CACHE, exist_ok=True)
+    t0 = time.time()
+    build_taxi()
+    build_ssb()
+    build_s = round(time.time() - t0, 1)
 
     from pinot_tpu.engine.engine import QueryEngine
     from pinot_tpu.storage.segment import ImmutableSegment
 
-    segments = [
-        ImmutableSegment(os.path.join(CACHE_DIR, f"s{i}")) for i in range(N_SEGMENTS)
+    eng = QueryEngine()
+    taxi = [
+        ImmutableSegment(os.path.join(CACHE, "taxi", f"s{i}"))
+        for i in range(TAXI_SEGMENTS)
     ]
-    total_rows = sum(s.n_docs for s in segments)
+    ssb = [
+        ImmutableSegment(os.path.join(CACHE, "ssb", f"s{i}"))
+        for i in range(SSB_SEGMENTS)
+    ]
+    for s in taxi:
+        eng.add_segment("bench", s)
+    for s in ssb:
+        eng.add_segment("lineorder", s)
+    ssb_rows = sum(s.n_docs for s in ssb)
+    taxi_rows = sum(s.n_docs for s in taxi)
 
-    dev = QueryEngine()
-    for s in segments:
-        dev.add_segment("bench", s)
+    ssb_detail = bench_suite(eng, SSB_QUERIES)
+    taxi_detail = bench_suite(eng, TAXI_QUERIES)
 
-    # warm (compile + HBM upload), then measure
-    detail = {}
-    for name, sql in QUERIES.items():
-        run(dev, sql, 2)
-        p50, p99 = run(dev, sql, 7)
-        detail[name] = {"p50_ms": round(p50 * 1e3, 2), "p99_ms": round(p99 * 1e3, 2)}
+    headline_p50 = ssb_detail["q4_highcard_hll"]["p50_ms"] / 1e3
+    rows_per_sec = ssb_rows / headline_p50
 
-    headline_p50 = detail["groupby"]["p50_ms"] / 1e3
-    rows_per_sec = total_rows / headline_p50
-
-    # CPU stand-in baseline: same query, numpy host path, one segment scaled up
+    # CPU stand-in baseline: host path on ONE ssb segment, scaled by
+    # segment count (a full-table host run takes minutes)
     host = QueryEngine(device_executor=None)
-    for s in segments:
-        host.add_segment("bench", s)
-    host_p50, _ = run(host, QUERIES["groupby"], 3)
-    vs_baseline = host_p50 / headline_p50
+    host.add_segment("lineorder", ssb[0])
+    host_p50, _ = run(host, SSB_QUERIES["q4_highcard_hll"], 3)
+    vs_baseline = host_p50 * SSB_SEGMENTS / headline_p50
 
     print(
         json.dumps(
             {
-                "metric": "group-by scan throughput (12M rows, 2-dim groupby+agg)",
+                "metric": "SSB 100M high-card group-by+HLL scan throughput",
                 "value": round(rows_per_sec / 1e6, 2),
                 "unit": "Mrows/s/chip",
                 "vs_baseline": round(vs_baseline, 2),
-                "detail": detail,
-                "total_rows": total_rows,
-                "baseline_note": "vs in-process numpy host path (no published reference numbers; BASELINE.md)",
+                "detail": {
+                    "ssb100m": ssb_detail,
+                    "taxi12m": taxi_detail,
+                    "ssb_rows": ssb_rows,
+                    "taxi_rows": taxi_rows,
+                    "dataset_build_s": build_s,
+                },
+                "baseline_note": (
+                    "vs in-process numpy host path, 1 segment scaled x8 "
+                    "(no published reference numbers; BASELINE.md)"
+                ),
             }
         )
     )

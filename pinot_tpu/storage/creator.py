@@ -40,13 +40,24 @@ import json
 
 
 def _np_column(values, dtype: DataType) -> np.ndarray:
-    """Coerce an ingested column to its canonical numpy representation."""
+    """Coerce an ingested column to its canonical numpy representation.
+    Columns already in canonical dtype pass through without a per-element
+    copy (the conversion loop dominated segment build time at 10M+ rows)."""
     if dtype.is_string_like:
+        arr = np.asarray(values) if not isinstance(values, np.ndarray) else values
         if dtype is DataType.BYTES:
-            # fixed-width byte strings: np.save-able without pickle
-            return np.asarray([v if isinstance(v, bytes) else bytes(v) for v in values], dtype=np.bytes_)
+            if arr.dtype.kind == "S":
+                return arr
+            return np.asarray(
+                [v if isinstance(v, bytes) else bytes(v) for v in values],
+                dtype=np.bytes_,
+            )
+        if arr.dtype.kind == "U":
+            return arr
         return np.asarray([str(v) for v in values], dtype=np.str_)
     arr = np.asarray(values)
+    if arr.dtype == dtype.np_dtype:
+        return arr
     if arr.dtype == object:
         arr = np.asarray([dtype.convert(v) for v in values])
     return arr.astype(dtype.np_dtype)
