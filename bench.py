@@ -28,7 +28,7 @@ import time
 
 import numpy as np
 
-CACHE = os.path.join(tempfile.gettempdir(), "pinot_tpu_bench_v3")
+CACHE = os.path.join(tempfile.gettempdir(), "pinot_tpu_bench_v4")
 
 TAXI_SEGMENTS = 8
 TAXI_ROWS = 1_500_000
@@ -145,7 +145,16 @@ def build_ssb():
             "s_nation": nations[rng.integers(0, 25, n)],
             "lo_suppkey": rng.integers(0, 2000, n).astype(np.int32),
             "lo_custkey": rng.integers(0, 100_000, n).astype(np.int32),
-            "lo_orderdate": (19920000 + rng.integers(0, 2406, n)).astype(np.int32),
+            # date-like ints spanning 1992-01-01..1998-08-02 (SSB's range) so
+            # Q1.x's 1993 BETWEEN actually selects rows (a prior generator
+            # capped at 19922405 — every segment min/max-pruned and "q2" was
+            # a 1.6ms no-op)
+            "lo_orderdate": (
+                19920101
+                + (rng.integers(0, 7, n) * 10000)
+                + (rng.integers(0, 12, n) * 100)
+                + rng.integers(0, 28, n)
+            ).astype(np.int32),
             "lo_discount": rng.integers(0, 11, n).astype(np.int32),
             "lo_quantity": rng.integers(1, 51, n).astype(np.int32),
             "lo_revenue": rng.integers(1000, 6_000_000, n).astype(np.int32),
@@ -208,6 +217,56 @@ SSB_QUERIES = {
 }
 
 
+def smoke_gate():
+    """Tiny REAL-backend compile+run of every Pallas path before the 100M
+    suite: a Mosaic layout/padding regression must die here with a clear
+    message, not as a 50GB allocation two minutes into the bench.
+    (Round-2 postmortem: interpret-mode tests can't see TPU layout
+    blowups — VERDICT.md round 2, weak #2.)"""
+    import jax
+    import jax.numpy as jnp
+
+    from pinot_tpu.ops import groupby_mm as mm
+
+    # off-TPU the engine routes to scatter anyway; interpret mode still
+    # checks the kernel math without requiring Mosaic lowering
+    interp = jax.default_backend() != "tpu"
+    rng = np.random.default_rng(3)
+    n, G, A = 200_000, 6240, 4
+    gid = rng.integers(0, G, n).astype(np.int32)
+    vals = rng.integers(0, 255, (A, n)).astype(np.float32)
+    out = np.asarray(
+        jax.device_get(
+            jax.jit(lambda g, c: mm.group_sums(g, c, G, interpret=interp))(
+                jnp.asarray(gid), jnp.asarray(vals).astype(jnp.bfloat16)
+            )
+        )
+    )
+    ref = np.zeros((A, G))
+    for a in range(A):
+        np.add.at(ref[a], gid, vals[a])
+    if np.abs(out - ref).max() != 0:
+        raise SystemExit("smoke_gate: group_sums kernel mismatch on real backend")
+
+    log2m, ngr = 10, 8
+    m = 1 << log2m
+    slot = rng.integers(0, ngr * m, n).astype(np.int32)
+    rho = rng.integers(1, 23, n).astype(np.int32)
+    regs = np.asarray(
+        jax.device_get(
+            jax.jit(lambda s, r: mm.hll_registers(s, r, ngr, log2m,
+                                                  interpret=interp))(
+                jnp.asarray(slot), jnp.asarray(rho)
+            )
+        )
+    )
+    ref_regs = np.zeros(ngr * m, dtype=np.int32)
+    np.maximum.at(ref_regs, slot, rho)
+    if np.abs(regs.reshape(-1) - ref_regs).max() != 0:
+        raise SystemExit("smoke_gate: hll_registers kernel mismatch on real backend")
+    print(f"smoke_gate OK on {jax.default_backend()}", file=sys.stderr)
+
+
 def run(engine, sql, iters):
     lat = []
     for _ in range(iters):
@@ -230,6 +289,7 @@ def bench_suite(engine, queries, warm=2, iters=7):
 
 def main():
     os.makedirs(CACHE, exist_ok=True)
+    smoke_gate()
     t0 = time.time()
     build_taxi()
     build_ssb()

@@ -19,6 +19,8 @@ merge: groups are already aligned across segments when the scatter lands.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -154,9 +156,11 @@ def _hll_regs(slot, rho, num_groups, log2m, mm_mode):
             slot.reshape(-1), rho.reshape(-1), num_groups, log2m,
             interpret=(mm_mode == "interpret"),
         )
-    regs = jnp.zeros(num_groups * m + 1, dtype=jnp.int32)
-    regs = regs.at[slot.reshape(-1)].max(rho.reshape(-1))
-    return regs[: num_groups * m].reshape(num_groups, m)
+    # f32 scatter-max: ~16% faster than int32 on v5e at 100M rows (951 vs
+    # 1136 ms) and exact for rho <= 23 < 2^24
+    regs = jnp.zeros(num_groups * m + 1, dtype=jnp.float32)
+    regs = regs.at[slot.reshape(-1)].max(rho.reshape(-1).astype(jnp.float32))
+    return regs[: num_groups * m].reshape(num_groups, m).astype(jnp.int32)
 
 
 def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs):
@@ -341,6 +345,9 @@ def build_pipeline(template, mm_mode: str = "auto"):
 
 class DeviceExecutor:
     MAX_CACHED_BATCHES = 4  # LRU cap: a batch holds full columns in HBM
+    # byte-aware cap: column blocks are materialized lazily, so the byte
+    # check runs after each execution too (engine/device.py _execute)
+    MAX_CACHED_BYTES = int(os.environ.get("PINOT_TPU_BATCH_CACHE_BYTES", 6 << 30))
 
     def __init__(self, mesh=None, mm_mode: str = "auto"):
         """``mesh``: optional jax Mesh — shard the segment axis over it with
@@ -358,16 +365,31 @@ class DeviceExecutor:
             return False
         return all(a.name in DEVICE_AGGS for a in aggs)
 
+    @staticmethod
+    def _batch_key(segments):
+        return tuple(s.dir for s in segments)
+
     def batch_for(self, segments) -> BatchContext:
-        key = tuple(s.dir for s in segments)
+        key = self._batch_key(segments)
         ctx = self._batches.pop(key, None)
         if ctx is None:
             ctx = BatchContext(segments)
-            while len(self._batches) >= self.MAX_CACHED_BATCHES:
-                # evict least-recently-used (insertion order == recency)
-                self._batches.pop(next(iter(self._batches)))
         self._batches[key] = ctx
+        self._evict(keep=key)
         return ctx
+
+    def _evict(self, keep=None):
+        """LRU eviction by count AND resident HBM bytes (a 100M-row batch's
+        decoded/prehashed blocks alone can approach HBM capacity — count
+        caps alone don't bound that)."""
+        def over():
+            if len(self._batches) > self.MAX_CACHED_BATCHES:
+                return True
+            total = sum(b.device_bytes() for b in self._batches.values())
+            return total > self.MAX_CACHED_BYTES and len(self._batches) > 1
+        while over():
+            lru = next(k for k in self._batches if k != keep)
+            self._batches.pop(lru)
 
     def try_execute(self, q: QueryContext, segments):
         """list[IntermediateResult] (length 1) or None → host fallback."""
@@ -507,6 +529,7 @@ class DeviceExecutor:
         # round-trip each, device_get overlaps them (measured 4-5x)
         outs = jax.device_get(pipeline(cols, n_docs, params))
         outs = {k: np.asarray(v) for k, v in outs.items()}
+        self._evict(keep=self._batch_key(segments))
         return self._to_intermediate(q, ctx, template, outs, aggs)
 
     @staticmethod

@@ -171,6 +171,15 @@ class BatchContext:
             self._prehashed[name] = jnp.asarray(blocks)
         return self._prehashed[name]
 
+    def device_bytes(self) -> int:
+        """HBM resident bytes of materialized column blocks (columns +
+        decoded + prehashed) — the executor's byte-aware LRU eviction key."""
+        total = 0
+        for d in (self._columns, self._decoded, self._prehashed):
+            for arr in d.values():
+                total += getattr(arr, "nbytes", 0)
+        return total
+
     def int_bounds(self, name: str):
         """(min, max) over the batch from column metadata, or None."""
         mns, mxs = [], []

@@ -9,16 +9,22 @@ because they ride the MXU.
 
 Design (radix-128 factored one-hot):
     gid = hi*128 + lo.  Per row-block of ``blk`` rows:
-      oh_lo (blk, 128)  : oh_lo[l, j] = (lo_l == j)   — lo on sublanes
-      oh_hi (hpad, blk) : oh_hi[h, l] = (hi_l == h)   — hi on lanes
+      oh_loT (128, blk) : oh_loT[j, l] = (lo_l == j)  — rows on lanes
+      oh_hi (hpad, blk) : oh_hi[h, l]  = (hi_l == h)  — rows on lanes
       per channel a:     chh_a = oh_hi * ch_a(1, blk)  (masked channel)
-                         acc[a] += chh_a @ oh_lo       (MXU contracts rows)
+                         acc[a] += chh_a @ oh_loT^T    (NT dot_general,
+                                                        MXU contracts rows)
     acc[a, h, j] == sum over rows with gid == h*128+j of channel a.
 
-The 3-way contraction channel×hi-onehot×lo-onehot never materializes the
-full (blk, G) one-hot: VPU builds two small one-hots (~0.3 cycles/row),
-the MXU does the G-wide work. ids are fed twice (column- and row-major)
-because Mosaic cannot relayout lanes→sublanes in-kernel.
+The 3-way contraction channel x hi-onehot x lo-onehot never materializes
+the full (blk, G) one-hot: the VPU builds two small one-hots (~0.3
+cycles/row), the MXU does the G-wide work. Both one-hots keep the row
+index on LANES, so ids stream in once, lane-major ``(n/128, 128)`` — no
+degenerate-dim operand anywhere. (A previous revision fed ids a second
+time as ``(n, 1)``; XLA tiles that layout to (8,128), padding the size-1
+minor dim to 128 lanes — a 128x HBM blowup that OOMed at 100M rows. The
+NT ``dot_general`` — the standard TPU flash-attention contraction — is
+how the row axis gets contracted from a lane-major one-hot.)
 
 Exactness: channels are bf16 *planes* — one-hot(bf16) x plane(bf16)
 products are exact for plane values <= 255, and f32 accumulation over one
@@ -27,13 +33,16 @@ reduce in f64 outside the kernel, and integer recombination happens in
 int64. Float channels use an exact 3-way bf16 split built by bit-masking
 (immune to XLA excess-precision folding of bf16 round-trips), giving
 ~2e-12 relative error on f32 sums — tighter than the f32 scatter path.
+
+HLL register builds run the same kernel in ``rho_mode``: the rho-threshold
+indicator channels are built INSIDE the kernel from a lane-major rho
+operand (4 bytes/row) instead of materializing (nrho, n) bf16 channels in
+HBM (~46 bytes/row — several GB at 100M rows).
 """
 
 from __future__ import annotations
 
 import functools
-
-import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -45,9 +54,12 @@ NINNER = 32             # steps per superblock: 65536 rows (f32-exact bound)
 SUPERBLOCK = BLK * NINNER
 MM_MIN_ROWS = 1 << 17   # below this the scatter path's fixed cost wins
 MAX_CHANNELS = 15       # + the count channel; bounded by VMEM acc size
-MAX_ACC_CELLS = 1 << 19 # A * hpad * 128 f32 cells (2MB VMEM accumulator)
+MAX_ACC_CELLS = 1 << 21 # A * hpad * 128 f32 cells (8MB VMEM accumulator;
+                        # _launch raises the scoped-vmem limit to cover
+                        # acc + double-buffered out block)
 
 _i32 = jnp.int32
+_NT = (((1,), (1,)), ((), ()))  # contract lanes-with-lanes (rows axis)
 
 
 def mm_supported(num_groups: int, n_channels: int) -> bool:
@@ -59,34 +71,81 @@ def _hpad(num_groups: int) -> int:
     return max(8, ((num_groups // 128 + 1 + 7) // 8) * 8)
 
 
-def _kernel(ids_col_ref, ids_row_ref, ch_ref, out_ref, acc_ref,
-            *, ninner, hpad, a_real, blk):
+def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
+            *, ninner, hpad, a_real, blk, rho_mode):
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    ids_c = ids_col_ref[:]                          # (blk, 1) int32
-    ids_r = ids_row_ref[:].reshape(1, blk)          # (blk//128,128)→(1,blk)
-    lo_c = ids_c & 127
+    ids_r = ids_ref[:].reshape(1, blk)              # sublane→lane merge: OK
+    lo_r = ids_r & 127
     hi_r = ids_r >> 7
 
-    jlane = jax.lax.broadcasted_iota(jnp.int32, (blk, 128), 1)
-    oh_lo = jnp.where(lo_c == jlane, jnp.float32(1), jnp.float32(0)) \
+    jsub = jax.lax.broadcasted_iota(jnp.int32, (128, blk), 0)
+    oh_loT = jnp.where(lo_r == jsub, jnp.float32(1), jnp.float32(0)) \
         .astype(jnp.bfloat16)
     hsub = jax.lax.broadcasted_iota(jnp.int32, (hpad, blk), 0)
     oh_hi = jnp.where(hi_r == hsub, jnp.float32(1), jnp.float32(0)) \
         .astype(jnp.bfloat16)
 
+    if rho_mode:
+        rho_r = ch_ref[:].reshape(1, blk)           # lane-major int32 rho
     for a in range(a_real):
-        ch_a = ch_ref[pl.ds(a, 1), :]               # (1, blk) bf16
+        if rho_mode:
+            # channel a = indicator(rho == a+1), built in-VMEM
+            ch_a = jnp.where(rho_r == a + 1, jnp.float32(1), jnp.float32(0)) \
+                .astype(jnp.bfloat16)
+        else:
+            ch_a = ch_ref[pl.ds(a, 1), :]           # (1, blk) bf16
         chh = oh_hi * ch_a
-        acc_ref[a] += jnp.dot(chh, oh_lo, preferred_element_type=jnp.float32)
+        acc_ref[a] += jax.lax.dot_general(
+            chh, oh_loT, _NT, preferred_element_type=jnp.float32
+        )
 
     @pl.when(i == ninner - 1)
     def _():
         out_ref[0] = acc_ref[:]
+
+
+def _launch(ids_lane, ch_operand, ch_spec, *, a_real, hpad, nsuper,
+            rho_mode, interpret):
+    kern = functools.partial(
+        _kernel, ninner=NINNER, hpad=hpad, a_real=a_real, blk=BLK,
+        rho_mode=rho_mode,
+    )
+    # acc scratch + out block are each a_real*hpad*128 f32; the out block is
+    # double-buffered by the pipeline. Default scoped-vmem limit is 16MB —
+    # raise it for large-G accumulators (v5e has 128MB VMEM).
+    acc_bytes = a_real * hpad * 128 * 4
+    vmem_limit = max(16 * 2**20, min(110 * 2**20, 4 * acc_bytes + 8 * 2**20))
+    out = pl.pallas_call(
+        kern,
+        grid=(nsuper, NINNER),
+        in_specs=[
+            pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
+                         memory_space=pltpu.VMEM),
+            ch_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, a_real, hpad, 128),
+            lambda s, i: (s, _i32(0), _i32(0), _i32(0)),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((nsuper, a_real, hpad, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((a_real, hpad, 128), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=vmem_limit),
+        interpret=interpret,
+    )(ids_lane, ch_operand)
+    return jnp.sum(out, axis=0, dtype=jnp.float64)
+
+
+def _pad_ids(gid, num_groups: int, n_pad: int, n: int):
+    ids = jnp.concatenate(
+        [gid.astype(jnp.int32), jnp.full(n_pad - n, num_groups, dtype=jnp.int32)]
+    )
+    return ids.reshape(-1, 128)
 
 
 def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
@@ -102,40 +161,40 @@ def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
     n_pad = ((n + SUPERBLOCK - 1) // SUPERBLOCK) * SUPERBLOCK
     nsuper = n_pad // SUPERBLOCK
 
-    ids = jnp.concatenate(
-        [gid.astype(jnp.int32), jnp.full(n_pad - n, num_groups, dtype=jnp.int32)]
-    )
-    ids_col = ids[:, None]
-    ids_row = ids.reshape(-1, 128)
+    ids_lane = _pad_ids(gid, num_groups, n_pad, n)
     ch = jnp.concatenate(
         [channels, jnp.zeros((a_real, n_pad - n), channels.dtype)], axis=1
     )
-
-    kern = functools.partial(
-        _kernel, ninner=NINNER, hpad=hpad, a_real=a_real, blk=BLK
-    )
-    out = pl.pallas_call(
-        kern,
-        grid=(nsuper, NINNER),
-        in_specs=[
-            pl.BlockSpec((BLK, 1), lambda s, i: (s * NINNER + i, _i32(0)),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((a_real, BLK), lambda s, i: (_i32(0), s * NINNER + i),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, a_real, hpad, 128),
-            lambda s, i: (s, _i32(0), _i32(0), _i32(0)),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((nsuper, a_real, hpad, 128), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((a_real, hpad, 128), jnp.float32)],
-        interpret=interpret,
-    )(ids_col, ids_row, ch)
-    tot = jnp.sum(out, axis=0, dtype=jnp.float64)
+    ch_spec = pl.BlockSpec((a_real, BLK), lambda s, i: (_i32(0), s * NINNER + i),
+                           memory_space=pltpu.VMEM)
+    tot = _launch(ids_lane, ch, ch_spec, a_real=a_real, hpad=hpad,
+                  nsuper=nsuper, rho_mode=False, interpret=interpret)
     return tot.reshape(a_real, hpad * 128)[:, :num_groups]
+
+
+def rho_group_counts(slot, rho, num_groups: int, nrho: int, *,
+                     interpret: bool = False):
+    """counts[r, g] = #rows with slot == g and rho == r+1, r in [0, nrho).
+
+    The nrho indicator channels are built inside the kernel from the
+    lane-major rho operand — nothing rho-shaped ever hits HBM beyond the
+    (n,) int32 itself. Padded rows get rho = 0, matching no channel.
+    Returns (nrho, num_groups) float64 counts.
+    """
+    n = slot.shape[0]
+    hpad = _hpad(num_groups)
+    n_pad = ((n + SUPERBLOCK - 1) // SUPERBLOCK) * SUPERBLOCK
+    nsuper = n_pad // SUPERBLOCK
+
+    ids_lane = _pad_ids(slot, num_groups, n_pad, n)
+    rho_lane = jnp.concatenate(
+        [rho.astype(jnp.int32), jnp.zeros(n_pad - n, dtype=jnp.int32)]
+    ).reshape(-1, 128)
+    rho_spec = pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
+                            memory_space=pltpu.VMEM)
+    tot = _launch(ids_lane, rho_lane, rho_spec, a_real=nrho, hpad=hpad,
+                  nsuper=nsuper, rho_mode=True, interpret=interpret)
+    return tot.reshape(nrho, hpad * 128)[:, :num_groups]
 
 
 # ---------------------------------------------------------------------------
@@ -197,13 +256,7 @@ def hll_registers(slot, rho, num_groups: int, log2m: int, *,
     m = 1 << log2m
     nslots = num_groups * m
     nrho = hll_nrho(log2m)
-    channels = jnp.stack(
-        [
-            jnp.where(rho == r, jnp.float32(1), jnp.float32(0)).astype(jnp.bfloat16)
-            for r in range(1, nrho + 1)
-        ]
-    )
-    counts = group_sums(slot, channels, nslots, interpret=interpret)
+    counts = rho_group_counts(slot, rho, nslots, nrho, interpret=interpret)
     rvals = jnp.arange(1, nrho + 1, dtype=jnp.int32)[:, None]
     regs = jnp.max(jnp.where(counts > 0.5, rvals, 0), axis=0).astype(jnp.int32)
     return regs.reshape(num_groups, m)

@@ -53,13 +53,28 @@ class CheckpointStore:
     def committed(self, table: str, partition: int) -> Optional[dict]:
         return self._state.get(self._key(table, partition))
 
+    def committed_name(self, table: str, partition: int, sequence: int):
+        """Name of the committed segment at ``sequence``, or None if unknown
+        (legacy checkpoint written before names were logged)."""
+        entry = self._state.get(self._key(table, partition))
+        if entry is None:
+            return None
+        return entry.get("names", {}).get(str(sequence))
+
     def record_commit(self, table: str, partition: int, segment_name: str,
                       end_offset: str, sequence: int) -> None:
         with self._lock:
+            prior = self._state.get(self._key(table, partition), {})
+            # full seq→name log (the ZK segment-metadata list analog): restart
+            # reconciliation uses it to tell committed dirs from crash orphans
+            # at ANY sequence, not just the latest
+            names = dict(prior.get("names", {}))
+            names[str(sequence)] = segment_name
             self._state[self._key(table, partition)] = {
                 "segment": segment_name,
                 "offset": end_offset,
                 "sequence": sequence,
+                "names": names,
             }
             tmp = self.path + ".tmp"
             with open(tmp, "w") as f:
@@ -344,10 +359,33 @@ class RealtimeTableDataManager:
         if prior is None:
             return
         committed_seq = prior["sequence"]
+        committed_name = prior["segment"]
         engine_segs = getattr(self.engine_table, "segments", {})
+        cmp_base = 0  # running doc base across sealed segments (commit order)
         for seq, name in self._sealed_on_disk(partition):
             if seq > committed_seq:
                 continue  # sealed dir past the checkpoint: orphan, not committed
+            expected = self.checkpoint.committed_name(
+                self.table_config.table_name, partition, seq
+            )
+            if expected is None and seq == committed_seq:
+                expected = committed_name  # legacy checkpoint without names log
+            if expected is not None and name != expected:
+                # orphan from a crash between seal() and record_commit(): the
+                # later re-consumed committed segment shares this sequence
+                # (names embed a creation timestamp, so they differ), and its
+                # rows are duplicates of the committed one's — quarantine it
+                # so neither this pass nor future restarts publish or replay
+                # it (an orphan at an OLDER sequence would otherwise inflate
+                # cmp_base and make replayed stale rows beat live updates)
+                log.warning("partition %s: quarantining orphan segment %s "
+                            "(committed name at seq %s is %s)",
+                            partition, name, seq, expected)
+                orphans = os.path.join(self.data_dir, "_orphans")
+                os.makedirs(orphans, exist_ok=True)
+                os.replace(os.path.join(self.data_dir, name),
+                           os.path.join(orphans, name))
+                continue
             # Replay must target the instance the engine queries (the
             # valid_docs_mask attaches to the object), not a fresh load.
             existing = engine_segs.get(name)
@@ -360,8 +398,14 @@ class RealtimeTableDataManager:
                 if upsert.comparison_column is not None:
                     cmps = sealed.values(upsert.comparison_column)
                 else:
-                    cmps = range(sealed.n_docs)  # doc order == offset order
+                    # doc order == offset order, but only WITHIN a segment:
+                    # offset the range by the docs replayed so far so a later
+                    # segment's rows compare greater than an earlier one's
+                    # (live ingestion uses the global stream offset, which is
+                    # >= total replayed docs on resume)
+                    cmps = range(cmp_base, cmp_base + sealed.n_docs)
                 upsert.add_segment(sealed, keys, cmps)
+            cmp_base += sealed.n_docs
             if existing is None and (upsert is not None or seq == committed_seq):
                 # non-upsert: only the checkpointed segment can be in the
                 # crash window; earlier ones come from the registry sync

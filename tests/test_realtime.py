@@ -78,13 +78,14 @@ class TestMutableSegment:
         assert seg.values("amount")[0] == make_schema().field("amount").null_value()
 
 
-def _realtime_setup(tmp_path, topic_name, n_partitions=2, flush_rows=200, upsert=False):
+def _realtime_setup(tmp_path, topic_name, n_partitions=2, flush_rows=200, upsert=False,
+                    cmp_col="ts"):
     TopicRegistry.delete(topic_name)
     topic = TopicRegistry.create(topic_name, n_partitions)
     cfg = TableConfig(
         table_name="events",
         table_type=TableType.REALTIME,
-        upsert=UpsertConfig(mode="FULL", comparison_column="ts") if upsert else UpsertConfig(),
+        upsert=UpsertConfig(mode="FULL", comparison_column=cmp_col) if upsert else UpsertConfig(),
         stream=StreamConfig(
             stream_type="memory",
             topic=topic_name,
@@ -279,6 +280,39 @@ class TestUpsert:
         finally:
             mgr2.stop(commit_remaining=False)
 
+    def test_upsert_restart_no_comparison_column(self, tmp_path):
+        """Upsert with no comparison column (arrival order wins) must keep
+        arrival order ACROSS sealed segments on restart: replay uses a
+        running doc base, not per-segment indexes (r2 advisor finding —
+        per-segment range(n_docs) made a later segment's low doc index lose
+        to an earlier segment's high one, flipping SUM from 70 to 1)."""
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert_nocmp", n_partitions=1,
+                                               flush_rows=2, upsert=True, cmp_col=None)
+        mgr.start()
+        topic.publish_json({"user": "a", "action": "1", "amount": 1, "ts": 1})
+        topic.publish_json({"user": "b", "action": "1", "amount": 2, "ts": 1})  # seals S0
+        assert wait_until(lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 1)
+        topic.publish_json({"user": "a", "action": "2", "amount": 70, "ts": 2})
+        topic.publish_json({"user": "c", "action": "1", "amount": 5, "ts": 1})  # seals S1
+        assert wait_until(lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 2)
+        mgr.stop(commit_remaining=False)
+
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(pk=True), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            assert _count(eng2) == 3  # a (later arrival wins), b, c
+            assert _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 70
+            # new stream rows still override the replayed state
+            topic.publish_json({"user": "a", "action": "3", "amount": 500, "ts": 0})
+            assert wait_until(
+                lambda: _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 500
+            )
+        finally:
+            mgr2.stop(commit_remaining=False)
+
     def test_upsert_survives_commit(self, tmp_path):
         topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_upsert3", n_partitions=1,
                                                flush_rows=3, upsert=True)
@@ -316,6 +350,90 @@ def _total(eng, sql):
 def _total_indexed(mgr):
     """Docs in the current consuming segments (tests using this don't flush)."""
     return sum(m.segment.n_docs for m in mgr.partition_managers.values())
+
+
+class TestOrphanSegments:
+    def test_same_sequence_orphan_quarantined(self, tmp_path):
+        """A crash between seal() and record_commit() leaves a sealed dir
+        that shares its sequence with the later re-consumed committed
+        segment (names embed creation time, so they differ). Restart must
+        publish only the checkpoint-named segment and quarantine the orphan
+        — publishing both doubles every count (r2 advisor finding)."""
+        import shutil
+
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_orphan", n_partitions=1,
+                                               flush_rows=100)
+        mgr.start()
+        for i in range(150):
+            topic.publish_json({"user": f"u{i % 5}", "action": "a", "amount": 1, "ts": i})
+        assert wait_until(lambda: _count(eng) == 150)
+        mgr.stop(commit_remaining=True)
+
+        # forge the orphan: same table/partition/sequence as the last commit,
+        # different creation timestamp
+        rt = tmp_path / "rt"
+        import json as _json
+
+        ckpt = _json.load(open(rt / "checkpoints.json"))
+        committed = ckpt["events/0"]["segment"]
+        seq = committed.split("__")[2]
+        orphan = f"events__0__{seq}__19990101T000000Z"
+        shutil.copytree(rt / committed, rt / orphan)
+
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            # only the committed segment is published — no doubled rows
+            assert 0 < _count(eng2) <= 150
+            assert not (rt / orphan).exists()
+            assert (rt / "_orphans" / orphan).exists()
+        finally:
+            mgr2.stop(commit_remaining=False)
+
+
+    def test_older_sequence_orphan_quarantined(self, tmp_path):
+        """An orphan whose sequence has been PASSED by later commits must
+        still be quarantined on a later restart — the checkpoint's seq→name
+        log identifies it (code-review finding: without the log, an old
+        orphan was replayed, inflating cmp_base past the resume offset so
+        live upsert updates lost to stale replayed rows)."""
+        import shutil
+
+        topic, cfg, eng, mgr = _realtime_setup(tmp_path, "t_orphan2", n_partitions=1,
+                                               flush_rows=50)
+        mgr.start()
+        for wave in range(3):  # one ≥50-row commit per wave (flush is per fetch)
+            for i in range(60):
+                topic.publish_json({"user": f"u{i % 5}", "action": "a",
+                                    "amount": 1, "ts": wave * 60 + i})
+            assert wait_until(
+                lambda: sum(m.commits for m in mgr.partition_managers.values()) >= wave + 1
+            )
+        mgr.stop(commit_remaining=True)
+
+        rt = tmp_path / "rt"
+        import json as _json
+
+        ckpt = _json.load(open(rt / "checkpoints.json"))
+        names = ckpt["events/0"]["names"]
+        committed_at_1 = names["1"]
+        orphan = f"events__0__1__19990101T000000Z"  # old seq, unknown name
+        shutil.copytree(rt / committed_at_1, rt / orphan)
+
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            assert 0 < _count(eng2) <= 180
+            assert not (rt / orphan).exists()
+            assert (rt / "_orphans" / orphan).exists()
+        finally:
+            mgr2.stop(commit_remaining=False)
 
 
 class TestUpsertRestart:
