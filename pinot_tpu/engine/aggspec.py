@@ -28,6 +28,10 @@ class AggSpec:
     """Base: subclasses define the state algebra."""
 
     name: str = ""
+    # MV specs take ONE arg evaluated as an (entry_values, per_doc_lens)
+    # pair (the executor's eval_mv form) instead of per-doc value arrays
+    mv: bool = False
+
     # which select-time arg expressions need evaluating over filtered rows
     def __init__(self, expr: Expression):
         self.expr = expr
@@ -358,6 +362,74 @@ class FirstLastWithTimeSpec(AggSpec):
         return part["val"]
 
 
+class _MVEntrySpec(AggSpec):
+    """Shared shape for MV aggregations that fold per-entry values: expand
+    the group index per entry and delegate to the SV spec's state algebra
+    (reference: SumMVAggregationFunction et al. iterate getDictIdMV)."""
+
+    mv = True
+    sv_base: type = None  # parent SV spec class
+
+    def host_groups(self, arg_values, group_idx, n):
+        vals, lens = arg_values[0]
+        g = np.repeat(group_idx, lens)
+        return self.sv_base.host_groups(self, [vals], g, n)
+
+
+class SumMVSpec(_MVEntrySpec, SumSpec):
+    name = "summv"
+    sv_base = SumSpec
+
+
+class MinMVSpec(_MVEntrySpec, MinSpec):
+    name = "minmv"
+    sv_base = MinSpec
+
+
+class MaxMVSpec(_MVEntrySpec, MaxSpec):
+    name = "maxmv"
+    sv_base = MaxSpec
+
+
+class AvgMVSpec(_MVEntrySpec, AvgSpec):
+    name = "avgmv"
+    sv_base = AvgSpec
+
+
+class DistinctCountMVSpec(_MVEntrySpec, DistinctCountSpec):
+    name = "distinctcountmv"
+    sv_base = DistinctCountSpec
+
+
+class CountMVSpec(AggSpec):
+    """COUNTMV: total MV entries per group (not docs)."""
+
+    name = "countmv"
+    mv = True
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr)
+        self.args = expr.args[:1]
+
+    def host_groups(self, arg_values, group_idx, n):
+        _, lens = arg_values[0]
+        c = np.zeros(n, dtype=np.int64)
+        np.add.at(c, group_idx, lens)
+        return {"count": c}
+
+    def empty(self, n):
+        return {"count": np.zeros(n, dtype=np.int64)}
+
+    def scatter_merge(self, acc, idx, part):
+        np.add.at(acc["count"], idx, part["count"])
+
+    def finalize(self, part):
+        return part["count"]
+
+    def result_type(self):
+        return "LONG"
+
+
 _SPECS = {
     "count": CountSpec,
     "sum": SumSpec,
@@ -373,6 +445,12 @@ _SPECS = {
     "percentileest": PercentileSpec,
     "percentiletdigest": PercentileSpec,
     "mode": ModeSpec,
+    "summv": SumMVSpec,
+    "minmv": MinMVSpec,
+    "maxmv": MaxMVSpec,
+    "avgmv": AvgMVSpec,
+    "countmv": CountMVSpec,
+    "distinctcountmv": DistinctCountMVSpec,
 }
 
 

@@ -53,12 +53,18 @@ def encode_error(kind: str, message: str) -> bytes:
 def _flatten_obj(name: str, arr: np.ndarray, arrays: dict, meta: dict) -> None:
     """Object array of sets/lists/dicts → (concat values, offsets)."""
     first = next((x for x in arr if x is not None), None)
-    if isinstance(first, set) or isinstance(first, list) or first is None:
+    if isinstance(first, (set, list, np.ndarray)) or first is None:
+        # ndarray rows are MV selection cells; they round-trip as lists
         kind = "set" if isinstance(first, set) else "list"
         offsets = np.zeros(len(arr) + 1, dtype=np.int64)
         chunks = []
         for i, x in enumerate(arr):
-            vals = sorted(x) if isinstance(x, set) else list(x or ())
+            if isinstance(x, set):
+                vals = sorted(x)
+            elif x is None:
+                vals = []
+            else:
+                vals = list(x)
             chunks.append(np.asarray(vals))
             offsets[i + 1] = offsets[i] + len(vals)
         concat = (
@@ -141,7 +147,11 @@ def encode(result: IntermediateResult) -> bytes:
     if result.rows is not None:
         meta["row_keys"] = [str(k) for k in result.rows]
         for k, v in result.rows.items():
-            arrays[f"row__{k}"] = np.asarray(v)
+            v = np.asarray(v)
+            if v.dtype == object:  # MV selection column → (values, offsets)
+                _flatten_obj(f"row__{k}", v, arrays, meta["objects"])
+            else:
+                arrays[f"row__{k}"] = v
 
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -187,7 +197,14 @@ def decode(data: bytes) -> IntermediateResult:
         for k in meta["row_keys"]:
             # selection row keys are select-position ints or "__ob{j}" strings
             key = int(k) if k.lstrip("-").isdigit() else k
-            rows[key] = arrays[f"row__{k}"]
+            slot = f"row__{k}"
+            if slot in meta["objects"]:
+                lists = _unflatten_obj(slot, meta["objects"][slot], arrays)
+                for i in range(len(lists)):
+                    lists[i] = np.asarray(lists[i])
+                rows[key] = lists
+            else:
+                rows[key] = arrays[slot]
 
     return IntermediateResult(
         meta["shape"],

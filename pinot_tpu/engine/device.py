@@ -101,6 +101,12 @@ def _eval_filter(tpl, cols, params, shape):
         return m
     if kind == "not":
         return ~_eval_filter(tpl[1], cols, params, shape)
+    if kind == "mv_any":
+        # per-entry mask over the (S, L, K) id block, -1 padding masked out,
+        # reduced match-any over K (ForwardIndexReader.getDictIdMV semantics)
+        ids = cols[tpl[1]]
+        m = _eval_filter(tpl[2], cols, params, ids.shape)
+        return jnp.any(m & (ids >= 0), axis=-1)
     if kind == "eq_dict":
         return mask_ops.eq_dict(cols[tpl[1]], params[tpl[2]])
     if kind == "in_dict":
@@ -253,7 +259,7 @@ def build_pipeline(template, mm_mode: str = "auto"):
 
     def pipeline(cols, n_docs, params):
         any_col = next(iter(cols.values()))
-        sl = any_col.shape
+        sl = any_col.shape[:2]  # MV blocks are (S, L, K); masks are (S, L)
         valid = mask_ops.valid_mask(n_docs, sl[1], batched=True)
         mask = _eval_filter(filter_tpl, cols, params, sl) & valid
         seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)  # (S,) for stats
@@ -511,6 +517,8 @@ class DeviceExecutor:
                 cols[c] = ctx.decoded_column(c[4:])
             elif c.startswith("hh::"):
                 cols[c] = ctx.prehashed_column(c[4:])
+            elif c.startswith("mv::"):
+                cols[c] = ctx.mv_column(c[4:])
             else:
                 cols[c] = ctx.column(c)
         if not cols:  # COUNT(*) with no filter: still need one column for shape
@@ -545,7 +553,7 @@ class DeviceExecutor:
             if t[0] == "dictval":
                 out.add("dv::" + t[1])
                 return
-            if t[0] in ("eq_dict", "in_dict", "range_dict", "lut_dict"):
+            if t[0] in ("eq_dict", "in_dict", "range_dict", "lut_dict", "mv_any"):
                 out.add(t[1])
             for x in t[1:]:
                 walk(x)

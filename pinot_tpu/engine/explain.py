@@ -10,17 +10,22 @@ from __future__ import annotations
 from pinot_tpu.query.context import FilterNode, FilterNodeType, QueryContext
 
 
-def _filter_lines(f: FilterNode, depth: int, out: list) -> None:
+def _filter_lines(f: FilterNode, depth: int, out: list, seg=None) -> None:
     pad = "  " * depth
     if f.type is FilterNodeType.PREDICATE:
-        out.append(f"{pad}FILTER_PREDICATE({f.predicate})")
+        op = "PREDICATE"
+        if seg is not None:
+            from pinot_tpu.engine.host import filter_operator_for
+
+            op = filter_operator_for(seg, f.predicate)
+        out.append(f"{pad}FILTER_{op}({f.predicate})")
         return
     if f.type in (FilterNodeType.CONSTANT_TRUE, FilterNodeType.CONSTANT_FALSE):
         out.append(f"{pad}FILTER_{f.type.value}")
         return
     out.append(f"{pad}FILTER_{f.type.value}")
     for c in f.children:
-        _filter_lines(c, depth + 1, out)
+        _filter_lines(c, depth + 1, out, seg)
 
 
 def explain_plan(engine, q: QueryContext) -> dict:
@@ -46,7 +51,13 @@ def explain_plan(engine, q: QueryContext) -> dict:
     if q.group_by:
         lines.append(f"    GROUP_BY({', '.join(str(g) for g in q.group_by)})")
     if q.filter is not None:
-        _filter_lines(q.filter, 2, lines)
+        # index choice is per-segment; EXPLAIN (like the reference's
+        # non-verbose mode) describes it against one representative segment
+        seg = None
+        tdm = engine.tables.get(q.table_name)
+        if tdm is not None and tdm.segments:
+            seg = next(iter(tdm.segments.values()))
+        _filter_lines(q.filter, 2, lines, seg)
     else:
         lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
     lines.append("    PROJECT(" + ", ".join(sorted(q.columns())) + ")")

@@ -47,6 +47,31 @@ def like_to_regex(pattern: str) -> str:
     return "^" + "".join(out) + "$"
 
 
+INVERTED_MAX_IDS = 64  # above this, slicing doc-lists loses to the LUT scan
+
+
+def filter_operator_for(seg, p: Predicate) -> str:
+    """Which filter operator a predicate gets on this segment — the
+    index-priority ordering of FilterOperatorUtils.java:165-194 (sorted >
+    inverted > scan), shared by the evaluator and EXPLAIN."""
+    lhs = p.lhs
+    if not (lhs.is_identifier and lhs.name in seg.metadata.columns):
+        return "FULL_SCAN"
+    meta = seg.column_metadata(lhs.name)
+    if meta.encoding != Encoding.DICT or not meta.single_value or \
+            p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+        return "FULL_SCAN"
+    if meta.is_sorted and p.type in (
+        PredicateType.EQ, PredicateType.IN, PredicateType.RANGE
+    ):
+        return "SORTED_INDEX"
+    if meta.has_inverted and p.type in (
+        PredicateType.EQ, PredicateType.IN, PredicateType.RANGE
+    ):
+        return "INVERTED_INDEX"
+    return "FULL_SCAN"
+
+
 class SegmentEvaluator:
     """Evaluates expressions / filters over one segment in value space."""
 
@@ -57,6 +82,10 @@ class SegmentEvaluator:
         # (MutableSegmentImpl volatile counter analog)
         self.n = segment.n_docs
         self._cache: dict = {}
+        # entries actually read while filtering: index-served predicates add
+        # 0, scans add n (reference: numEntriesScannedInFilter is 0 when the
+        # filter is fully index-resolved)
+        self.entries_scanned_in_filter = 0
 
     def n_docs(self) -> int:
         return self.n
@@ -116,23 +145,122 @@ class SegmentEvaluator:
             return ~self.filter_mask(f.children[0])
         return self.predicate_mask(f.predicate)
 
+    # ---- multi-value access ---------------------------------------------
+    def mv_parts(self, col: str):
+        """(flat, lens, dictionary_or_None) snapshot for an MV column —
+        ``flat`` is dict ids when a dictionary exists, else raw values.
+        The vectorized MV read path (FixedBitMVForwardIndexReader analog)."""
+        seg = self.seg
+        meta = seg.column_metadata(col)
+        if hasattr(seg, "mv_offsets") and not getattr(seg, "is_mutable", False):
+            off = np.asarray(seg.mv_offsets(col))[: self.n + 1]
+            flat = np.asarray(seg.forward(col))[: off[-1]]
+            return flat, np.diff(off), seg.dictionary(col)
+        rows = seg.values(col)[: self.n]
+        lens = np.fromiter((len(r) for r in rows), dtype=np.int64, count=len(rows))
+        if lens.sum():
+            flat = np.concatenate([np.asarray(r) for r in rows if len(r)])
+        else:
+            flat = np.empty(0, dtype=meta.data_type.np_dtype)
+        return flat, lens, None
+
+    def eval_mv(self, expr: Expression, doc_idx: np.ndarray):
+        """(entry_values, per_doc_lens) of an MV column over doc_idx — the
+        arg form MV aggregation specs consume."""
+        if not expr.is_identifier:
+            raise NotImplementedError("MV aggregations take a bare MV column")
+        flat, lens, d = self.mv_parts(expr.name)
+        off = np.zeros(len(lens) + 1, dtype=np.int64)
+        np.cumsum(lens, out=off[1:])
+        dl = lens[doc_idx]
+        vals = flat[concat_ranges(off[doc_idx], dl)]
+        if d is not None:
+            vals = d.take(vals)
+        return vals, dl
+
+    def _mv_predicate_mask(self, col: str, p: Predicate) -> np.ndarray:
+        """Match-any semantics: a doc matches if ANY of its entries satisfies
+        the predicate (reference per-entry ValueMatcher / aggregateGroupByMV
+        contract)."""
+        flat, lens, d = self.mv_parts(col)
+        self.entries_scanned_in_filter += int(lens.sum())
+        if d is not None:
+            lut = self._predicate_over_values(p, d.values)
+            per_entry = lut[flat]
+        elif len(flat):
+            per_entry = self._predicate_over_values(p, np.asarray(flat))
+        else:
+            per_entry = np.zeros(0, dtype=bool)
+        mask = np.zeros(self.n, dtype=bool)
+        nz = lens > 0
+        if nz.any():
+            off = np.zeros(self.n + 1, dtype=np.int64)
+            np.cumsum(lens, out=off[1:])
+            starts = off[:-1][nz]
+            mask[nz] = np.logical_or.reduceat(per_entry, starts)
+        return mask
+
     def predicate_mask(self, p: Predicate) -> np.ndarray:
         lhs = p.lhs
         # dictionary-space fast path
         if lhs.is_identifier and lhs.name in self.seg.metadata.columns:
             meta = self.seg.column_metadata(lhs.name)
+            if not meta.single_value and \
+                    p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+                return self._mv_predicate_mask(lhs.name, p)
             if meta.encoding == Encoding.DICT and meta.single_value and \
                     p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
                 d = self.seg.dictionary(lhs.name)
                 lut = self._predicate_over_values(p, d.values)
+                m = self._indexed_mask(lhs.name, meta, p, np.nonzero(lut)[0])
+                if m is not None:
+                    return m
+                self.entries_scanned_in_filter += self.n
                 fwd = np.asarray(self.seg.forward(lhs.name))[: self.n]
                 return lut[fwd]
         if p.type is PredicateType.IS_NULL:
             return np.zeros(self.n, dtype=bool)  # nulls: see creator
         if p.type is PredicateType.IS_NOT_NULL:
             return np.ones(self.n, dtype=bool)
+        self.entries_scanned_in_filter += self.n
         values = self.eval(lhs)
         return self._predicate_over_values(p, np.asarray(values))
+
+    def _indexed_mask(self, col: str, meta, p: Predicate, ids: np.ndarray):
+        """Index-served mask for a dict predicate whose matching dict ids are
+        ``ids``, or None → caller scans. Priority mirrors
+        FilterOperatorUtils.java:165-194: sorted column (binary-search doc
+        runs, O(k log n)) beats inverted (doc-list slices, O(matched docs))
+        beats the O(n) forward-index scan."""
+        op = filter_operator_for(self.seg, p)
+        if op == "SORTED_INDEX":
+            mask = np.zeros(self.n, dtype=bool)
+            if len(ids) == 0:
+                return mask
+            fwd = self.seg.forward(col)  # mmap; searchsorted touches O(log n)
+            contiguous = ids[-1] - ids[0] + 1 == len(ids)
+            if contiguous:
+                lo = np.searchsorted(fwd[: self.n], ids[0], "left")
+                hi = np.searchsorted(fwd[: self.n], ids[-1], "right")
+                mask[lo:hi] = True
+            else:
+                if len(ids) > INVERTED_MAX_IDS:
+                    return None
+                for i in ids:
+                    lo = np.searchsorted(fwd[: self.n], i, "left")
+                    hi = np.searchsorted(fwd[: self.n], i, "right")
+                    mask[lo:hi] = True
+            return mask
+        if op == "INVERTED_INDEX" and len(ids) <= INVERTED_MAX_IDS:
+            inv = self.seg.inverted(col)
+            if inv is None:
+                return None
+            docs, off = inv
+            mask = np.zeros(self.n, dtype=bool)
+            for i in ids:
+                mask[docs[off[i]: off[i + 1]]] = True
+            return mask
+        return None
 
     def _predicate_over_values(self, p: Predicate, v: np.ndarray) -> np.ndarray:
         t = p.type
@@ -173,6 +301,19 @@ class SegmentEvaluator:
         if v.dtype.kind in ("U", "S"):
             return np.asarray([str(x) for x in values])
         return np.asarray(list(values))
+
+
+def concat_ranges(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Vectorized concatenation of [starts[i], starts[i]+lens[i]) ranges."""
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    cum = np.cumsum(lens) - lens  # output start of each range
+    return (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(cum, lens)
+        + np.repeat(starts.astype(np.int64), lens)
+    )
 
 
 def factorize_multi(cols: list) -> tuple:
@@ -228,7 +369,8 @@ class HostExecutor:
         doc_idx = np.nonzero(mask)[0]
         stats.num_docs_scanned = int(len(doc_idx))
         if q.filter is not None:
-            stats.num_entries_scanned_in_filter = ev.n * len(q.filter.columns())
+            # actual entries read: 0 for fully index-served filters
+            stats.num_entries_scanned_in_filter = ev.entries_scanned_in_filter
         if len(doc_idx) > 0:
             stats.num_segments_matched = 1
 
@@ -242,18 +384,71 @@ class HostExecutor:
         return self._selection(q, ev, doc_idx, stats)
 
     # ---- shapes ----------------------------------------------------------
+    @staticmethod
+    def _agg_partial(spec, ev, doc_idx, group_idx, n_groups, stats):
+        """One spec's partial over the matched docs; MV specs get the
+        (entry_values, lens) arg form."""
+        if spec.mv:
+            vals, lens = ev.eval_mv(spec.args[0], doc_idx)
+            stats.num_entries_scanned_post_filter += int(lens.sum())
+            return spec.host_groups([(vals, lens)], group_idx, n_groups)
+        arg_values = [ev.eval(arg, doc_idx) for arg in spec.args]
+        stats.num_entries_scanned_post_filter += len(doc_idx) * len(spec.args)
+        return spec.host_groups(arg_values, group_idx, n_groups)
+
     def _aggregation(self, q, ev, doc_idx, stats, aggs) -> IntermediateResult:
         partials = []
         idx = np.zeros(len(doc_idx), dtype=np.int64)
         for a in aggs:
             spec = aggspec.make_spec(a)
-            arg_values = [ev.eval(arg, doc_idx) for arg in spec.args]
-            partials.append(spec.host_groups(arg_values, idx, 1))
-            stats.num_entries_scanned_post_filter += len(doc_idx) * len(spec.args)
+            partials.append(self._agg_partial(spec, ev, doc_idx, idx, 1, stats))
         return IntermediateResult("aggregation", agg_partials=partials, stats=stats)
 
+    @staticmethod
+    def _expand_mv_groups(ev, group_exprs, doc_idx):
+        """Expand matched docs so each doc contributes one row per MV entry
+        of each MV group-by column (cartesian across MV columns — the
+        reference's aggregateGroupByMV per-entry group keys).
+
+        Returns (rep, mv_vals): ``rep`` maps expanded rows → positions in
+        doc_idx; ``mv_vals[gi]`` holds the expanded entry values for MV
+        group expression gi."""
+        rep = np.arange(len(doc_idx))
+        mv_vals: dict = {}
+        for gi, g in enumerate(group_exprs):
+            if not (g.is_identifier and g.name in ev.seg.metadata.columns
+                    and not ev.seg.column_metadata(g.name).single_value):
+                continue
+            flat, lens, d = ev.mv_parts(g.name)
+            off = np.zeros(len(lens) + 1, dtype=np.int64)
+            np.cumsum(lens, out=off[1:])
+            docs = doc_idx[rep]
+            dl = lens[docs]
+            vals = flat[concat_ranges(off[docs], dl)]
+            if d is not None:
+                vals = d.take(vals)
+            newrep = np.repeat(np.arange(len(rep)), dl)
+            for k in mv_vals:
+                mv_vals[k] = mv_vals[k][newrep]
+            mv_vals[gi] = vals
+            rep = rep[newrep]
+        return rep, mv_vals
+
     def _group_by(self, q, ev, doc_idx, stats, aggs) -> IntermediateResult:
-        key_cols = [ev.eval(g, doc_idx) for g in q.group_by]
+        has_mv = any(
+            g.is_identifier and g.name in ev.seg.metadata.columns
+            and not ev.seg.column_metadata(g.name).single_value
+            for g in q.group_by
+        )
+        if has_mv:
+            rep, mv_vals = self._expand_mv_groups(ev, q.group_by, doc_idx)
+            doc_idx = doc_idx[rep]
+            key_cols = [
+                mv_vals[gi] if gi in mv_vals else ev.eval(g, doc_idx)
+                for gi, g in enumerate(q.group_by)
+            ]
+        else:
+            key_cols = [ev.eval(g, doc_idx) for g in q.group_by]
         if len(doc_idx) == 0:
             empty_keys = tuple(np.asarray(k)[:0] for k in key_cols)
             specs = [aggspec.make_spec(a) for a in aggs]
@@ -278,9 +473,7 @@ class HostExecutor:
         partials = []
         for a in aggs:
             spec = aggspec.make_spec(a)
-            arg_values = [ev.eval(arg, doc_idx) for arg in spec.args]
-            partials.append(spec.host_groups(arg_values, ginv, n_groups))
-            stats.num_entries_scanned_post_filter += len(doc_idx) * len(spec.args)
+            partials.append(self._agg_partial(spec, ev, doc_idx, ginv, n_groups, stats))
         return IntermediateResult(
             "group_by", group_keys=keys, agg_partials=partials, stats=stats
         )

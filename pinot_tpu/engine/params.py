@@ -55,6 +55,8 @@ _NUMERIC_KINDS = ("i", "u", "f")
 class BatchContext:
     """Host+device state for one batch of segments (cached per segment set)."""
 
+    MAX_MV_K = 16  # (S, L, K) id blocks cost K x an SV column of HBM
+
     def __init__(self, segments: list, pad_multiple: int = 1024):
         self.segments = list(segments)
         self.pad_to = max(padded_len(s.n_docs, pad_multiple) for s in self.segments)
@@ -66,6 +68,7 @@ class BatchContext:
         self._global_dicts: dict[str, Dictionary] = {}
         self._decoded: dict[str, object] = {}       # name -> (S, L) decoded values
         self._prehashed: dict[str, object] = {}     # name -> (S, L) value hashes
+        self._mv_columns: dict[str, object] = {}    # name -> (S, L, K) id blocks
 
     # ---- column access ---------------------------------------------------
     def column_meta(self, name: str):
@@ -88,6 +91,46 @@ class BatchContext:
                 raise DeviceUnsupported(f"multi-value column {name}")
             self._encodings[name] = enc
         return self._encodings[name]
+
+    def is_mv(self, name: str) -> bool:
+        for s in self.segments:
+            if name not in s.metadata.columns:
+                raise DeviceUnsupported(f"column {name} missing from {s.name}")
+            if s.column_metadata(name).single_value:
+                return False
+        return True
+
+    def mv_column(self, name: str):
+        """(S, L, K) device array of GLOBAL dict ids for an MV column,
+        entries padded with -1 (K = batch max entries per doc). The device
+        form of getDictIdMV (ForwardIndexReader.java:99) — predicates
+        evaluate per entry and reduce match-any over K."""
+        if name not in self._mv_columns:
+            metas = [s.column_metadata(name) for s in self.segments]
+            if any(m.encoding != Encoding.DICT for m in metas):
+                raise DeviceUnsupported(f"raw MV column {name} on device")
+            K = max(m.max_mv_entries for m in metas)
+            if K == 0 or K > self.MAX_MV_K:
+                raise DeviceUnsupported(
+                    f"MV column {name} has up to {K} entries/doc (cap {self.MAX_MV_K})"
+                )
+            gdict = self.global_dict(name)
+            blocks = np.full((self.S, self.pad_to, K), -1, dtype=np.int32)
+            for i, s in enumerate(self.segments):
+                d = s.dictionary(name)
+                remap = np.searchsorted(
+                    gdict.values, np.asarray(d.values)
+                ).astype(np.int32)
+                fwd = np.asarray(s.forward(name))
+                off = np.asarray(s.mv_offsets(name))
+                lens = np.diff(off)
+                doc_of_entry = np.repeat(
+                    np.arange(len(lens), dtype=np.int64), lens
+                )
+                rank = np.arange(len(fwd), dtype=np.int64) - np.repeat(off[:-1], lens)
+                blocks[i, doc_of_entry, rank] = remap[fwd]
+            self._mv_columns[name] = jnp.asarray(blocks)
+        return self._mv_columns[name]
 
     def column(self, name: str):
         """(S, L) device array: **global** dict ids (DICT, pad -1) or raw
@@ -175,7 +218,7 @@ class BatchContext:
         """HBM resident bytes of materialized column blocks (columns +
         decoded + prehashed) — the executor's byte-aware LRU eviction key."""
         total = 0
-        for d in (self._columns, self._decoded, self._prehashed):
+        for d in (self._columns, self._decoded, self._prehashed, self._mv_columns):
             for arr in d.values():
                 total += getattr(arr, "nbytes", 0)
         return total
@@ -243,6 +286,14 @@ def build_predicate(p: Predicate, ctx: BatchContext, params: dict, counter: list
         raise DeviceUnsupported(f"predicate {p.type} not device-supported")
     lhs = p.lhs
     if lhs.is_identifier:
+        if ctx.is_mv(lhs.name):
+            # match-any over the (S, L, K) id block: the inner template is
+            # the ordinary dict predicate evaluated per entry; mv_any reduces
+            # over K with -1 padding masked out (NOT_EQ's inner "not" stays
+            # per-entry — reference MV semantics: ANY entry != value)
+            ctx.mv_column(lhs.name)  # validates dict encoding + K cap
+            tpl = _dict_predicate(p, ctx, params, counter, col_key="mv::" + lhs.name)
+            return ("mv_any", "mv::" + lhs.name, tpl)
         enc = ctx.encoding(lhs.name)
         if enc == Encoding.DICT:
             return _dict_predicate(p, ctx, params, counter)
@@ -251,9 +302,10 @@ def build_predicate(p: Predicate, ctx: BatchContext, params: dict, counter: list
     return _raw_predicate(p, lhs, ctx, params, counter)
 
 
-def _dict_predicate(p: Predicate, ctx: BatchContext, params: dict, counter: list):
-    col = p.lhs.name
-    gdict = ctx.global_dict(col)
+def _dict_predicate(p: Predicate, ctx: BatchContext, params: dict, counter: list,
+                    col_key: str = None):
+    col = col_key or p.lhs.name
+    gdict = ctx.global_dict(p.lhs.name)
     t = p.type
     if t in (PredicateType.EQ, PredicateType.NOT_EQ):
         gid = gdict.index_of(p.value)

@@ -77,9 +77,12 @@ class ResultTable:
 
 
 def py_value(v):
-    """numpy scalar → python value for the JSON layer."""
+    """numpy scalar → python value for the JSON layer. MV cells (per-doc
+    arrays) become JSON lists, the reference's MV response shape."""
     if isinstance(v, np.generic):
         return v.item()
     if isinstance(v, bytes):
         return v.hex()
+    if isinstance(v, np.ndarray):
+        return v.tolist()
     return v

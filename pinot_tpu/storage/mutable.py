@@ -32,10 +32,14 @@ _INITIAL_CAPACITY = 4096
 class MutableColumn:
     def __init__(self, spec):
         self.spec = spec
+        self.single_value = spec.single_value
+        self.dict_encoded = spec.data_type.is_string_like and spec.single_value
         if not spec.single_value:
-            raise NotImplementedError("multi-value columns in mutable segments")
-        self.dict_encoded = spec.data_type.is_string_like
-        if self.dict_encoded:
+            # MV: per-row value arrays in a grow-only list (host scan path;
+            # sealing re-encodes through the creator's flatten+offsets pass)
+            self._rows: list = []
+            self.total_entries = 0
+        elif self.dict_encoded:
             self._dict: dict = {}
             self._dict_values: list = []
             self._data = np.empty(_INITIAL_CAPACITY, dtype=np.int32)
@@ -50,7 +54,26 @@ class MutableColumn:
             new[: len(self._data)] = self._data
             self._data = new
 
+    def _track(self, v) -> None:
+        if self.min_value is None or v < self.min_value:
+            self.min_value = v
+        if self.max_value is None or v > self.max_value:
+            self.max_value = v
+
     def append(self, value, row_idx: int) -> None:
+        if not self.single_value:
+            dt = self.spec.data_type
+            entries = value if isinstance(value, (list, tuple, np.ndarray)) \
+                else [value]
+            if dt.is_string_like:
+                row = np.asarray([str(v) for v in entries], dtype=np.str_)
+            else:
+                row = np.asarray([dt.convert(v) for v in entries], dtype=dt.np_dtype)
+            self._rows.append(row)
+            self.total_entries += len(row)
+            for v in row.tolist():
+                self._track(v)
+            return
         self._grow(row_idx)
         if self.dict_encoded:
             v = str(value) if self.spec.data_type is not DataType.BYTES else bytes(value)
@@ -63,13 +86,17 @@ class MutableColumn:
         else:
             v = self.spec.data_type.convert(value)
             self._data[row_idx] = v
-        if self.min_value is None or v < self.min_value:
-            self.min_value = v
-        if self.max_value is None or v > self.max_value:
-            self.max_value = v
+        self._track(v)
 
     def values(self, n: int) -> np.ndarray:
-        """Decoded raw values for the first n docs (reader snapshot)."""
+        """Decoded raw values for the first n docs (reader snapshot); MV
+        columns return an object array of per-row arrays."""
+        if not self.single_value:
+            out = np.empty(n, dtype=object)
+            rows = self._rows  # grow-only list: indexes < n are stable
+            for i in range(n):
+                out[i] = rows[i]
+            return out
         if self.dict_encoded:
             # snapshot the dict list first: it only appends
             table = np.asarray(self._dict_values[:])
@@ -162,9 +189,11 @@ class MutableSegment:
             min_value=c.min_value,
             max_value=c.max_value,
             is_sorted=False,
-            single_value=True,
+            single_value=c.single_value,
             has_dictionary=False,
-            total_number_of_entries=self._count,
+            total_number_of_entries=(
+                self._count if c.single_value else c.total_entries
+            ),
         )
 
     def dictionary(self, col: str):

@@ -198,7 +198,23 @@ class ImmutableSegment:
 
     # ---- raw value access (host-side materialization) -------------------
     def values(self, col: str) -> np.ndarray:
-        """Decoded raw values for the whole column (host path only)."""
+        """Decoded raw values for the whole column (host path only).
+        Multi-value columns return an object array of per-doc value arrays
+        (ForwardIndexReader.java:99 getDictIdMV analog)."""
+        meta = self.column_metadata(col)
+        flat = self.flat_values(col)
+        if meta.single_value:
+            return flat
+        off = np.asarray(self.mv_offsets(col))
+        out = np.empty(self.n_docs, dtype=object)
+        for i in range(self.n_docs):
+            out[i] = flat[off[i]: off[i + 1]]
+        return out
+
+    def flat_values(self, col: str) -> np.ndarray:
+        """Decoded values in entry order: (n_docs,) for SV, (total_entries,)
+        for MV (pair with ``mv_offsets``). The vectorized MV access path —
+        ``values()``'s per-doc object array is for row materialization only."""
         meta = self.column_metadata(col)
         fwd = self.forward(col)
         if meta.encoding == Encoding.DICT:

@@ -1,0 +1,132 @@
+"""Index-served filter paths: sorted-column binary search, inverted-index
+doc lists, and their equivalence with the full-scan path.
+
+Reference analogs: SortedIndexBasedFilterOperator, BitmapBasedFilterOperator,
+and the index-priority ordering in FilterOperatorUtils.java:165-194.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.engine.host import filter_operator_for
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+
+@pytest.fixture(scope="module")
+def segs(tmp_path_factory):
+    """Two segments: one sorted by `k`, one unsorted with an inverted index
+    on `v`."""
+    base = tmp_path_factory.mktemp("fidx")
+    schema = Schema.build(
+        name="t",
+        dimensions=[("k", DataType.INT), ("v", DataType.STRING)],
+        metrics=[("m", DataType.INT)],
+    )
+    cfg = TableConfig(
+        table_name="t",
+        indexing=IndexingConfig(inverted_index_columns=["v"]),
+    )
+    rng = np.random.default_rng(9)
+    n = 20_000
+    sorted_cols = {
+        "k": np.sort(rng.integers(0, 500, n)).astype(np.int32),
+        "v": np.array([f"s{j:02d}" for j in rng.integers(0, 40, n)]),
+        "m": rng.integers(0, 100, n).astype(np.int32),
+    }
+    unsorted_cols = {
+        "k": rng.integers(0, 500, n).astype(np.int32),
+        "v": np.array([f"s{j:02d}" for j in rng.integers(0, 40, n)]),
+        "m": rng.integers(0, 100, n).astype(np.int32),
+    }
+    build_segment(schema, sorted_cols, str(base / "sorted"), cfg, "sorted")
+    build_segment(schema, unsorted_cols, str(base / "unsorted"), cfg, "unsorted")
+    return (
+        ImmutableSegment(str(base / "sorted")),
+        ImmutableSegment(str(base / "unsorted")),
+        sorted_cols,
+        unsorted_cols,
+    )
+
+
+def _engine(seg):
+    eng = QueryEngine(device_executor=None)
+    eng.add_segment("t", seg)
+    return eng
+
+
+class TestOperatorChoice:
+    def test_sorted_beats_inverted(self, segs):
+        s_sorted, s_unsorted, *_ = segs
+        from pinot_tpu.sql.compiler import compile_query
+
+        q = compile_query("SELECT COUNT(*) FROM t WHERE k = 7")
+        assert s_sorted.column_metadata("k").is_sorted
+        assert filter_operator_for(s_sorted, q.filter.predicate) == "SORTED_INDEX"
+        assert filter_operator_for(s_unsorted, q.filter.predicate) == "FULL_SCAN"
+
+        qv = compile_query("SELECT COUNT(*) FROM t WHERE v = 's01'")
+        assert filter_operator_for(s_unsorted, qv.filter.predicate) == "INVERTED_INDEX"
+
+    def test_explain_shows_index_operator(self, segs):
+        _, s_unsorted, *_ = segs
+        eng = _engine(s_unsorted)
+        r = eng.execute("EXPLAIN PLAN FOR SELECT COUNT(*) FROM t WHERE v = 's01'")
+        ops = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("FILTER_INVERTED_INDEX" in o for o in ops), ops
+
+
+class TestIndexEqualsScan:
+    @pytest.mark.parametrize(
+        "where",
+        [
+            "k = 7",
+            "k BETWEEN 100 AND 200",
+            "k IN (3, 99, 471)",
+            "v = 's05'",
+            "v IN ('s01', 's17', 's39')",
+            "v BETWEEN 's10' AND 's20'",
+            "k > 490 AND v = 's00'",
+            "NOT v = 's01'",
+        ],
+    )
+    def test_results_match_numpy(self, segs, where):
+        s_sorted, s_unsorted, sc, uc = segs
+        for seg, cols in ((s_sorted, sc), (s_unsorted, uc)):
+            eng = _engine(seg)
+            r = eng.execute(f"SELECT COUNT(*), SUM(m) FROM t WHERE {where}")
+            assert not r.get("exceptions"), r
+            mask = _numpy_mask(cols, where)
+            got = r["resultTable"]["rows"][0]
+            assert got[0] == int(mask.sum()), (where, seg.name)
+            if mask.any():
+                assert got[1] == int(cols["m"][mask].sum()), (where, seg.name)
+
+    def test_zero_entries_scanned_for_index_filter(self, segs):
+        s_sorted, s_unsorted, *_ = segs
+        r = _engine(s_sorted).execute("SELECT COUNT(*) FROM t WHERE k = 7")
+        assert r["numEntriesScannedInFilter"] == 0
+        r = _engine(s_unsorted).execute("SELECT COUNT(*) FROM t WHERE v = 's01'")
+        assert r["numEntriesScannedInFilter"] == 0
+        # scan predicates still count
+        r = _engine(s_unsorted).execute("SELECT COUNT(*) FROM t WHERE k = 7")
+        assert r["numEntriesScannedInFilter"] == s_unsorted.n_docs
+
+
+def _numpy_mask(cols, where):
+    k, v = cols["k"], cols["v"]
+    masks = {
+        "k = 7": k == 7,
+        "k BETWEEN 100 AND 200": (k >= 100) & (k <= 200),
+        "k IN (3, 99, 471)": np.isin(k, [3, 99, 471]),
+        "v = 's05'": v == "s05",
+        "v IN ('s01', 's17', 's39')": np.isin(v, ["s01", "s17", "s39"]),
+        "v BETWEEN 's10' AND 's20'": (v >= "s10") & (v <= "s20"),
+        "k > 490 AND v = 's00'": (k > 490) & (v == "s00"),
+        "NOT v = 's01'": v != "s01",
+    }
+    return masks[where]

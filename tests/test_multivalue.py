@@ -1,0 +1,235 @@
+"""Multi-value columns end-to-end: storage round-trip, match-any predicates
+(host + device), MV group-by expansion, *MV aggregation functions, mutable
+segments, and DataTable wire round-trip.
+
+Reference analogs: FixedBitMVForwardIndexReader, per-entry ValueMatchers,
+aggregateGroupByMV (AggregationFunction.java), SumMV/CountMV/...
+AggregationFunction classes.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.mutable import MutableSegment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+N = 5_000
+
+
+def make_schema():
+    return Schema.build(
+        name="ev",
+        dimensions=[("user", DataType.STRING)],
+        multi_value_dimensions=[("tags", DataType.STRING), ("ports", DataType.INT)],
+        metrics=[("amount", DataType.INT)],
+    )
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(21)
+    tags_pool = np.array([f"t{i}" for i in range(12)])
+    rows = {
+        "user": [f"u{i % 50}" for i in range(N)],
+        "tags": [
+            list(tags_pool[rng.choice(12, size=rng.integers(0, 4), replace=False)])
+            for _ in range(N)
+        ],
+        "ports": [list(rng.integers(0, 100, rng.integers(1, 5))) for _ in range(N)],
+        "amount": rng.integers(0, 1000, N).astype(np.int32),
+    }
+    return rows
+
+
+@pytest.fixture(scope="module")
+def seg(tmp_path_factory, data):
+    out = str(tmp_path_factory.mktemp("mv") / "s0")
+    build_segment(make_schema(), data, out, TableConfig(table_name="ev"), "s0")
+    return ImmutableSegment(str(out))
+
+
+def _engine(seg, device=None):
+    eng = QueryEngine(device_executor=device)
+    eng.add_segment("ev", seg)
+    return eng
+
+
+def _has_tag(data, i, t):
+    return t in data["tags"][i]
+
+
+class TestStorage:
+    def test_roundtrip_values(self, seg, data):
+        vals = seg.values("tags")
+        assert list(vals[0]) == list(data["tags"][0])
+        assert list(vals[N - 1]) == list(data["tags"][N - 1])
+        meta = seg.column_metadata("tags")
+        assert not meta.single_value
+        assert meta.max_mv_entries <= 3
+        assert meta.total_number_of_entries == sum(len(r) for r in data["tags"])
+
+    def test_flat_values_and_offsets(self, seg, data):
+        flat = seg.flat_values("ports")
+        off = np.asarray(seg.mv_offsets("ports"))
+        assert len(flat) == off[-1]
+        i = 137
+        assert list(flat[off[i]: off[i + 1]]) == list(data["ports"][i])
+
+
+class TestHostPredicates:
+    def test_match_any_eq(self, seg, data):
+        r = _engine(seg).execute("SELECT COUNT(*) FROM ev WHERE tags = 't3'")
+        exp = sum(1 for i in range(N) if _has_tag(data, i, "t3"))
+        assert r["resultTable"]["rows"][0][0] == exp
+
+    def test_match_any_in(self, seg, data):
+        r = _engine(seg).execute("SELECT COUNT(*) FROM ev WHERE tags IN ('t1', 't7')")
+        exp = sum(
+            1 for i in range(N)
+            if _has_tag(data, i, "t1") or _has_tag(data, i, "t7")
+        )
+        assert r["resultTable"]["rows"][0][0] == exp
+
+    def test_match_any_range_numeric(self, seg, data):
+        r = _engine(seg).execute("SELECT COUNT(*) FROM ev WHERE ports BETWEEN 90 AND 99")
+        exp = sum(1 for row in data["ports"] if any(90 <= p <= 99 for p in row))
+        assert r["resultTable"]["rows"][0][0] == exp
+
+    def test_not_semantics(self, seg, data):
+        # SQL NOT: doc-level negation of the match-any predicate
+        r = _engine(seg).execute("SELECT COUNT(*) FROM ev WHERE NOT tags = 't3'")
+        exp = sum(1 for i in range(N) if not _has_tag(data, i, "t3"))
+        assert r["resultTable"]["rows"][0][0] == exp
+        # != : per-entry semantics — ANY entry different (reference MV NotEq)
+        r = _engine(seg).execute("SELECT COUNT(*) FROM ev WHERE tags != 't3'")
+        exp = sum(
+            1 for row in data["tags"] if any(t != "t3" for t in row)
+        )
+        assert r["resultTable"]["rows"][0][0] == exp
+
+
+class TestDevicePredicates:
+    def test_device_matches_host(self, seg, data):
+        from pinot_tpu.engine.device import DeviceExecutor
+
+        dev = _engine(seg, DeviceExecutor(mm_mode="interpret"))
+        host = _engine(seg)
+        for where in ("tags = 't3'", "tags IN ('t1','t7')",
+                      "ports BETWEEN 90 AND 99", "tags != 't3'"):
+            sql = f"SELECT COUNT(*), SUM(amount) FROM ev WHERE {where}"
+            rd = dev.execute(sql)
+            rh = host.execute(sql)
+            assert not rd.get("exceptions"), rd
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], where
+
+
+class TestGroupBy:
+    def test_mv_groupby_expansion(self, seg, data):
+        r = _engine(seg).execute(
+            "SELECT tags, COUNT(*), SUM(amount) FROM ev GROUP BY tags ORDER BY tags LIMIT 50"
+        )
+        exp_count: dict = {}
+        exp_sum: dict = {}
+        for i, row in enumerate(data["tags"]):
+            for t in row:
+                exp_count[t] = exp_count.get(t, 0) + 1
+                exp_sum[t] = exp_sum.get(t, 0) + int(data["amount"][i])
+        got = r["resultTable"]["rows"]
+        assert len(got) == len(exp_count)
+        for tag, cnt, s in got:
+            assert cnt == exp_count[tag], tag
+            assert s == exp_sum[tag], tag
+
+    def test_mv_plus_sv_groupby(self, seg, data):
+        r = _engine(seg).execute(
+            "SELECT user, tags, COUNT(*) FROM ev WHERE user = 'u7' "
+            "GROUP BY user, tags ORDER BY tags LIMIT 50"
+        )
+        exp: dict = {}
+        for i in range(N):
+            if data["user"][i] == "u7":
+                for t in data["tags"][i]:
+                    exp[t] = exp.get(t, 0) + 1
+        got = r["resultTable"]["rows"]
+        assert {(u, t): c for u, t, c in got} == {("u7", t): c for t, c in exp.items()}
+
+
+class TestMVAggregations:
+    def test_countmv_summv(self, seg, data):
+        r = _engine(seg).execute("SELECT COUNTMV(ports), SUMMV(ports) FROM ev")
+        exp_c = sum(len(p) for p in data["ports"])
+        exp_s = sum(sum(p) for p in data["ports"])
+        assert r["resultTable"]["rows"][0] == [exp_c, exp_s]
+
+    def test_grouped_mv_aggs(self, seg, data):
+        r = _engine(seg).execute(
+            "SELECT user, COUNTMV(ports), MINMV(ports), MAXMV(ports), AVGMV(ports), "
+            "DISTINCTCOUNTMV(tags) FROM ev WHERE user IN ('u3', 'u4') "
+            "GROUP BY user ORDER BY user"
+        )
+        for row in r["resultTable"]["rows"]:
+            u = row[0]
+            ports = [p for i, p in enumerate(data["ports"]) if data["user"][i] == u]
+            tags = [t for i, ts in enumerate(data["tags"]) if data["user"][i] == u
+                    for t in ts]
+            flat = [x for p in ports for x in p]
+            assert row[1] == len(flat)
+            assert row[2] == min(flat)
+            assert row[3] == max(flat)
+            assert abs(row[4] - sum(flat) / len(flat)) < 1e-9
+            assert row[5] == len(set(tags))
+
+
+class TestSelectionAndWire:
+    def test_select_mv_column(self, seg, data):
+        r = _engine(seg).execute(
+            "SELECT user, tags FROM ev WHERE user = 'u1' LIMIT 5"
+        )
+        assert not r.get("exceptions"), r
+        for row in r["resultTable"]["rows"]:
+            assert row[0] == "u1"
+            assert isinstance(row[1], list)
+
+    def test_datatable_roundtrip_mv_rows(self, seg):
+        from pinot_tpu.engine import datatable
+        from pinot_tpu.engine.host import HostExecutor
+        from pinot_tpu.sql.compiler import compile_query
+
+        q = compile_query("SELECT tags, amount FROM ev LIMIT 7")
+        res = HostExecutor().execute_segment(q, seg)
+        back = datatable.decode(datatable.encode(res))
+        for a, b in zip(res.rows[0], back.rows[0]):
+            assert list(a) == list(b)
+
+
+class TestMutableMV:
+    def test_mutable_mv_index_query_seal(self, tmp_path):
+        seg = MutableSegment(make_schema(), "m0")
+        rows = [
+            {"user": "a", "tags": ["x", "y"], "ports": [1, 2], "amount": 10},
+            {"user": "b", "tags": ["y"], "ports": [3], "amount": 20},
+            {"user": "a", "tags": [], "ports": [5, 6, 7], "amount": 30},
+        ]
+        for row in rows:
+            seg.index(row)
+        eng = QueryEngine()
+        eng.table("ev").add_segment(seg)
+        r = eng.execute("SELECT COUNT(*) FROM ev WHERE tags = 'y'")
+        assert r["resultTable"]["rows"][0][0] == 2
+        r = eng.execute("SELECT COUNTMV(ports), SUMMV(ports) FROM ev")
+        assert r["resultTable"]["rows"][0] == [6, 24]
+        r = eng.execute("SELECT tags, COUNT(*) FROM ev GROUP BY tags ORDER BY tags")
+        assert [list(x) for x in r["resultTable"]["rows"]] == [["x", 1], ["y", 2]]
+
+        sealed = seg.seal(str(tmp_path / "sealed"))
+        eng2 = QueryEngine()
+        eng2.table("ev").add_segment(sealed)
+        r = eng2.execute("SELECT COUNT(*) FROM ev WHERE tags = 'y'")
+        assert r["resultTable"]["rows"][0][0] == 2
+        r = eng2.execute("SELECT COUNTMV(ports), SUMMV(ports) FROM ev")
+        assert r["resultTable"]["rows"][0] == [6, 24]

@@ -352,7 +352,12 @@ class TestMisc:
         )
         ops = [r[0] for r in resp["resultTable"]["rows"]]
         assert any("BROKER_REDUCE" in o for o in ops)
-        assert any("FILTER_PREDICATE" in o for o in ops)
+        # filter line names the chosen operator (sorted/inverted/full-scan)
+        assert any(
+            "FILTER_FULL_SCAN" in o or "FILTER_SORTED_INDEX" in o
+            or "FILTER_INVERTED_INDEX" in o or "FILTER_PREDICATE" in o
+            for o in ops
+        )
 
     def test_stats_present(self, setup):
         engine, _ = setup
