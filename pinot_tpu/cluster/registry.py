@@ -88,6 +88,7 @@ def _to_json(state: dict) -> dict:
         "assignment": state["assignment"],
         "external_view": state["external_view"],
         "partition_assignment": state["partition_assignment"],
+        "segment_completion": state.get("segment_completion", {}),
     }
 
 
@@ -103,6 +104,7 @@ def _from_json(d: dict) -> dict:
         "assignment": d.get("assignment", {}),
         "external_view": d.get("external_view", {}),
         "partition_assignment": d.get("partition_assignment", {}),
+        "segment_completion": d.get("segment_completion", {}),
     }
 
 
@@ -273,13 +275,101 @@ class ClusterRegistry:
 
     # ---- realtime partition assignment ----------------------------------
     def set_partition_assignment(self, table: str, mapping: dict) -> None:
-        """{partition(str): instance_id}"""
+        """{partition(str): [instance_ids]} — every listed replica consumes
+        the partition (multi-replica LLC consumption)."""
+
+        def norm(v):
+            return [v] if isinstance(v, str) else list(v)
+
         self._tx(lambda s: s["partition_assignment"].__setitem__(
-            table, {str(k): v for k, v in mapping.items()}
+            table, {str(k): norm(v) for k, v in mapping.items()}
         ))
 
     def partition_assignment(self, table: str) -> dict:
-        return self._tx_read(lambda s: dict(s["partition_assignment"].get(table, {})))
+        out = self._tx_read(
+            lambda s: dict(s["partition_assignment"].get(table, {}))
+        )
+        return {k: ([v] if isinstance(v, str) else list(v)) for k, v in out.items()}
+
+    # ---- segment completion FSM (SegmentCompletionManager analog) --------
+    # state: {table: {partition: {sequence: entry}}} where entry =
+    # {committer, state: COMMITTING|DONE, segment, location, offset, ts_ms}
+    # The first replica to reach its flush threshold CAS-claims the commit;
+    # losers HOLD until the entry goes DONE, then adopt the committed
+    # segment. A stale COMMITTING entry (committer died mid-build) can be
+    # taken over.
+
+    def try_claim_commit(self, table: str, partition: int, sequence: int,
+                         instance_id: str, segment_name: str) -> dict:
+        """CAS: claim the commit for (partition, sequence). Returns the
+        current entry — caller won iff entry['committer'] == instance_id
+        and entry['state'] == 'COMMITTING'."""
+
+        def fn(s):
+            part = s.setdefault("segment_completion", {}) \
+                .setdefault(table, {}).setdefault(str(partition), {})
+            entry = part.get(str(sequence))
+            if entry is None:
+                entry = {
+                    "committer": instance_id, "state": "COMMITTING",
+                    "segment": segment_name, "location": None, "offset": None,
+                    "ts_ms": int(time.time() * 1000),
+                }
+                part[str(sequence)] = entry
+            return dict(entry)
+
+        return self._tx(fn)
+
+    def finish_commit(self, table: str, partition: int, sequence: int,
+                      instance_id: str, segment_name: str, location: str,
+                      end_offset: str) -> bool:
+        """Committer publishes the built segment; False if it lost the claim
+        (a takeover happened while it was building). ``segment_name`` is
+        re-recorded: after a takeover the new committer's segment replaces
+        the dead claimer's."""
+
+        def fn(s):
+            part = s.get("segment_completion", {}).get(table, {}) \
+                .get(str(partition), {})
+            entry = part.get(str(sequence))
+            if entry is None or entry["committer"] != instance_id:
+                return False
+            entry.update(state="DONE", segment=segment_name, location=location,
+                         offset=end_offset, ts_ms=int(time.time() * 1000))
+            return True
+
+        return self._tx(fn)
+
+    def commit_entry(self, table: str, partition: int, sequence: int):
+        def fn(s):
+            e = s.get("segment_completion", {}).get(table, {}) \
+                .get(str(partition), {}).get(str(sequence))
+            return None if e is None else dict(e)
+
+        return self._tx_read(fn)
+
+    def takeover_commit(self, table: str, partition: int, sequence: int,
+                        instance_id: str, stale_ms: int) -> dict:
+        """If the entry is COMMITTING and untouched for ``stale_ms``, replace
+        the (presumed dead) committer. Returns the current entry."""
+
+        def fn(s):
+            part = s.setdefault("segment_completion", {}) \
+                .setdefault(table, {}).setdefault(str(partition), {})
+            entry = part.get(str(sequence))
+            now = int(time.time() * 1000)
+            if entry is None:
+                entry = {
+                    "committer": instance_id, "state": "COMMITTING",
+                    "segment": None, "location": None, "offset": None,
+                    "ts_ms": now,
+                }
+                part[str(sequence)] = entry
+            elif entry["state"] == "COMMITTING" and now - entry["ts_ms"] >= stale_ms:
+                entry.update(committer=instance_id, ts_ms=now)
+            return dict(entry)
+
+        return self._tx(fn)
 
 
 class FileRegistry(ClusterRegistry):

@@ -116,21 +116,72 @@ class Controller:
     def drop_table(self, table: str) -> None:
         self.registry.drop_table(table)
 
+    def _realtime_replication(self, config: TableConfig) -> int:
+        """Replica consumers per partition. Upsert tables pin to 1: each
+        replica maintains independent validDocIds state, and adopted
+        segments would desync it (the reference requires strict replica
+        routing for upsert for the same reason)."""
+        if config.upsert.mode != "NONE":
+            return 1
+        return max(1, config.replication)
+
     def _assign_stream_partitions(self, config: TableConfig) -> None:
-        """Stream partition → server round-robin
-        (PinotLLCRealtimeSegmentManager's consuming-segment creation)."""
+        """Stream partition → [servers], replication-aware round-robin
+        (PinotLLCRealtimeSegmentManager's consuming-segment creation; every
+        listed replica consumes, commits arbitrate via the completion FSM)."""
         from pinot_tpu.stream.spi import create_consumer_factory
 
-        servers = [
+        servers = sorted(
             i.instance_id
             for i in self.registry.instances(Role.SERVER,
                                              live_ttl_ms=self.assigner.live_ttl_ms)
-        ]
+        )
         if not servers:
             raise RuntimeError("no servers available for realtime partitions")
         n = create_consumer_factory(config.stream).partition_count()
-        mapping = {p: servers[p % len(servers)] for p in range(n)}
+        reps = min(self._realtime_replication(config), len(servers))
+        mapping = {
+            p: [servers[(p + r) % len(servers)] for r in range(reps)]
+            for p in range(n)
+        }
         self.registry.set_partition_assignment(config.table_name_with_type, mapping)
+
+    def run_realtime_repair(self) -> dict:
+        """RealtimeSegmentValidationManager analog: re-home partitions whose
+        consumers died so ingestion continues (the new owner resumes from
+        the last completed commit in the registry)."""
+        live = sorted(
+            i.instance_id
+            for i in self.registry.instances(Role.SERVER,
+                                             live_ttl_ms=self.assigner.live_ttl_ms)
+        )
+        changed = {}
+        for table in self.registry.tables():
+            cfg = self.registry.table_config(table)
+            if cfg is None or cfg.stream is None:
+                continue
+            pa = self.registry.partition_assignment(table)
+            if not pa or not live:
+                continue
+            want = min(self._realtime_replication(cfg), len(live))
+            new_pa = {}
+            dirty = False
+            for p, insts in pa.items():
+                alive = [i for i in insts if i in live]
+                if len(alive) < want:
+                    for cand in live:
+                        if len(alive) >= want:
+                            break
+                        if cand not in alive:
+                            alive.append(cand)
+                    dirty = True
+                elif len(alive) != len(insts):
+                    dirty = True
+                new_pa[p] = alive
+            if dirty:
+                self.registry.set_partition_assignment(table, new_pa)
+                changed[table] = new_pa
+        return changed
 
     # ---- segment lifecycle -----------------------------------------------
     def resolve(self, table: str) -> str:

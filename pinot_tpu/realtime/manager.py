@@ -82,10 +82,17 @@ class CheckpointStore:
             os.replace(tmp, self.path)
 
 
-def llc_segment_name(table: str, partition: int, sequence: int) -> str:
-    """LLCSegmentName analog: table__partition__sequence__creationTime."""
-    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
-    return f"{table}__{partition}__{sequence}__{ts}"
+def llc_segment_name(table: str, partition: int, sequence: int,
+                     start_offset: str = None) -> str:
+    """LLCSegmentName analog: table__partition__sequence__suffix. The suffix
+    is the START OFFSET (deterministic), not a creation timestamp: replicas
+    consuming the same partition resume from the same committed offset, so
+    they agree on the name of the segment they're racing to commit — the
+    property the reference gets from the controller assigning the name in
+    ZK. Falls back to a timestamp when no offset is known."""
+    suffix = start_offset if start_offset is not None \
+        else time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    return f"{table}__{partition}__{sequence}__{suffix}"
 
 
 class RealtimePartitionManager:
@@ -111,6 +118,7 @@ class RealtimePartitionManager:
         upsert_manager: Optional[PartitionUpsertMetadataManager] = None,
         fetch_timeout_ms: int = 100,
         idle_sleep_s: float = 0.02,
+        completion=None,  # SegmentCompletionClient for multi-replica commit
     ):
         self.table = table
         self.schema = schema
@@ -125,6 +133,8 @@ class RealtimePartitionManager:
         self.upsert = upsert_manager
         self.fetch_timeout_ms = fetch_timeout_ms
         self.idle_sleep_s = idle_sleep_s
+        self.completion = completion
+        self.adoptions = 0
 
         stream = table_config.stream
         self.rows_threshold = stream.segment_flush_threshold_rows
@@ -167,7 +177,8 @@ class RealtimePartitionManager:
 
     # ---- consume loop ----------------------------------------------------
     def _new_consuming_segment(self) -> None:
-        name = llc_segment_name(self.table, self.partition, self._sequence)
+        name = llc_segment_name(self.table, self.partition, self._sequence,
+                                self._offset.to_string())
         self.segment = MutableSegment(
             self.schema, name, self.table_config,
             enable_upsert=self.upsert is not None,
@@ -237,7 +248,7 @@ class RealtimePartitionManager:
         )
 
     def _commit(self) -> None:
-        """Seal → checkpoint → publish (the single-process commit protocol).
+        """Seal → checkpoint → publish (the commit protocol).
 
         Checkpoint BEFORE publishing: a crash between the two must not leave
         a live registered segment whose offset range the restarted consumer
@@ -245,20 +256,58 @@ class RealtimePartitionManager:
         dir + checkpoint entry are the durable commit — the reference makes
         segment metadata + offset one atomic ZK write; here restart
         reconciliation (RealtimeTableDataManager.start) republishes a
-        committed-but-unpublished segment."""
+        committed-but-unpublished segment.
+
+        With a completion client (multi-replica consumption), the commit is
+        arbitrated first: exactly one replica builds the segment, the rest
+        adopt its output (SegmentCompletionManager FSM semantics)."""
         mutable = self.segment
         mutable.end_offset = self._offset.to_string()
+        if self.completion is not None:
+            from pinot_tpu.realtime.completion import CommitOutcome
+
+            outcome, entry = self.completion.arbitrate(
+                self.partition, self._sequence, mutable.segment_name, self._stop
+            )
+            if outcome == CommitOutcome.ABORT:
+                return  # shutting down while holding: leave rows unconsumed
+            if outcome == CommitOutcome.ADOPT:
+                self._adopt_committed(entry)
+                return
         out = os.path.join(self.segment_dir, mutable.segment_name)
         sealed = mutable.seal(out)
         self.checkpoint.record_commit(
             self.table, self.partition, mutable.segment_name,
             self._offset.to_string(), self._sequence,
         )
+        if self.completion is not None:
+            self.completion.finish(
+                self.partition, self._sequence, mutable.segment_name, out,
+                self._offset.to_string(),
+            )
         if self.upsert is not None:
             self.upsert.replace_segment(mutable, sealed)
         self.on_committed_segment(self.partition, mutable, sealed)
         self._sequence += 1
         self.commits += 1
+
+    def _adopt_committed(self, entry: dict) -> None:
+        """HOLDING replica path: another replica won the commit — discard
+        the local in-progress rows, copy its sealed segment, resume from its
+        end offset (the reference's download-and-replace)."""
+        from pinot_tpu.realtime.completion import adopt_segment
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        local = adopt_segment(entry, self.segment_dir)
+        sealed = ImmutableSegment(local)
+        self._offset = StreamPartitionMsgOffset.from_string(entry["offset"])
+        self.checkpoint.record_commit(
+            self.table, self.partition, entry["segment"], entry["offset"],
+            self._sequence,
+        )
+        self.on_committed_segment(self.partition, self.segment, sealed)
+        self._sequence += 1
+        self.adoptions += 1
 
 
 class RealtimeTableDataManager:
@@ -267,7 +316,7 @@ class RealtimeTableDataManager:
     immediately queryable."""
 
     def __init__(self, schema: Schema, table_config: TableConfig,
-                 engine_table, data_dir: str):
+                 engine_table, data_dir: str, completion_client=None):
         if table_config.stream is None:
             raise ValueError("realtime table needs a stream config")
         self.schema = schema
@@ -280,6 +329,9 @@ class RealtimeTableDataManager:
         self.upsert_managers: dict[int, PartitionUpsertMetadataManager] = {}
         self._factory = create_consumer_factory(table_config.stream)
         self._decoder = get_decoder(table_config.stream.decoder, table_config.stream)
+        self.completion = completion_client  # multi-replica commit FSM
+        self._on_commit_cb = None
+        self._on_consuming_cb = None
 
     def start(self, partitions=None, on_commit=None, on_consuming=None) -> None:
         """``partitions``: subset to consume (cluster mode: only the
@@ -290,30 +342,46 @@ class RealtimeTableDataManager:
         parts = list(partitions) if partitions is not None \
             else range(self._factory.partition_count())
         for p in parts:
-            upsert = None
-            if self.table_config.upsert.mode != "NONE":
-                if not self.schema.primary_key_columns:
-                    raise ValueError("upsert requires schema primaryKeyColumns")
-                upsert = PartitionUpsertMetadataManager(
-                    self.table_config.upsert.comparison_column
-                )
-                self.upsert_managers[p] = upsert
-            self._reconcile_committed(p, upsert)
-            mgr = RealtimePartitionManager(
-                table=self.table_config.table_name,
-                schema=self.schema,
-                table_config=self.table_config,
-                partition=p,
-                consumer_factory=self._factory,
-                decoder=self._decoder,
-                checkpoint=self.checkpoint,
-                segment_dir=self.data_dir,
-                on_consuming_segment=self._on_consuming,
-                on_committed_segment=self._on_committed,
-                upsert_manager=upsert,
+            self.add_partition(p)
+
+    def add_partition(self, p: int) -> None:
+        """Start consuming one partition (idempotent) — called at start and
+        when the controller reassigns a dead server's partitions here."""
+        if p in self.partition_managers:
+            return
+        upsert = None
+        if self.table_config.upsert.mode != "NONE":
+            if not self.schema.primary_key_columns:
+                raise ValueError("upsert requires schema primaryKeyColumns")
+            upsert = PartitionUpsertMetadataManager(
+                self.table_config.upsert.comparison_column
             )
-            self.partition_managers[p] = mgr
-            mgr.start()
+            self.upsert_managers[p] = upsert
+        self._reconcile_committed(p, upsert)
+        mgr = RealtimePartitionManager(
+            table=self.table_config.table_name,
+            schema=self.schema,
+            table_config=self.table_config,
+            partition=p,
+            consumer_factory=self._factory,
+            decoder=self._decoder,
+            checkpoint=self.checkpoint,
+            segment_dir=self.data_dir,
+            on_consuming_segment=self._on_consuming,
+            on_committed_segment=self._on_committed,
+            upsert_manager=upsert,
+            completion=self.completion,
+        )
+        self.partition_managers[p] = mgr
+        mgr.start()
+
+    def stop_partition(self, p: int) -> None:
+        """Stop consuming a partition (reassigned away): uncommitted rows
+        are dropped — the new owner re-consumes from the last commit."""
+        mgr = self.partition_managers.pop(p, None)
+        if mgr is not None:
+            mgr.stop(commit_remaining=False)
+            self.engine_table.remove_segment(mgr.segment.segment_name)
 
     def stop(self, commit_remaining: bool = True) -> None:
         for mgr in self.partition_managers.values():
@@ -419,6 +487,10 @@ class RealtimeTableDataManager:
             cb(self.table_config.table_name, partition, segment)
 
     def _on_committed(self, partition: int, mutable, sealed) -> None:
+        if mutable is not None and mutable.segment_name != sealed.name:
+            # adopted segment under a different name: drop the discarded
+            # consuming segment so its rows don't double-count
+            self.engine_table.remove_segment(mutable.segment_name)
         self._publish_committed(partition, sealed)
 
     def _publish_committed(self, partition: int, sealed) -> None:

@@ -167,33 +167,47 @@ class ServerInstance:
         self.registry.update_external_view(self.instance_id, serving)
 
     def _sync_realtime(self) -> None:
-        """Start consumers for realtime tables with partitions assigned to
-        this instance (CONSUMING state analog)."""
+        """Reconcile stream consumers against the (multi-replica) partition
+        assignment: start consumers for newly-assigned partitions, stop
+        reassigned ones (CONSUMING state analog, level-triggered)."""
         for table in self.registry.tables():
-            if table in self._realtime_managers:
-                continue
             pa = self.registry.partition_assignment(table)
-            mine = [int(p) for p, inst in pa.items() if inst == self.instance_id]
-            if not mine:
-                continue
-            cfg = self.registry.table_config(table)
-            schema = self.registry.table_schema(table)
-            if cfg is None or cfg.stream is None:
-                continue
-            from pinot_tpu.realtime.manager import RealtimeTableDataManager
+            mine = sorted(
+                int(p) for p, insts in pa.items() if self.instance_id in insts
+            )
+            mgr = self._realtime_managers.get(table)
+            if mgr is None:
+                if not mine:
+                    continue
+                cfg = self.registry.table_config(table)
+                schema = self.registry.table_schema(table)
+                if cfg is None or cfg.stream is None:
+                    continue
+                from pinot_tpu.realtime.completion import SegmentCompletionClient
+                from pinot_tpu.realtime.manager import RealtimeTableDataManager
 
-            mgr = RealtimeTableDataManager(
-                schema, cfg, self.engine.table(table),
-                os.path.join(self.data_dir, f"rt_{table}"),
-            )
-            # callbacks publish under the PHYSICAL registry key
-            # (clicks_REALTIME), not the raw table name the manager carries
-            mgr.start(
-                partitions=mine,
-                on_commit=lambda _t, p, seg, _k=table: self._publish_committed(_k, p, seg),
-                on_consuming=lambda _t, p, seg, _k=table: self._publish_consuming(_k, p, seg),
-            )
-            self._realtime_managers[table] = mgr
+                mgr = RealtimeTableDataManager(
+                    schema, cfg, self.engine.table(table),
+                    os.path.join(self.data_dir, f"rt_{table}"),
+                    completion_client=SegmentCompletionClient(
+                        self.registry, table, self.instance_id
+                    ),
+                )
+                # callbacks publish under the PHYSICAL registry key
+                # (clicks_REALTIME), not the raw table name the manager carries
+                mgr.start(
+                    partitions=mine,
+                    on_commit=lambda _t, p, seg, _k=table: self._publish_committed(_k, p, seg),
+                    on_consuming=lambda _t, p, seg, _k=table: self._publish_consuming(_k, p, seg),
+                )
+                self._realtime_managers[table] = mgr
+            else:
+                current = set(mgr.partition_managers)
+                for p in mine:
+                    if p not in current:
+                        mgr.add_partition(p)
+                for p in current - set(mine):
+                    mgr.stop_partition(p)
 
     def _publish_consuming(self, table: str, partition: int, segment) -> None:
         """Consuming segments are routable (brokers send them queries while

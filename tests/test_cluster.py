@@ -235,6 +235,65 @@ class TestRealtimeCluster:
         assert [row[1] for row in r["resultTable"]["rows"]] == [25] * 8
 
 
+    def test_kill_consuming_server_no_loss(self, cluster, tmp_path):
+        """Multi-replica consumption survives a consumer death: the replica
+        keeps serving, the controller re-homes the dead server's partitions,
+        and every row stays queryable exactly once (SegmentCompletionManager
+        + RealtimeSegmentValidationManager semantics)."""
+        registry, controller, servers, broker = cluster
+        TopicRegistry.delete("mrclicks")
+        topic = TopicRegistry.create("mrclicks", 1)
+        schema = Schema.build(
+            name="mrclicks",
+            dimensions=[("page", DataType.STRING)],
+            metrics=[("n", DataType.INT)],
+        )
+        cfg = TableConfig(
+            table_name="mrclicks", table_type=TableType.REALTIME, replication=2,
+            stream=StreamConfig(
+                stream_type="memory", topic="mrclicks", decoder="json",
+                segment_flush_threshold_rows=40, segment_flush_threshold_seconds=3600,
+            ),
+        )
+        controller.add_table(cfg, schema)
+        pa = registry.partition_assignment("mrclicks_REALTIME")
+        assert all(len(v) == 2 for v in pa.values())
+
+        def broker_count():
+            r = broker.execute("SELECT COUNT(*) FROM mrclicks")
+            if r.get("exceptions"):
+                return -1
+            return r["resultTable"]["rows"][0][0]
+
+        for i in range(100):
+            topic.publish_json({"page": f"p{i % 4}", "n": 1})
+        assert wait_until(lambda: broker_count() == 100, timeout=20), broker_count()
+
+        # kill one of the consuming replicas hard, mid-stream
+        victims = set(pa["0"])
+        victim = next(s for s in servers if s.instance_id in victims)
+        victim.transport.stop(grace=0)
+        victim._stop.set()  # sync loop (and its consumers' publishes) halt
+        for mgr in victim._realtime_managers.values():
+            mgr.stop(commit_remaining=False)
+        for i in range(100):
+            topic.publish_json({"page": f"p{i % 4}", "n": 1})
+        controller.run_realtime_repair()
+
+        deadline = time.time() + 20
+        count = -1
+        while time.time() < deadline:
+            count = broker_count()
+            if count == 200:
+                break
+            time.sleep(0.1)
+        assert count == 200, count
+        r = broker.execute(
+            "SELECT page, COUNT(*) FROM mrclicks GROUP BY page ORDER BY page"
+        )
+        assert [row[1] for row in r["resultTable"]["rows"]] == [50] * 4
+
+
 class TestHybridTable:
     def test_time_boundary_split(self, cluster, tmp_path):
         """Hybrid table: offline covers old time range, realtime covers new;

@@ -1,0 +1,165 @@
+"""Multi-replica realtime consumption + segment completion FSM.
+
+Reference analogs: SegmentCompletionManager (committer election, HOLDING,
+commit), LLRealtimeSegmentDataManager download-and-replace, and
+RealtimeSegmentValidationManager repair.
+"""
+
+import time
+
+import numpy as np
+
+from pinot_tpu.cluster.registry import ClusterRegistry, InstanceInfo, Role
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.realtime.completion import SegmentCompletionClient
+from pinot_tpu.realtime.manager import RealtimeTableDataManager
+from pinot_tpu.stream.memory_stream import TopicRegistry
+
+
+def wait_until(cond, timeout=15.0, interval=0.03):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _schema():
+    return Schema.build(
+        name="events",
+        dimensions=[("user", DataType.STRING)],
+        metrics=[("amount", DataType.INT)],
+        datetimes=[("ts", DataType.LONG)],
+    )
+
+
+def _cfg(topic, flush_rows):
+    return TableConfig(
+        table_name="events",
+        table_type=TableType.REALTIME,
+        stream=StreamConfig(
+            stream_type="memory", topic=topic, decoder="json",
+            segment_flush_threshold_rows=flush_rows,
+            segment_flush_threshold_seconds=3600,
+        ),
+    )
+
+
+def _count(eng):
+    r = eng.execute("SELECT COUNT(*) FROM events")
+    if r.get("exceptions"):
+        return -1
+    return r["resultTable"]["rows"][0][0]
+
+
+def _replica(tmp_path, registry, cfg, instance_id, **kw):
+    eng = QueryEngine(device_executor=None)
+    mgr = RealtimeTableDataManager(
+        _schema(), cfg, eng.table("events"), str(tmp_path / f"rt_{instance_id}"),
+        completion_client=SegmentCompletionClient(
+            registry, "events_REALTIME", instance_id, **kw
+        ),
+    )
+    return eng, mgr
+
+
+class TestCompletionFSM:
+    def test_one_commit_per_sequence_losers_adopt(self, tmp_path):
+        """Two replicas consume the same partition; each sequence is
+        committed by exactly one replica, the other adopts — both serve
+        every row exactly once."""
+        TopicRegistry.delete("t_mr")
+        topic = TopicRegistry.create("t_mr", 1)
+        registry = ClusterRegistry()
+        cfg = _cfg("t_mr", flush_rows=50)
+        eng_a, mgr_a = _replica(tmp_path, registry, cfg, "A")
+        eng_b, mgr_b = _replica(tmp_path, registry, cfg, "B")
+        mgr_a.start(partitions=[0])
+        mgr_b.start(partitions=[0])
+        try:
+            for wave in range(3):
+                for i in range(60):
+                    topic.publish_json(
+                        {"user": f"u{i % 5}", "amount": 1, "ts": wave * 60 + i}
+                    )
+                assert wait_until(
+                    lambda: _count(eng_a) == (wave + 1) * 60
+                    and _count(eng_b) == (wave + 1) * 60
+                ), (_count(eng_a), _count(eng_b))
+            pa = mgr_a.partition_managers[0]
+            pb = mgr_b.partition_managers[0]
+            assert wait_until(lambda: pa.commits + pb.commits >= 3)
+            # every committed sequence has exactly ONE committer; the other
+            # replica adopted (or is still consuming behind)
+            for seq in range(min(pa.commits + pb.commits, 3)):
+                entry = registry.commit_entry("events_REALTIME", 0, seq)
+                assert entry is not None and entry["state"] == "DONE", seq
+                assert entry["committer"] in ("A", "B")
+            assert pa.adoptions + pb.adoptions >= 1  # somebody held + adopted
+            # exact-once on each replica
+            r = eng_a.execute("SELECT user, COUNT(*) FROM events GROUP BY user ORDER BY user")
+            assert [row[1] for row in r["resultTable"]["rows"]] == [36] * 5
+        finally:
+            mgr_a.stop(commit_remaining=False)
+            mgr_b.stop(commit_remaining=False)
+
+    def test_committer_death_takeover(self, tmp_path):
+        """A claimed-but-dead committer goes stale; a holding replica takes
+        over, commits its own rows, and ingestion continues — no loss."""
+        TopicRegistry.delete("t_dead")
+        topic = TopicRegistry.create("t_dead", 1)
+        registry = ClusterRegistry()
+        cfg = _cfg("t_dead", flush_rows=40)
+        # the "dead server" claims sequence 0 and never finishes
+        ghost = registry.try_claim_commit("events_REALTIME", 0, 0, "ghost", "ghost_seg")
+        assert ghost["committer"] == "ghost"
+        eng, mgr = _replica(tmp_path, registry, cfg, "B", stale_ms=300, poll_s=0.05)
+        mgr.start(partitions=[0])
+        try:
+            for i in range(50):
+                topic.publish_json({"user": "u", "amount": 1, "ts": i})
+            # B flushes, holds behind ghost, takes over after stale_ms, commits
+            assert wait_until(
+                lambda: mgr.partition_managers[0].commits >= 1, timeout=20
+            )
+            entry = registry.commit_entry("events_REALTIME", 0, 0)
+            assert entry["state"] == "DONE"
+            assert entry["committer"] == "B"
+            assert entry["segment"] != "ghost_seg"  # takeover re-recorded the name
+            assert wait_until(lambda: _count(eng) == 50)
+        finally:
+            mgr.stop(commit_remaining=False)
+
+
+class TestControllerRepair:
+    def test_dead_consumer_partitions_reassigned(self, tmp_path):
+        from pinot_tpu.controller.controller import Controller
+
+        TopicRegistry.delete("t_repair")
+        TopicRegistry.create("t_repair", 2)
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        now = int(time.time() * 1000)
+        for sid in ("s1", "s2"):
+            registry.register_instance(InstanceInfo(sid, Role.SERVER))
+        cfg = _cfg("t_repair", flush_rows=100)
+        cfg.replication = 2
+        controller.add_table(cfg, _schema())
+        pa = registry.partition_assignment("events_REALTIME")
+        assert all(len(v) == 2 for v in pa.values())
+        # s1 dies (heartbeat goes stale); a fresh s3 joins
+        registry.register_instance(InstanceInfo("s3", Role.SERVER))
+        dead = registry._tx_read(lambda s: s["instances"]["s1"])
+        dead.last_heartbeat_ms = now - 120_000
+        registry.register_instance(InstanceInfo("s2", Role.SERVER))  # fresh hb
+        registry._tx(lambda s: s["instances"].__setitem__("s1", dead))
+        changed = controller.run_realtime_repair()
+        assert "events_REALTIME" in changed
+        pa = registry.partition_assignment("events_REALTIME")
+        for insts in pa.values():
+            assert "s1" not in insts
+            assert len(insts) == 2 and set(insts) <= {"s2", "s3"}
