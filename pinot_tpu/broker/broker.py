@@ -19,6 +19,7 @@ import time
 from concurrent import futures
 from typing import Optional
 
+from pinot_tpu.broker.segment_pruner import prune_segments
 from pinot_tpu.cluster.registry import ClusterRegistry, Role, SegmentState
 from pinot_tpu.engine.datatable import decode
 from pinot_tpu.engine.reduce import finalize, merge_intermediates
@@ -213,13 +214,31 @@ class Broker:
 
         scatter = []  # (instance, physical table, segments, time_filter)
         n_servers = set()
+        num_pruned = 0
+        fully_pruned = []  # fallback: keep one segment so reduce sees a shape
         for physical, time_filter in self._physical_tables(q.table_name):
             routing = self.routing.routing_table(physical)
             if not routing:
                 continue
+            records = self.registry.segments(physical)
+            cfg = self.registry.table_config(physical)
+            time_col = cfg.time_column if cfg is not None else None
             for inst, segs in routing.items():
-                scatter.append((inst, physical, segs, time_filter))
-                n_servers.add(inst)
+                kept, pruned = prune_segments(q, records, segs, time_col, time_filter)
+                num_pruned += pruned
+                if kept:
+                    scatter.append((inst, physical, kept, time_filter))
+                    n_servers.add(inst)
+                else:
+                    fully_pruned.append((inst, physical, segs[:1], time_filter))
+        if not scatter and fully_pruned:
+            # every segment pruned: query one anyway — the server's min/max
+            # pruner short-circuits it, and the reduce gets a typed empty
+            # result instead of a synthesized one
+            inst, phys, segs, tf = fully_pruned[0]
+            num_pruned -= len(segs)
+            scatter.append((inst, phys, segs, tf))
+            n_servers.add(inst)
         if not scatter:
             raise KeyError(f"no routing entry for table {q.table_name!r}")
 
@@ -280,6 +299,7 @@ class Broker:
                 "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,
                 "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,
                 "numSegmentsQueried": stats.num_segments_queried,
+                "numSegmentsPrunedByBroker": num_pruned,
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,

@@ -1,0 +1,146 @@
+"""Broker-side segment pruning: partition + time.
+
+Equivalent of the reference's routing pruners
+(pinot-broker/.../routing/segmentpruner/SinglePartitionColumnSegmentPruner.java,
+TimeSegmentPruner.java + segmentpruner/interval/IntervalTree.java): before
+scattering, drop segments whose recorded partition-id set or time range
+provably cannot satisfy the query filter. Pruning is conservative — a segment
+survives unless the filter *provably* excludes every one of its docs.
+
+The evaluation walks the filter tree bottom-up with tri-state semantics
+collapsed to "may match" booleans: AND may-match iff every child may match,
+OR iff any child may match, NOT is always "may match" (the complement of a
+partial exclusion proves nothing about the segment).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pinot_tpu.cluster.registry import SegmentRecord
+from pinot_tpu.query.context import (
+    FilterNode,
+    FilterNodeType,
+    Predicate,
+    PredicateType,
+    QueryContext,
+)
+from pinot_tpu.storage.partition import partition_of_value
+
+
+def _value_in_time_range(v, lo, hi) -> bool:
+    try:
+        return not (v < lo or v > hi)
+    except TypeError:
+        return True  # incomparable literal: cannot prune
+
+
+def _predicate_may_match(p: Predicate, rec: SegmentRecord,
+                         time_column: Optional[str]) -> bool:
+    if not p.lhs.is_identifier:
+        return True
+    col = p.lhs.name
+
+    # ---- partition pruning (SinglePartitionColumnSegmentPruner) ----------
+    if (
+        rec.partition_column == col
+        and rec.partition_ids
+        and rec.partition_function
+        and rec.num_partitions
+    ):
+        pids = set(rec.partition_ids)
+
+        def pid(v) -> int:
+            return partition_of_value(v, rec.partition_function, rec.num_partitions)
+
+        try:
+            if p.type is PredicateType.EQ:
+                if pid(p.value) not in pids:
+                    return False
+            elif p.type is PredicateType.IN and p.values:
+                if all(pid(v) not in pids for v in p.values):
+                    return False
+        except Exception:  # noqa: BLE001 — unhashable/odd literal: no pruning
+            pass
+
+    # ---- time pruning (TimeSegmentPruner) --------------------------------
+    if (
+        time_column is not None
+        and col == time_column
+        and rec.start_time is not None
+        and rec.end_time is not None
+    ):
+        lo, hi = rec.start_time, rec.end_time
+        try:
+            if p.type is PredicateType.EQ:
+                return _value_in_time_range(p.value, lo, hi)
+            if p.type is PredicateType.IN and p.values:
+                return any(_value_in_time_range(v, lo, hi) for v in p.values)
+            if p.type is PredicateType.RANGE:
+                if p.lower is not None:
+                    if p.lower > hi or (p.lower == hi and not p.lower_inclusive):
+                        return False
+                if p.upper is not None:
+                    if p.upper < lo or (p.upper == lo and not p.upper_inclusive):
+                        return False
+        except TypeError:
+            return True
+    return True
+
+
+def _filter_may_match(f: FilterNode, rec: SegmentRecord,
+                      time_column: Optional[str]) -> bool:
+    if f.type is FilterNodeType.PREDICATE:
+        return _predicate_may_match(f.predicate, rec, time_column)
+    if f.type is FilterNodeType.AND:
+        return all(_filter_may_match(c, rec, time_column) for c in f.children)
+    if f.type is FilterNodeType.OR:
+        if not f.children:
+            return True  # degenerate OR: never prune on it
+        return any(_filter_may_match(c, rec, time_column) for c in f.children)
+    if f.type is FilterNodeType.CONSTANT_FALSE:
+        return False
+    # NOT / CONSTANT_TRUE: conservative
+    return True
+
+
+def _hybrid_boundary_filter(time_filter: Optional[dict]) -> Optional[FilterNode]:
+    """The broker's hybrid time-boundary split (op le/gt) as a prunable
+    RANGE predicate over the time column."""
+    if not time_filter:
+        return None
+    from pinot_tpu.query.context import Expression
+
+    col = Expression.identifier(time_filter["column"])
+    if time_filter["op"] == "le":
+        p = Predicate(PredicateType.RANGE, col, upper=time_filter["value"],
+                      upper_inclusive=True)
+    else:  # gt
+        p = Predicate(PredicateType.RANGE, col, lower=time_filter["value"],
+                      lower_inclusive=False)
+    return FilterNode.pred(p)
+
+
+def prune_segments(
+    q: Optional[QueryContext],
+    records: dict[str, SegmentRecord],
+    segments: list[str],
+    time_column: Optional[str],
+    time_filter: Optional[dict] = None,
+) -> tuple[list[str], int]:
+    """Return (surviving segments, pruned count) for one routed instance."""
+    filters = []
+    if q is not None and q.filter is not None:
+        filters.append(q.filter)
+    bf = _hybrid_boundary_filter(time_filter)
+    if bf is not None:
+        filters.append(bf)
+    if not filters:
+        return segments, 0
+    tree = filters[0] if len(filters) == 1 else FilterNode.and_(*filters)
+    out = []
+    for s in segments:
+        rec = records.get(s)
+        if rec is None or _filter_may_match(tree, rec, time_column):
+            out.append(s)
+    return out, len(segments) - len(out)

@@ -72,6 +72,8 @@ class SegmentRecord:
     end_time: Optional[int] = None
     partition_column: Optional[str] = None
     partition_ids: Optional[list] = None
+    partition_function: Optional[str] = None
+    num_partitions: Optional[int] = None
     crc: Optional[str] = None
     push_time_ms: int = 0
 

@@ -30,6 +30,21 @@ from pinot_tpu.storage.segment import ImmutableSegment
 log = logging.getLogger("pinot_tpu.controller")
 
 
+def _partition_record_fields(meta) -> dict:
+    """Partition metadata of the first partitioned column, for broker-side
+    pruning (SegmentPartitionConfig → SegmentZKMetadata partition metadata
+    in the reference)."""
+    for cm in meta.columns.values():
+        if cm.partition_function and cm.partitions:
+            return {
+                "partition_column": cm.name,
+                "partition_ids": list(cm.partitions),
+                "partition_function": cm.partition_function,
+                "num_partitions": cm.num_partitions,
+            }
+    return {}
+
+
 class SegmentAssigner:
     """Balanced assignment: each segment gets `replication` replicas on the
     least-loaded live servers (assignment/segment/OfflineSegmentAssignment +
@@ -216,6 +231,7 @@ class Controller:
             name=seg.name, table=table, n_docs=seg.n_docs, location=location,
             state=SegmentState.ONLINE, start_time=meta.start_time,
             end_time=meta.end_time, crc=meta.crc,
+            **_partition_record_fields(meta),
         )
         instances = self.assigner.assign(cfg.replication)
         self.registry.add_segment(record, instances)

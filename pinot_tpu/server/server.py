@@ -224,11 +224,14 @@ class ServerInstance:
         """Committed realtime segments become cluster-visible (the
         Server2Controller commit → ZK metadata step)."""
         meta = sealed.metadata
+        from pinot_tpu.controller.controller import _partition_record_fields
+
         self.registry.add_segment(
             SegmentRecord(
                 name=sealed.name, table=table, n_docs=sealed.n_docs,
                 location=sealed.dir, state=SegmentState.ONLINE,
                 start_time=meta.start_time, end_time=meta.end_time,
+                **_partition_record_fields(meta),
             ),
             [self.instance_id],
         )

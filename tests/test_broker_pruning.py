@@ -1,0 +1,173 @@
+"""Broker-side segment pruning: partition + time.
+
+Reference analogs: SinglePartitionColumnSegmentPruner.java,
+TimeSegmentPruner.java — the broker drops segments from the scatter set when
+the filter provably excludes them, and the response reports
+numSegmentsPrunedByBroker.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import (
+    SegmentPartitionConfig,
+    TableConfig,
+)
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+from tests.test_cluster import wait_until
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "deepstore"))
+    servers = [
+        ServerInstance(f"server_{i}", registry, str(tmp_path / f"srv{i}"),
+                       device_executor=None)
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=10.0)
+    yield registry, controller, servers, broker
+    broker.close()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+N_PART = 4
+
+
+def _partitioned_table(tmp_path, controller, n_segments=N_PART, rows=500):
+    """One segment per modulo-partition of `store_id`, plus disjoint time
+    ranges per segment on `ts`."""
+    schema = Schema.build(
+        name="orders",
+        dimensions=[("store_id", DataType.INT)],
+        metrics=[("amount", DataType.INT)],
+        datetimes=[("ts", DataType.LONG)],
+    )
+    cfg = TableConfig(
+        table_name="orders",
+        replication=1,
+        time_column="ts",
+        partition=SegmentPartitionConfig(
+            column_partition_map={"store_id": ("modulo", N_PART)}
+        ),
+    )
+    controller.add_table(cfg, schema)
+    rng = np.random.default_rng(5)
+    all_cols = []
+    for i in range(n_segments):
+        # store_id values all ≡ i (mod N_PART); ts in [i*1000, i*1000+999]
+        cols = {
+            "store_id": (rng.integers(0, 100, rows) * N_PART + i).astype(np.int64),
+            "amount": rng.integers(1, 100, rows).astype(np.int32),
+            "ts": (i * 1000 + rng.integers(0, 1000, rows)).astype(np.int64),
+        }
+        all_cols.append(cols)
+        d = str(tmp_path / f"seg{i}")
+        build_segment(schema, cols, d, cfg, f"orders_s{i}")
+        controller.upload_segment("orders", d)
+    return schema, cfg, all_cols
+
+
+def _loaded(servers, n):
+    return lambda: sum(
+        len(s.engine.tables["orders_OFFLINE"].segments)
+        if s.engine.tables.get("orders_OFFLINE") else 0
+        for s in servers
+    ) >= n
+
+
+class TestBrokerPruning:
+    def test_partition_pruning_eq(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _, _, all_cols = _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        # store_id = 6 → partition 2 → only segment 2 scanned
+        r = broker.execute("SELECT SUM(amount) FROM orders WHERE store_id = 6")
+        expected = sum(
+            int(c["amount"][c["store_id"] == 6].sum()) for c in all_cols
+        )
+        assert int(float(r["resultTable"]["rows"][0][0])) == expected
+        assert r["numSegmentsPrunedByBroker"] == N_PART - 1
+        assert r["numSegmentsQueried"] == 1
+
+    def test_partition_pruning_in(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _, _, all_cols = _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        # values in partitions {1, 3} → two segments survive
+        r = broker.execute(
+            "SELECT COUNT(*) FROM orders WHERE store_id IN (5, 7)"
+        )
+        expected = sum(
+            int(np.isin(c["store_id"], [5, 7]).sum()) for c in all_cols
+        )
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == N_PART - 2
+
+    def test_time_pruning_range(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _, _, all_cols = _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        # ts between 1000 and 1999 → only segment 1
+        r = broker.execute(
+            "SELECT COUNT(*) FROM orders WHERE ts >= 1000 AND ts < 2000"
+        )
+        expected = sum(
+            int(((c["ts"] >= 1000) & (c["ts"] < 2000)).sum()) for c in all_cols
+        )
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == N_PART - 1
+        assert r["numSegmentsQueried"] == 1
+
+    def test_all_pruned_returns_empty(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        r = broker.execute("SELECT SUM(amount) FROM orders WHERE ts > 999999")
+        # one fallback segment queried so the reduce sees a typed result
+        assert r["numSegmentsQueried"] == 1
+        val = r["resultTable"]["rows"][0][0]
+        assert val in (0, 0.0, None, "null")
+
+    def test_or_filter_not_overpruned(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _, _, all_cols = _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        # OR across two partitions must keep both segments
+        r = broker.execute(
+            "SELECT COUNT(*) FROM orders WHERE store_id = 4 OR store_id = 5"
+        )
+        expected = sum(
+            int(np.isin(c["store_id"], [4, 5]).sum()) for c in all_cols
+        )
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == N_PART - 2
+
+    def test_not_filter_conservative(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _, _, all_cols = _partitioned_table(tmp_path, controller)
+        assert wait_until(_loaded(servers, N_PART))
+
+        r = broker.execute("SELECT COUNT(*) FROM orders WHERE NOT store_id = 6")
+        expected = sum(int((c["store_id"] != 6).sum()) for c in all_cols)
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == 0
