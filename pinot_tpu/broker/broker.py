@@ -73,13 +73,25 @@ class RoutingManager:
     def routing_table(self, table: str) -> Optional[dict]:
         # route on the EXTERNAL VIEW (what servers actually serve), not the
         # ideal-state assignment — assignment may race ahead of loading
-        view = self.registry.external_view(table)
+        view, records, lineage = self.registry.routing_snapshot(table)
         if not view:
             return None
-        records = self.registry.segments(table)
+        # Segment-lineage filter (reference SegmentLineage +
+        # SegmentLineageBasedSegmentPreSelector): an IN_PROGRESS replace
+        # routes the FROM set (the TO segments are still loading); a
+        # COMPLETED one routes the TO set even while the FROM segments
+        # linger in the external view awaiting deletion. This is what makes
+        # a minion merge swap atomic from the query path's point of view.
+        excluded = set()
+        for entry in lineage.values():
+            excluded.update(
+                entry["from"] if entry["state"] == "COMPLETED" else entry["to"]
+            )
         offset = next(self._rr)
         out: dict[str, list] = {}
         for segment, instances in view.items():
+            if segment in excluded:
+                continue
             rec = records.get(segment)
             if rec is not None and rec.state == SegmentState.OFFLINE:
                 continue

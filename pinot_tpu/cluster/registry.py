@@ -91,6 +91,9 @@ def _to_json(state: dict) -> dict:
         "external_view": state["external_view"],
         "partition_assignment": state["partition_assignment"],
         "segment_completion": state.get("segment_completion", {}),
+        "tasks": state.get("tasks", {}),
+        "task_metadata": state.get("task_metadata", {}),
+        "segment_lineage": state.get("segment_lineage", {}),
     }
 
 
@@ -107,6 +110,9 @@ def _from_json(d: dict) -> dict:
         "external_view": d.get("external_view", {}),
         "partition_assignment": d.get("partition_assignment", {}),
         "segment_completion": d.get("segment_completion", {}),
+        "tasks": d.get("tasks", {}),
+        "task_metadata": d.get("task_metadata", {}),
+        "segment_lineage": d.get("segment_lineage", {}),
     }
 
 
@@ -370,6 +376,248 @@ class ClusterRegistry:
             elif entry["state"] == "COMMITTING" and now - entry["ts_ms"] >= stale_ms:
                 entry.update(committer=instance_id, ts_ms=now)
             return dict(entry)
+
+        return self._tx(fn)
+
+
+    # ---- minion task queue (PinotHelixTaskResourceManager analog) --------
+    # tasks: {task_id: {id, type, table, config, state, worker, ts_ms, output}}
+    # States: PENDING -> RUNNING -> DONE | FAILED. Minions claim via CAS
+    # (the registry tx is the arbiter, replacing Helix's task framework).
+
+    class TaskState:
+        PENDING = "PENDING"
+        RUNNING = "RUNNING"
+        DONE = "DONE"
+        FAILED = "FAILED"
+
+    def submit_task(self, task_type: str, table: str, config: dict) -> str:
+        def fn(s):
+            tasks = s.setdefault("tasks", {})
+            task_id = f"task_{task_type}_{len(tasks)}_{int(time.time() * 1000)}"
+            tasks[task_id] = {
+                "id": task_id, "type": task_type, "table": table,
+                "config": dict(config), "state": self.TaskState.PENDING,
+                "worker": None, "ts_ms": int(time.time() * 1000), "output": None,
+            }
+            return task_id
+
+        return self._tx(fn)
+
+    def claim_task(self, instance_id: str,
+                   task_types: Optional[list] = None) -> Optional[dict]:
+        """CAS-claim the oldest PENDING task (optionally restricted by type)."""
+
+        def fn(s):
+            pending = sorted(
+                (t for t in s.get("tasks", {}).values()
+                 if t["state"] == self.TaskState.PENDING
+                 and (task_types is None or t["type"] in task_types)),
+                key=lambda t: t["ts_ms"],
+            )
+            if not pending:
+                return None
+            t = pending[0]
+            t["state"] = self.TaskState.RUNNING
+            t["worker"] = instance_id
+            t["ts_ms"] = int(time.time() * 1000)
+            return dict(t)
+
+        return self._tx(fn)
+
+    def finish_task(self, task_id: str, ok: bool, output: Optional[str] = None) -> None:
+        def fn(s):
+            t = s.get("tasks", {}).get(task_id)
+            if t is not None:
+                t["state"] = self.TaskState.DONE if ok else self.TaskState.FAILED
+                t["output"] = output
+                t["ts_ms"] = int(time.time() * 1000)
+
+        self._tx(fn)
+
+    def touch_task(self, task_id: str) -> None:
+        """Executor heartbeat: a healthy long-running task refreshes ts_ms
+        so requeue_stale_tasks never requeues live work."""
+
+        def fn(s):
+            t = s.get("tasks", {}).get(task_id)
+            if t is not None and t["state"] == self.TaskState.RUNNING:
+                t["ts_ms"] = int(time.time() * 1000)
+
+        self._tx(fn)
+
+    def prune_terminal_tasks(self, ttl_ms: int = 3_600_000) -> int:
+        """GC DONE/FAILED tasks older than ``ttl_ms`` — the tasks map rides
+        every FileRegistry transaction, so history must stay bounded."""
+
+        def fn(s):
+            tasks = s.get("tasks", {})
+            cutoff = int(time.time() * 1000) - ttl_ms
+            dead = [tid for tid, t in tasks.items()
+                    if t["state"] in (self.TaskState.DONE, self.TaskState.FAILED)
+                    and t["ts_ms"] < cutoff]
+            for tid in dead:
+                del tasks[tid]
+            return len(dead)
+
+        return self._tx(fn)
+
+    def requeue_stale_tasks(self, stale_ms: int, max_attempts: int = 3) -> list:
+        """Repair path for dead minions (stale-COMMITTING analog of the
+        completion FSM): RUNNING tasks untouched for ``stale_ms`` go back to
+        PENDING (or FAILED once ``max_attempts`` claims burned)."""
+
+        def fn(s):
+            now = int(time.time() * 1000)
+            changed = []
+            for t in s.get("tasks", {}).values():
+                if t["state"] == self.TaskState.RUNNING \
+                        and now - t["ts_ms"] >= stale_ms:
+                    attempts = t.get("attempts", 1)
+                    if attempts >= max_attempts:
+                        t["state"] = self.TaskState.FAILED
+                        t["output"] = f"abandoned after {attempts} stale claims"
+                    else:
+                        t["state"] = self.TaskState.PENDING
+                        t["worker"] = None
+                        t["attempts"] = attempts + 1
+                    t["ts_ms"] = now
+                    changed.append(dict(t))
+            return changed
+
+        return self._tx(fn)
+
+    def tasks(self, table: Optional[str] = None,
+              state: Optional[str] = None) -> list:
+        def fn(s):
+            out = [dict(t) for t in s.get("tasks", {}).values()]
+            if table is not None:
+                out = [t for t in out if t["table"] == table]
+            if state is not None:
+                out = [t for t in out if t["state"] == state]
+            return sorted(out, key=lambda t: t["ts_ms"])
+
+        return self._tx_read(fn)
+
+    # ---- per-table task metadata (watermarks etc.; ZK minion metadata) ---
+    def task_metadata_get(self, table: str, task_type: str) -> dict:
+        return self._tx_read(
+            lambda s: dict(s.get("task_metadata", {}).get(table, {}).get(task_type, {}))
+        )
+
+    def task_metadata_set(self, table: str, task_type: str, meta: dict) -> None:
+        self._tx(lambda s: s.setdefault("task_metadata", {})
+                 .setdefault(table, {}).__setitem__(task_type, dict(meta)))
+
+    # ---- segment lineage (SegmentLineage analog: atomic replace) ---------
+    # {table: {lineage_id: {from: [...], to: [...], state, ts_ms}}}
+    # IN_PROGRESS: brokers route the FROM set (TO still loading);
+    # COMPLETED:   brokers route the TO set (FROM await deletion).
+    # The single-tx flip is what makes a merge swap atomic to queries.
+
+    def start_lineage(self, table: str, from_segments: list, to_segments: list) -> str:
+        def fn(s):
+            lin = s.setdefault("segment_lineage", {}).setdefault(table, {})
+            lid = f"lineage_{len(lin)}_{int(time.time() * 1000)}"
+            lin[lid] = {
+                "from": list(from_segments), "to": list(to_segments),
+                "state": "IN_PROGRESS", "ts_ms": int(time.time() * 1000),
+            }
+            return lid
+
+        return self._tx(fn)
+
+    def complete_lineage(self, table: str, lineage_id: str) -> bool:
+        """CAS flip IN_PROGRESS → COMPLETED. Returns False if the entry was
+        concurrently aborted/repaired — the caller MUST then abandon the
+        swap (deleting the FROM set after a lost flip loses both copies)."""
+
+        def fn(s):
+            e = s.get("segment_lineage", {}).get(table, {}).get(lineage_id)
+            if e is None or e["state"] != "IN_PROGRESS":
+                return False
+            e["state"] = "COMPLETED"
+            e["ts_ms"] = int(time.time() * 1000)
+            return True
+
+        return self._tx(fn)
+
+    def try_abort_lineage(self, table: str, lineage_id: str) -> bool:
+        """CAS IN_PROGRESS → ABORTING (controller repair claims the unwind).
+        ABORTING keeps the TO set routing-excluded while its segments are
+        deleted; False means the executor already flipped to COMPLETED."""
+
+        def fn(s):
+            e = s.get("segment_lineage", {}).get(table, {}).get(lineage_id)
+            if e is None or e["state"] == "COMPLETED":
+                return False
+            e["state"] = "ABORTING"
+            e["ts_ms"] = int(time.time() * 1000)
+            return True
+
+        return self._tx(fn)
+
+    def revert_lineage(self, table: str, lineage_id: str) -> bool:
+        """Drop a non-COMPLETED entry (failed/aborted replace). A COMPLETED
+        entry is never dropped here — prune_lineage GCs it once the FROM
+        set is fully gone."""
+
+        def fn(s):
+            lin = s.get("segment_lineage", {}).get(table, {})
+            e = lin.get(lineage_id)
+            if e is None or e["state"] == "COMPLETED":
+                return False
+            del lin[lineage_id]
+            return True
+
+        return self._tx(fn)
+
+    def lineage(self, table: str) -> dict:
+        return self._tx_read(
+            lambda s: {k: dict(v) for k, v in
+                       s.get("segment_lineage", {}).get(table, {}).items()}
+        )
+
+    def stale_in_progress_lineage(self, table: str, stale_ms: int) -> dict:
+        """Non-COMPLETED entries untouched for ``stale_ms`` (the executor —
+        or a previous repair — died mid-swap); the controller unwinds them."""
+        now = int(time.time() * 1000)
+        return {
+            lid: e for lid, e in self.lineage(table).items()
+            if e["state"] != "COMPLETED" and now - e["ts_ms"] >= stale_ms
+        }
+
+    def routing_snapshot(self, table: str) -> tuple:
+        """(external_view, segment records, lineage) in ONE read tx — the
+        broker's per-query read; a single FileRegistry parse instead of
+        three, and no cross-read consistency window."""
+
+        def fn(s):
+            view = {k: list(v) for k, v in
+                    s["external_view"].get(table, {}).items() if v}
+            records = dict(s["segments"].get(table, {}))
+            lineage = {k: dict(v) for k, v in
+                       s.get("segment_lineage", {}).get(table, {}).items()}
+            return view, records, lineage
+
+        return self._tx_read(fn)
+
+    def prune_lineage(self, table: str) -> int:
+        """GC COMPLETED entries whose FROM segments are fully deleted."""
+
+        def fn(s):
+            lin = s.get("segment_lineage", {}).get(table, {})
+            segs = s.get("segments", {}).get(table, {})
+            ev = s.get("external_view", {}).get(table, {})
+            gone = 0
+            for lid in list(lin):
+                e = lin[lid]
+                if e["state"] == "COMPLETED" and not any(
+                    f in segs or ev.get(f) for f in e["from"]
+                ):
+                    del lin[lid]
+                    gone += 1
+            return gone
 
         return self._tx(fn)
 

@@ -78,6 +78,9 @@ class TableConfig:
     partition: SegmentPartitionConfig = dataclasses.field(default_factory=SegmentPartitionConfig)
     upsert: UpsertConfig = dataclasses.field(default_factory=UpsertConfig)
     stream: Optional[StreamConfig] = None
+    # Minion task configs keyed by task type (TableTaskConfig analog), e.g.
+    # {"MergeRollupTask": {"max_docs_per_segment": 1_000_000}}
+    task_configs: dict = dataclasses.field(default_factory=dict)
 
     def __post_init__(self):
         # TableConfigUtils analog: star-trees pre-aggregate over all rows at

@@ -251,6 +251,40 @@ class Controller:
             raise KeyError(f"table {table!r} not found")
         return self.assigner.rebalance(table, cfg.replication)
 
+    # ---- minion task generation (PinotTaskManager analog) ----------------
+    def run_task_generation(self, now_ms: Optional[int] = None) -> list:
+        """Scan table task_configs and enqueue due minion tasks."""
+        from pinot_tpu.minion.generator import generate_tasks
+
+        return generate_tasks(self.registry, now_ms)
+
+    def run_task_repair(self, stale_ms: int = 600_000) -> dict:
+        """Repair after a minion death (TaskMetricsEmitter/stale-task sweep
+        analog, mirroring the completion FSM's stale-COMMITTING takeover):
+
+        - RUNNING tasks untouched for ``stale_ms`` requeue as PENDING
+          (FAILED once their claim attempts are exhausted);
+        - IN_PROGRESS lineage entries untouched for ``stale_ms`` unwind —
+          their TO segments are routing-excluded, so deleting them first and
+          then dropping the entry can never double-route.
+        """
+        requeued = self.registry.requeue_stale_tasks(stale_ms)
+        reverted = []
+        for table in self.registry.tables():
+            for lid, entry in self.registry.stale_in_progress_lineage(
+                    table, stale_ms).items():
+                # CAS-claim the unwind first: if the executor completed the
+                # flip in the meantime, the TO set is live data — touching
+                # it would delete the only remaining copy.
+                if not self.registry.try_abort_lineage(table, lid):
+                    continue
+                for name in entry["to"]:
+                    if name in self.registry.segments(table):
+                        self.delete_segment(table, name)
+                self.registry.revert_lineage(table, lid)
+                reverted.append((table, lid))
+        return {"requeued_tasks": requeued, "reverted_lineage": reverted}
+
     # ---- periodic maintenance (RetentionManager analog) ------------------
     def run_retention(self, now_ms: Optional[int] = None) -> list:
         """Drop segments whose time range fell out of the retention window."""

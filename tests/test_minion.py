@@ -1,0 +1,306 @@
+"""Minion task framework: mergeRollup / realtimeToOffline / purge.
+
+Reference analogs: MergeRollupMinionClusterIntegrationTest,
+RealtimeToOfflineSegmentsMinionClusterIntegrationTest,
+PurgeMinionClusterIntegrationTest — segment counts drop, query results
+stay identical, watermarks advance.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry, SegmentState
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.minion.worker import MinionWorker
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.stream.memory_stream import TopicRegistry
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "deepstore"))
+    servers = [
+        ServerInstance(f"server_{i}", registry, str(tmp_path / f"srv{i}"),
+                       device_executor=None)
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=10.0)
+    minion = MinionWorker(registry, controller, str(tmp_path / "minion"))
+    yield registry, controller, servers, broker, minion
+    minion.stop()
+    broker.close()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+
+
+def _sales_table(tmp_path, controller, task_configs, n_segments=4, rows=500):
+    schema = Schema.build(
+        name="sales",
+        dimensions=[("region", DataType.STRING), ("deleted", DataType.INT)],
+        metrics=[("amount", DataType.INT)],
+    )
+    cfg = TableConfig(table_name="sales", replication=1,
+                      task_configs=task_configs)
+    controller.add_table(cfg, schema)
+    rng = np.random.default_rng(17)
+    for i in range(n_segments):
+        cols = {
+            "region": np.array(["na", "eu", "apac"])[rng.integers(0, 3, rows)],
+            "deleted": (rng.random(rows) < 0.2).astype(np.int32),
+            "amount": rng.integers(1, 100, rows).astype(np.int32),
+        }
+        d = str(tmp_path / f"up_{i}")
+        build_segment(schema, cols, d, cfg, f"sales_s{i}")
+        controller.upload_segment("sales", d)
+    return schema, cfg
+
+
+def _rows(broker, sql):
+    r = broker.execute(sql)
+    assert not r.get("exceptions"), r
+    return r["resultTable"]["rows"]
+
+
+class TestMergeRollup:
+    def test_concat_merge_preserves_results(self, cluster, tmp_path):
+        registry, controller, servers, broker, minion = cluster
+        _sales_table(tmp_path, controller,
+                     {"MergeRollupTask": {"max_docs_per_segment": 10_000}})
+        assert wait_until(
+            lambda: len(registry.external_view("sales_OFFLINE")) == 4)
+        before = _rows(
+            broker,
+            "SELECT region, COUNT(*), SUM(amount) FROM sales "
+            "GROUP BY region ORDER BY region",
+        )
+
+        ids = controller.run_task_generation()
+        assert len(ids) == 1
+        task = minion.run_one()
+        assert task is not None and task["state"] == "DONE", task
+        # inputs deleted, single merged segment remains
+        segs = registry.segments("sales_OFFLINE")
+        assert len(segs) == 1 and next(iter(segs)).startswith("merged_")
+        assert wait_until(
+            lambda: set(registry.external_view("sales_OFFLINE"))
+            == set(segs))
+        after = _rows(
+            broker,
+            "SELECT region, COUNT(*), SUM(amount) FROM sales "
+            "GROUP BY region ORDER BY region",
+        )
+        assert after == before
+        # re-generation finds nothing new to merge, and the completed
+        # lineage entry is GC'd once servers stop serving the from-set
+        assert wait_until(lambda: controller.run_task_generation() == []
+                          and registry.lineage("sales_OFFLINE") == {})
+
+    def test_rollup_mode_aggregates_duplicate_rows(self, cluster, tmp_path):
+        registry, controller, servers, broker, minion = cluster
+        schema = Schema.build(
+            name="traffic",
+            dimensions=[("site", DataType.STRING)],
+            metrics=[("hits", DataType.LONG)],
+        )
+        cfg = TableConfig(
+            table_name="traffic", replication=1,
+            task_configs={"MergeRollupTask": {
+                "mode": "rollup", "rollup_aggregates": {"hits": "SUM"},
+            }},
+        )
+        controller.add_table(cfg, schema)
+        for i in range(3):
+            cols = {"site": ["a", "b", "a"], "hits": [1, 10, 100]}
+            d = str(tmp_path / f"tr_{i}")
+            build_segment(schema, cols, d, cfg, f"traffic_s{i}")
+            controller.upload_segment("traffic", d)
+        assert wait_until(
+            lambda: len(registry.external_view("traffic_OFFLINE")) == 3)
+        controller.run_task_generation()
+        task = minion.run_one()
+        assert task["state"] == "DONE", task
+        segs = registry.segments("traffic_OFFLINE")
+        assert len(segs) == 1
+        # rollup collapsed 9 rows to 2 groups; sums preserved
+        assert next(iter(segs.values())).n_docs == 2
+        assert wait_until(
+            lambda: set(registry.external_view("traffic_OFFLINE")) == set(segs))
+        rows = _rows(broker,
+                     "SELECT site, SUM(hits) FROM traffic GROUP BY site ORDER BY site")
+        assert rows == [["a", 303], ["b", 30]]
+
+    def test_worker_thread_drains_queue(self, cluster, tmp_path):
+        registry, controller, servers, broker, minion = cluster
+        _sales_table(tmp_path, controller,
+                     {"MergeRollupTask": {"max_docs_per_segment": 1_100}},
+                     n_segments=4, rows=500)
+        assert wait_until(
+            lambda: len(registry.external_view("sales_OFFLINE")) == 4)
+        minion.start()
+        ids = controller.run_task_generation()
+        assert len(ids) == 2  # 2 buckets of 2x500 docs under the 1100 cap
+        assert wait_until(lambda: all(
+            t["state"] == "DONE"
+            for t in registry.tasks(table="sales_OFFLINE")), timeout=30)
+        assert len(registry.segments("sales_OFFLINE")) == 2
+        assert _rows(broker, "SELECT COUNT(*) FROM sales") == [[2000]]
+
+
+class TestRepair:
+    def test_dead_minion_task_requeued_and_lineage_unwound(self, cluster, tmp_path):
+        """A minion that dies mid-task must not wedge the table: its RUNNING
+        claim requeues, and a mid-swap IN_PROGRESS lineage (with the
+        replacement already uploaded) unwinds without double-routing."""
+        registry, controller, servers, broker, minion = cluster
+        _sales_table(tmp_path, controller,
+                     {"MergeRollupTask": {"max_docs_per_segment": 10_000}})
+        assert wait_until(
+            lambda: len(registry.external_view("sales_OFFLINE")) == 4)
+        before = _rows(broker, "SELECT COUNT(*), SUM(amount) FROM sales")
+
+        ids = controller.run_task_generation()
+        # a "dead" minion claims the task and vanishes
+        claimed = registry.claim_task("minion_dead")
+        assert claimed is not None and claimed["id"] == ids[0]
+        # ... after having started the lineage swap and uploaded the merge
+        import numpy as np
+
+        from pinot_tpu.storage.creator import build_segment as _bs
+
+        schema = registry.table_schema("sales_OFFLINE")
+        cols = {"region": np.array(["na"] * 10), "deleted": np.zeros(10, np.int32),
+                "amount": np.ones(10, np.int32)}
+        d = str(tmp_path / "half_merged")
+        _bs(schema, cols, d, registry.table_config("sales_OFFLINE"), "half_merged")
+        lid = registry.start_lineage(
+            "sales_OFFLINE", claimed["config"]["segments"], ["half_merged"])
+        controller.upload_segment("sales_OFFLINE", d)
+        # the half-finished replacement must be invisible to queries
+        assert _rows(broker, "SELECT COUNT(*), SUM(amount) FROM sales") == before
+
+        rep = controller.run_task_repair(stale_ms=0)
+        assert rep["requeued_tasks"] and rep["reverted_lineage"]
+        assert "half_merged" not in registry.segments("sales_OFFLINE")
+        assert registry.lineage("sales_OFFLINE") == {}
+        # a live minion picks the requeued task up and finishes the job
+        task = minion.run_one()
+        assert task is not None and task["state"] == "DONE", task
+        segs = registry.segments("sales_OFFLINE")
+        assert len(segs) == 1
+        assert wait_until(
+            lambda: set(registry.external_view("sales_OFFLINE")) == set(segs))
+        assert _rows(broker, "SELECT COUNT(*), SUM(amount) FROM sales") == before
+
+
+class TestPurge:
+    def test_purge_drops_matching_rows(self, cluster, tmp_path):
+        registry, controller, servers, broker, minion = cluster
+        _sales_table(tmp_path, controller,
+                     {"PurgeTask": {"filter": "deleted = 1"}})
+        assert wait_until(
+            lambda: len(registry.external_view("sales_OFFLINE")) == 4)
+        keep = _rows(broker,
+                     "SELECT COUNT(*), SUM(amount) FROM sales WHERE deleted = 0")
+        ids = controller.run_task_generation()
+        assert len(ids) == 1
+        task = minion.run_one()
+        assert task["state"] == "DONE", task
+        segs = registry.segments("sales_OFFLINE")
+        assert wait_until(
+            lambda: set(registry.external_view("sales_OFFLINE")) == set(segs))
+        assert _rows(broker, "SELECT COUNT(*), SUM(amount) FROM sales") == keep
+        assert _rows(broker,
+                     "SELECT COUNT(*) FROM sales WHERE deleted = 1") == [[0]]
+        # purged markers recorded: nothing new generated
+        assert controller.run_task_generation() == []
+
+
+class TestRealtimeToOffline:
+    def test_moves_window_and_advances_watermark(self, cluster, tmp_path):
+        registry, controller, servers, broker, minion = cluster
+        TopicRegistry.delete("events")
+        topic = TopicRegistry.create("events", 1)
+        schema = Schema.build(
+            name="events",
+            dimensions=[("kind", DataType.STRING)],
+            metrics=[("v", DataType.INT)],
+            datetimes=[("ts", DataType.LONG)],
+        )
+        off_cfg = TableConfig(table_name="events", time_column="ts")
+        controller.add_table(off_cfg, schema)
+        rt_cfg = TableConfig(
+            table_name="events", table_type=TableType.REALTIME,
+            time_column="ts",
+            stream=StreamConfig(
+                stream_type="memory", topic="events", decoder="json",
+                segment_flush_threshold_rows=50,
+                segment_flush_threshold_seconds=3600,
+            ),
+            task_configs={"RealtimeToOfflineSegmentsTask": {
+                "bucket_ms": 1000, "buffer_ms": 0,
+            }},
+        )
+        controller.add_table(rt_cfg, schema)
+        # buckets: ts 0..99, 1000..1099, 2000..2049 (a single consume batch
+        # may seal them all into one segment — the window extract handles it)
+        for ts in (list(range(100)) + list(range(1000, 1100))
+                   + list(range(2000, 2050))):
+            topic.publish_json({"kind": f"k{ts % 3}", "v": 1, "ts": ts})
+        assert wait_until(lambda: any(
+            r.state == SegmentState.ONLINE
+            for r in registry.segments("events_REALTIME").values()), timeout=20)
+        assert wait_until(lambda: _rows(
+            broker, "SELECT COUNT(*) FROM events") == [[250]])
+
+        ids = controller.run_task_generation(now_ms=10_000)
+        assert len(ids) == 1
+        task = minion.run_one()
+        assert task["state"] == "DONE", task
+        # offline table received the bucket-0 rows
+        off_segs = registry.segments("events_OFFLINE")
+        assert len(off_segs) == 1
+        assert next(iter(off_segs.values())).n_docs == 100
+        meta = registry.task_metadata_get(
+            "events_REALTIME", "RealtimeToOfflineSegmentsTask")
+        assert meta["watermark_ms"] == 1000
+        # hybrid query still sees every row exactly once
+        assert wait_until(
+            lambda: len(registry.external_view("events_OFFLINE")) == 1)
+        assert _rows(broker, "SELECT COUNT(*) FROM events") == [[250]]
+
+        # next generation targets bucket 1 (bucket 2 stays: no data past it)
+        ids = controller.run_task_generation(now_ms=10_000)
+        assert len(ids) == 1
+        task = minion.run_one()
+        assert task["state"] == "DONE", task
+        assert registry.task_metadata_get(
+            "events_REALTIME", "RealtimeToOfflineSegmentsTask"
+        )["watermark_ms"] == 2000
+        assert wait_until(
+            lambda: len(registry.external_view("events_OFFLINE")) == 2)
+        assert _rows(broker, "SELECT COUNT(*) FROM events") == [[250]]
+        rows = _rows(broker,
+                     "SELECT kind, COUNT(*) FROM events GROUP BY kind ORDER BY kind")
+        assert [r[1] for r in rows] == [84, 83, 83]
