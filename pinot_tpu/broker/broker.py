@@ -188,10 +188,15 @@ class Broker:
         if off in tables and rt in tables:
             cfg = self.registry.table_config(off)
             if cfg is not None and cfg.time_column is not None:
+                # boundary counts only SERVABLE offline segments: a freshly
+                # pushed segment (e.g. a realtimeToOffline move) must not
+                # advance the boundary before any server can answer for it,
+                # or its window would transiently vanish from hybrid results
+                view, records, _ = self.registry.routing_snapshot(off)
                 ends = [
                     r.end_time
-                    for r in self.registry.segments(off).values()
-                    if r.end_time is not None
+                    for name, r in records.items()
+                    if r.end_time is not None and name in view
                 ]
                 if ends:
                     # TimeBoundaryManager semantics: back off one time unit

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import os
 import shutil
+import threading
 import time
 from typing import Optional
 
@@ -268,7 +269,10 @@ class Controller:
           their TO segments are routing-excluded, so deleting them first and
           then dropping the entry can never double-route.
         """
-        requeued = self.registry.requeue_stale_tasks(stale_ms)
+        # Unwind stale swaps BEFORE requeueing their tasks: a re-claimed
+        # task starts a fresh lineage + uploads a fresh replacement, and a
+        # later unwind of the OLD entry must never race with (or delete
+        # segments belonging to) the new attempt.
         reverted = []
         for table in self.registry.tables():
             for lid, entry in self.registry.stale_in_progress_lineage(
@@ -283,11 +287,43 @@ class Controller:
                         self.delete_segment(table, name)
                 self.registry.revert_lineage(table, lid)
                 reverted.append((table, lid))
+        requeued = self.registry.requeue_stale_tasks(stale_ms)
         return {"requeued_tasks": requeued, "reverted_lineage": reverted}
+
+    def start_periodic_tasks(self, interval_s: float = 60.0) -> None:
+        """ControllerPeriodicTaskScheduler analog: retention, realtime
+        repair, minion task generation and stale-task repair on a timer
+        (the reference schedules RetentionManager, RealtimeSegmentValidation-
+        Manager and PinotTaskManager the same way)."""
+        if getattr(self, "_periodic_thread", None) is not None:
+            return
+        self._periodic_stop = threading.Event()
+
+        def loop():
+            while not self._periodic_stop.wait(interval_s):
+                for step in (self.run_retention, self.run_realtime_repair,
+                             self.run_task_generation, self.run_task_repair):
+                    try:
+                        step()
+                    except Exception:
+                        log.exception("periodic task %s failed", step.__name__)
+
+        self._periodic_thread = threading.Thread(
+            target=loop, name="controller-periodic", daemon=True
+        )
+        self._periodic_thread.start()
+
+    def stop_periodic_tasks(self) -> None:
+        if getattr(self, "_periodic_thread", None) is not None:
+            self._periodic_stop.set()
+            self._periodic_thread.join(5)
+            self._periodic_thread = None
 
     # ---- periodic maintenance (RetentionManager analog) ------------------
     def run_retention(self, now_ms: Optional[int] = None) -> list:
         """Drop segments whose time range fell out of the retention window."""
+        from pinot_tpu.minion.generator import _busy_segments
+
         now_ms = now_ms or int(time.time() * 1000)
         dropped = []
         for table in self.registry.tables():
@@ -295,7 +331,14 @@ class Controller:
             if cfg is None or cfg.retention_days is None:
                 continue
             cutoff = now_ms - cfg.retention_days * 86_400_000
+            # segments mid-swap or claimed by a minion task are off limits:
+            # deleting a FROM segment while its replace is IN_PROGRESS would
+            # drop rows from routed results mid-swap (they age out of the
+            # busy set once the task/lineage resolves, and get deleted then)
+            busy = _busy_segments(self.registry, table)
             for name, rec in self.registry.segments(table).items():
+                if name in busy:
+                    continue
                 if rec.end_time is not None and rec.end_time < cutoff:
                     self.delete_segment(table, name)
                     dropped.append((table, name))

@@ -104,7 +104,11 @@ def generate_realtime_to_offline_tasks(registry, table: str, cfg: dict,
             or registry.lineage(table):
         return []  # exclusive with swaps: RTO reads live ONLINE segments
     bucket_ms = int(cfg.get("bucket_ms", 86_400_000))
-    buffer_ms = int(cfg.get("buffer_ms", 0))
+    # Reference default bufferTimePeriod=2d: the window must be well past
+    # "now" before moving — the guard against a slow partition whose
+    # in-window rows are still CONSUMING (we only read sealed segments, and
+    # consuming segments carry no time metadata to check directly).
+    buffer_ms = int(cfg.get("buffer_ms", 2 * 86_400_000))
     sealed = [r for r in registry.segments(table).values()
               if r.state == "ONLINE" and r.start_time is not None]
     if not sealed:

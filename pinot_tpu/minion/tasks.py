@@ -126,6 +126,13 @@ def _rollup(columns: dict, schema, aggregates: dict) -> dict:
                 # bits past 2^53
                 merged = np.zeros(n_groups, dtype=np.int64)
                 np.add.at(merged, gid, vals.astype(np.int64))
+                info = np.iinfo(vals.dtype)
+                if len(merged) and (merged.max() > info.max
+                                    or merged.min() < info.min):
+                    raise ValueError(
+                        f"rollup SUM of {name} overflows {vals.dtype}; "
+                        f"widen the schema column to LONG"
+                    )
                 merged = merged.astype(vals.dtype)
             else:
                 merged = np.bincount(gid, weights=vals.astype(np.float64),
@@ -191,6 +198,19 @@ def execute_merge_rollup(ctx: TaskContext, task: dict) -> str:
     schema = ctx.registry.table_schema(table)
     table_cfg = ctx.registry.table_config(table)
     records = ctx.registry.segments(table)
+    # Requeued-attempt idempotency: if a previous attempt already flipped a
+    # COMPLETED lineage over (some of) these inputs, the replacement is the
+    # live copy — re-merging would shadow it. Finish that attempt's cleanup
+    # (delete the leftover FROM segments) instead of redoing the merge.
+    input_set = set(cfg["segments"])
+    for entry in ctx.registry.lineage(table).values():
+        if entry["state"] == "COMPLETED" and input_set & set(entry["from"]):
+            for name in entry["from"]:
+                if name in records:
+                    ctx.controller.delete_segment(table, name)
+            ctx.registry.prune_lineage(table)
+            return (f"previous attempt already committed "
+                    f"{entry['to']}; cleaned up leftover inputs")
     names = [n for n in cfg["segments"] if n in records]
     if len(names) < 2:
         return f"skipped: only {len(names)} input segments still exist"
@@ -198,7 +218,11 @@ def execute_merge_rollup(ctx: TaskContext, task: dict) -> str:
     columns = _read_columns(segments, schema)
     if cfg.get("mode", "concat") == "rollup":
         columns = _rollup(columns, schema, cfg.get("rollup_aggregates", {}))
-    merged_name = f"merged_{table}_" + "_".join(task["id"].split("_")[-2:])
+    # name is unique per task AND per attempt: a requeued re-run must never
+    # collide with a half-dead prior attempt's upload
+    merged_name = (f"merged_{table}_"
+                   + "_".join(task["id"].split("_")[-2:])
+                   + f"_a{task.get('attempts', 1)}")
     out_dir = os.path.join(ctx.scratch(task["id"]), merged_name)
     build_segment(schema, columns, out_dir, table_cfg, merged_name)
     _lineage_swap(ctx, table, names, out_dir, merged_name)
@@ -246,6 +270,19 @@ def execute_realtime_to_offline(ctx: TaskContext, task: dict) -> str:
         out_dir = os.path.join(ctx.scratch(task["id"]), name)
         build_segment(schema, columns, out_dir, off_cfg, name)
         ctx.controller.upload_segment(off_table, out_dir)
+        # Gate on a server actually serving the pushed segment before
+        # advancing the watermark: the hybrid time boundary only moves for
+        # externally-visible offline segments (broker._physical_tables), so
+        # the window never goes dark between push and load. On timeout the
+        # push is unwound and the watermark stays put for a retry.
+        if not _wait_until(
+            lambda: name in ctx.registry.external_view(off_table)
+        ):
+            ctx.controller.delete_segment(off_table, name)
+            raise TimeoutError(
+                f"offline segment {name} never reached the external view "
+                f"of {off_table}; watermark not advanced"
+            )
     meta = ctx.registry.task_metadata_get(rt_table, "RealtimeToOfflineSegmentsTask")
     meta["watermark_ms"] = we
     ctx.registry.task_metadata_set(rt_table, "RealtimeToOfflineSegmentsTask", meta)

@@ -1,0 +1,246 @@
+"""Batch ingestion + quickstart + admin CLI.
+
+Reference analogs: CSVRecordReaderTest / JSONRecordReaderTest,
+IngestionJobLauncher standalone flow (SegmentGenerationJobRunner +
+push), QuickStart smoke, PinotAdministrator command surface.
+"""
+
+import csv
+import json
+import os
+import time
+
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.ingestion.job import IngestionJobSpec, run_ingestion_job
+from pinot_tpu.ingestion.readers import (
+    CSVRecordReader,
+    JSONRecordReader,
+    create_record_reader,
+    rows_to_columns,
+)
+from pinot_tpu.server.server import ServerInstance
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+SCHEMA = Schema.build(
+    name="t",
+    dimensions=[("name", DataType.STRING)],
+    multi_value_dimensions=[("tags", DataType.STRING)],
+    metrics=[("score", DataType.DOUBLE)],
+    datetimes=[("ts", DataType.LONG)],
+)
+
+
+class TestReaders:
+    def test_csv_types_mv_and_nulls(self, tmp_path):
+        p = tmp_path / "in.csv"
+        p.write_text(
+            "name,tags,score,ts\n"
+            "alice,red;blue,1.5,100\n"
+            "bob,,2.0,200\n"
+            "carol,green,,300\n"
+        )
+        cols = CSVRecordReader().read_columns(str(p), SCHEMA)
+        assert cols["name"] == ["alice", "bob", "carol"]
+        assert cols["tags"] == [["red", "blue"], [], ["green"]]
+        assert cols["score"] == [1.5, 2.0, DataType.DOUBLE.default_null]
+        assert cols["ts"] == [100, 200, 300]
+
+    def test_json_lines_and_array(self, tmp_path):
+        rows = [
+            {"name": "a", "tags": ["x"], "score": 1, "ts": 10},
+            {"name": "b", "tags": [], "score": 2.5, "ts": 20},
+        ]
+        pl = tmp_path / "in.jsonl"
+        pl.write_text("\n".join(json.dumps(r) for r in rows))
+        pa = tmp_path / "in.json"
+        pa.write_text(json.dumps(rows))
+        for path in (pl, pa):
+            cols = JSONRecordReader().read_columns(str(path), SCHEMA)
+            assert cols["name"] == ["a", "b"]
+            assert cols["tags"] == [["x"], []]
+            assert cols["score"] == [1.0, 2.5]
+
+    def test_missing_column_takes_default_null(self):
+        cols = rows_to_columns([{"name": "a"}], SCHEMA)
+        assert cols["score"] == [DataType.DOUBLE.default_null]
+        assert cols["ts"] == [DataType.LONG.default_null]
+        assert cols["tags"] == [[]]
+
+    def test_unknown_format_raises(self):
+        with pytest.raises(ValueError, match="unknown input format"):
+            create_record_reader("avro")
+
+    def test_parquet_roundtrip(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        pq = pytest.importorskip("pyarrow.parquet")
+        table = pa.table({
+            "name": ["a", "b"], "tags": [["x", "y"], []],
+            "score": [1.0, 2.5], "ts": [10, 20],
+        })
+        p = tmp_path / "in.parquet"
+        pq.write_table(table, str(p))
+        cols = create_record_reader("parquet").read_columns(str(p), SCHEMA)
+        assert cols["name"] == ["a", "b"]
+        assert cols["tags"] == [["x", "y"], []]
+        assert cols["score"] == [1.0, 2.5]
+        assert cols["ts"] == [10, 20]
+
+
+class TestIngestionJob:
+    def test_job_builds_and_pushes_per_file(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        servers = [ServerInstance("server_0", registry, str(tmp_path / "s0"),
+                                  device_executor=None)]
+        servers[0].start()
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            schema = Schema.build(
+                name="towns",
+                dimensions=[("town", DataType.STRING)],
+                metrics=[("pop", DataType.LONG)],
+            )
+            controller.add_table(TableConfig(table_name="towns"), schema)
+            data = tmp_path / "files"
+            data.mkdir()
+            total = 0
+            for i in range(3):
+                with open(data / f"part_{i}.csv", "w", newline="") as f:
+                    w = csv.writer(f)
+                    w.writerow(["town", "pop"])
+                    for j in range(10):
+                        w.writerow([f"town{i}_{j}", 100 * i + j])
+                        total += 100 * i + j
+            spec = IngestionJobSpec(table_name="towns", input_dir=str(data),
+                                    include_pattern="*.csv", format="csv")
+            built = run_ingestion_job(spec, controller)
+            assert len(built) == 3
+            assert len(registry.segments("towns_OFFLINE")) == 3
+            assert wait_until(
+                lambda: len(registry.external_view("towns_OFFLINE")) == 3)
+            r = broker.execute("SELECT COUNT(*), SUM(pop) FROM towns")
+            assert not r.get("exceptions"), r
+            assert r["resultTable"]["rows"] == [[30, total]]
+        finally:
+            broker.close()
+            servers[0].stop()
+
+    def test_job_spec_json_roundtrip(self, tmp_path):
+        spec = IngestionJobSpec(table_name="t", input_dir="/x",
+                                format="json", push=False)
+        p = tmp_path / "spec.json"
+        p.write_text(json.dumps(spec.to_json()))
+        assert IngestionJobSpec.load(str(p)) == spec
+
+    def test_no_matching_files_raises(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        schema = Schema.build(name="e", dimensions=[("a", DataType.STRING)])
+        controller.add_table(TableConfig(table_name="e"), schema)
+        with pytest.raises(FileNotFoundError):
+            run_ingestion_job(
+                IngestionJobSpec(table_name="e", input_dir=str(tmp_path)),
+                controller,
+            )
+
+
+class TestQuickstart:
+    def test_quickstart_end_to_end(self, tmp_path):
+        from pinot_tpu.tools.quickstart import run_quickstart
+
+        lines = []
+        handle = run_quickstart(work_dir=str(tmp_path / "qs"),
+                                out=lines.append, device_executor=None)
+        try:
+            r = handle.execute("SELECT COUNT(*) FROM baseballStats")
+            assert not r.get("exceptions"), r
+            assert r["resultTable"]["rows"] == [[1000]]  # 2 files x 500 rows
+            r = handle.execute(
+                "SELECT teamID, SUM(runs) FROM baseballStats "
+                "GROUP BY teamID ORDER BY SUM(runs) DESC LIMIT 3"
+            )
+            assert len(r["resultTable"]["rows"]) == 3
+            # HTTP endpoint serves too
+            import urllib.request
+
+            req = urllib.request.Request(
+                handle.http.url + "/query/sql",
+                data=json.dumps(
+                    {"sql": "SELECT COUNT(*) FROM baseballStats"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert json.loads(resp.read())["resultTable"]["rows"] == [[1000]]
+            assert any("example" in l or ">" in l for l in lines)
+        finally:
+            handle.stop()
+
+
+class TestAdminCli:
+    def test_multiprocess_style_flow_over_file_registry(self, tmp_path, capsys):
+        """add-table + ingest + query against a FileRegistry shared with an
+        in-process server (the CLI's multi-process contract, single-process
+        here so the test stays hermetic)."""
+        from pinot_tpu.cluster.registry import FileRegistry
+        from pinot_tpu.tools.admin import main
+
+        reg_path = str(tmp_path / "cluster.json")
+        schema = Schema.build(
+            name="towns",
+            dimensions=[("town", DataType.STRING)],
+            metrics=[("pop", DataType.LONG)],
+        )
+        schema_path = tmp_path / "schema.json"
+        schema.save(str(schema_path))
+        cfg_path = tmp_path / "table.json"
+        cfg_path.write_text(json.dumps(TableConfig(table_name="towns").to_json()))
+
+        assert main(["add-table", "--registry", reg_path,
+                     "--schema", str(schema_path), "--config", str(cfg_path),
+                     "--deep-store", str(tmp_path / "ds")]) == 0
+
+        # a server joins the same registry file
+        server = ServerInstance("server_0", FileRegistry(reg_path),
+                                str(tmp_path / "s0"), device_executor=None)
+        server.start()
+        try:
+            data = tmp_path / "files"
+            data.mkdir()
+            with open(data / "a.csv", "w", newline="") as f:
+                w = csv.writer(f)
+                w.writerow(["town", "pop"])
+                w.writerow(["springfield", 30000])
+                w.writerow(["shelbyville", 20000])
+            spec_path = tmp_path / "job.json"
+            spec_path.write_text(json.dumps(IngestionJobSpec(
+                table_name="towns", input_dir=str(data)).to_json()))
+            assert main(["ingest", "--registry", reg_path,
+                         "--spec", str(spec_path),
+                         "--deep-store", str(tmp_path / "ds")]) == 0
+            reg = FileRegistry(reg_path)
+            assert wait_until(
+                lambda: len(reg.external_view("towns_OFFLINE")) == 1)
+            rc = main(["query", "--registry", reg_path,
+                       "--sql", "SELECT SUM(pop) FROM towns"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            resp = json.loads(out[out.index("{"):])
+            assert resp["resultTable"]["rows"] == [[50000]]
+        finally:
+            server.stop()
