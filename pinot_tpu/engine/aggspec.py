@@ -56,6 +56,11 @@ class AggSpec:
     def finalize(self, part: dict) -> np.ndarray:
         raise NotImplementedError
 
+    def take(self, part: dict, idx: np.ndarray) -> dict:
+        """Row-select a partial (server-side trim): every state field is a
+        per-group array, so fancy indexing covers all specs."""
+        return {k: np.asarray(v)[idx] for k, v in part.items()}
+
     def result_type(self) -> str:
         return "DOUBLE"
 

@@ -13,6 +13,8 @@ scan-based operators (FilterOperatorUtils.java:165-194).
 
 from __future__ import annotations
 
+import logging
+import threading
 import time
 
 from pinot_tpu.engine.host import HostExecutor
@@ -28,6 +30,8 @@ from pinot_tpu.query.optimizer import optimize_query
 from pinot_tpu.sql.compiler import compile_query
 from pinot_tpu.storage.bloom import BloomFilter
 from pinot_tpu.storage.segment import ImmutableSegment
+
+log = logging.getLogger("pinot_tpu.engine")
 
 
 class SegmentPruner:
@@ -85,20 +89,64 @@ class SegmentPruner:
 
 class TableDataManager:
     """Segments of one table (data/manager/offline/OfflineTableDataManager
-    analog, single-process)."""
+    analog): acquire/release refcounting so an unload (retention, minion
+    swap, rebalance) during an in-flight query defers teardown — the
+    reference's ``acquireSegment``/``releaseSegment`` on TableDataManager.
+    ``on_unload`` fires once the last reference drains (the server deletes
+    its local working copy there)."""
 
     def __init__(self, name: str):
         self.name = name
         self.segments: dict[str, ImmutableSegment] = {}
+        self._refs: dict[str, int] = {}
+        self._doomed: dict[str, ImmutableSegment] = {}
+        self._lock = threading.Lock()
+        self.on_unload = None  # callback(segment) after last ref drops
 
     def add_segment(self, seg: ImmutableSegment) -> None:
-        self.segments[seg.name] = seg
+        with self._lock:
+            self.segments[seg.name] = seg
+            self._doomed.pop(seg.name, None)  # re-add wins over unload
 
     def remove_segment(self, name: str) -> None:
-        self.segments.pop(name, None)
+        with self._lock:
+            seg = self.segments.pop(name, None)
+            if seg is None:
+                return
+            if self._refs.get(name, 0) > 0:
+                self._doomed[name] = seg  # teardown deferred to release()
+                return
+            self._refs.pop(name, None)
+        self._fire_unload(seg)
 
     def acquire(self) -> list:
-        return list(self.segments.values())
+        with self._lock:
+            segs = list(self.segments.values())
+            for s in segs:
+                self._refs[s.name] = self._refs.get(s.name, 0) + 1
+            return segs
+
+    def release(self, segments) -> None:
+        to_unload = []
+        with self._lock:
+            for s in segments:
+                left = self._refs.get(s.name, 1) - 1
+                if left > 0:
+                    self._refs[s.name] = left
+                    continue
+                self._refs.pop(s.name, None)
+                doomed = self._doomed.pop(s.name, None)
+                if doomed is not None:
+                    to_unload.append(doomed)
+        for seg in to_unload:
+            self._fire_unload(seg)
+
+    def _fire_unload(self, seg) -> None:
+        if self.on_unload is not None:
+            try:
+                self.on_unload(seg)
+            except Exception:  # noqa: BLE001 — unload cleanup is best-effort
+                log.exception("segment unload callback failed for %s", seg.name)
 
 
 class QueryEngine:
@@ -156,11 +204,14 @@ class QueryEngine:
         if tdm is None:
             raise KeyError(f"table {q.table_name!r} not found")
         segments = tdm.acquire()
-        if not segments:
-            raise ValueError(f"table {q.table_name!r} has no segments")
-        merged = self.execute_segments(q, segments)
-        q = self._expand_star(q, segments[0])
-        return finalize(q, merged), merged.stats
+        try:
+            if not segments:
+                raise ValueError(f"table {q.table_name!r} has no segments")
+            merged = self.execute_segments(q, segments)
+            q = self._expand_star(q, segments[0])
+            return finalize(q, merged), merged.stats
+        finally:
+            tdm.release(segments)
 
     def execute_segments(self, q: QueryContext, segments):
         """Server-side partial execution over an explicit segment list →

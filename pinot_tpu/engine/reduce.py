@@ -92,6 +92,38 @@ def merge_intermediates(q: QueryContext, results: list) -> IntermediateResult:
     raise ValueError(f"unknown result shape {shape}")
 
 
+def trim_group_by(q: QueryContext, merged: IntermediateResult,
+                  min_trim_size: int = 5000) -> IntermediateResult:
+    """Server-side order-by-aware group trim before the DataTable ships
+    (data/table/TableResizer.java analog): keep the top
+    ``max(5 * (offset+limit), min_trim_size)`` groups by the query's ORDER
+    BY, evaluated on finalized local partials. The 5x headroom is the
+    reference's guard against a group that is globally top-K but not
+    locally top-K on this server; HAVING queries are not trimmed (the
+    broker filters groups after the merge, so any local trim could starve
+    it of survivors)."""
+    if merged.shape != "group_by" or not q.order_by or q.having is not None:
+        return merged
+    n = len(merged.group_keys[0])
+    trim_size = max(5 * (q.offset + q.limit), min_trim_size)
+    if n <= trim_size:
+        return merged
+    specs = [aggspec.make_spec(a) for a in q.aggregations()]
+    env = _group_env(q, merged, specs)
+    order = _order_indices(
+        [(np.broadcast_to(np.asarray(eval_post(ob.expression, env)), (n,)),
+          ob.ascending)
+         for ob in q.order_by]
+    )[:trim_size]
+    return IntermediateResult(
+        "group_by",
+        group_keys=tuple(np.asarray(k)[order] for k in merged.group_keys),
+        agg_partials=[s.take(p, order)
+                      for s, p in zip(specs, merged.agg_partials)],
+        stats=merged.stats,
+    )
+
+
 # ---------------------------------------------------------------------------
 # post-aggregation expression evaluation
 # ---------------------------------------------------------------------------

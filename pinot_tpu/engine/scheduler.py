@@ -1,0 +1,64 @@
+"""Query scheduler: bounded admission for server query execution.
+
+Equivalent of the reference's ``QueryScheduler`` hierarchy
+(pinot-core/.../query/scheduler/QueryScheduler.java:56 +
+BoundedAccountingExecutor / FCFSQueryScheduler): a hard cap on concurrently
+executing queries plus a bounded wait queue; past both, the query is
+rejected immediately with an in-band error rather than piling onto gRPC
+threads — one runaway high-cardinality query can no longer starve the
+server. (Per-query resource accounting lives in the stats the engine
+already returns; token-bucket priority across tables is not modeled.)
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class SchedulerSaturated(Exception):
+    """Queue full: the caller should surface QUERY_SCHEDULING_TIMEOUT."""
+
+
+class QueryScheduler:
+    def __init__(self, max_concurrent: int = 8, max_queued: int = 32,
+                 queue_timeout_s: float = 5.0):
+        # queue_timeout_s must stay below the broker's query timeout (10s
+        # default): a slot granted after the broker abandoned the request
+        # would burn a worker doing work nobody reads.
+        self.max_concurrent = max_concurrent
+        self.max_queued = max_queued
+        self.queue_timeout_s = queue_timeout_s
+        self._sem = threading.Semaphore(max_concurrent)
+        self._lock = threading.Lock()
+        self._waiting = 0
+        self.num_rejected = 0
+        self.num_executed = 0
+
+    def run(self, fn):
+        """Execute ``fn`` under the concurrency cap; raises
+        SchedulerSaturated when the wait queue is full or the slot wait
+        times out."""
+        with self._lock:
+            if self._waiting >= self.max_queued:
+                self.num_rejected += 1
+                raise SchedulerSaturated(
+                    f"query queue full ({self._waiting} waiting, "
+                    f"{self.max_concurrent} running)"
+                )
+            self._waiting += 1
+        try:
+            if not self._sem.acquire(timeout=self.queue_timeout_s):
+                with self._lock:
+                    self.num_rejected += 1
+                raise SchedulerSaturated(
+                    f"no execution slot within {self.queue_timeout_s}s"
+                )
+        finally:
+            with self._lock:
+                self._waiting -= 1
+        try:
+            with self._lock:
+                self.num_executed += 1
+            return fn()
+        finally:
+            self._sem.release()

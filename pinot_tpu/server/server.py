@@ -27,6 +27,8 @@ from pinot_tpu.cluster.registry import (
 )
 from pinot_tpu.engine.datatable import encode, encode_error
 from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.engine.reduce import trim_group_by
+from pinot_tpu.engine.scheduler import QueryScheduler, SchedulerSaturated
 from pinot_tpu.query.optimizer import optimize_query
 from pinot_tpu.sql.compiler import compile_query
 from pinot_tpu.storage.segment import ImmutableSegment
@@ -38,14 +40,26 @@ log = logging.getLogger("pinot_tpu.server")
 class ServerInstance:
     def __init__(self, instance_id: str, registry: ClusterRegistry,
                  data_dir: str, host: str = "127.0.0.1", port: int = 0,
-                 sync_interval_s: float = 0.2, device_executor="auto"):
+                 sync_interval_s: float = 0.2, device_executor="auto",
+                 max_concurrent_queries: int = 8, max_queued_queries: int = 32,
+                 group_trim_size: int = 5000):
         self.instance_id = instance_id
         self.registry = registry
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self.engine = QueryEngine(device_executor=device_executor)
-        self.transport = QueryServerTransport(self._handle_submit, host=host, port=port)
+        # transport threads must cover running + queued queries, or requests
+        # queue invisibly in grpc's executor and time out as transport
+        # failures (poisoning the broker's failure detector) before the
+        # scheduler's in-band rejection can ever fire
+        self.transport = QueryServerTransport(
+            self._handle_submit, host=host, port=port,
+            max_workers=max_concurrent_queries + max_queued_queries + 2,
+        )
         self.sync_interval_s = sync_interval_s
+        self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries,
+                                        max_queued=max_queued_queries)
+        self.group_trim_size = group_trim_size
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
@@ -77,7 +91,11 @@ class ServerInstance:
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)
         try:
-            return self._handle_submit_inner(req)
+            return self.scheduler.run(lambda: self._handle_submit_inner(req))
+        except SchedulerSaturated as e:
+            # admission rejection is a query-level error: the server is
+            # healthy (broker must not poison its failure detector)
+            return encode_error("query_error", f"QUERY_SCHEDULING_TIMEOUT: {e}")
         except Exception as e:  # noqa: BLE001 — query errors ship in-band
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
@@ -107,21 +125,25 @@ class ServerInstance:
             q = dataclasses.replace(q, filter=new_filter)
         tdm = self.engine.tables.get(q.table_name)
         wanted = set(req["segments"])
-        segments = [] if tdm is None else [
-            s for s in tdm.acquire() if s.name in wanted
-        ]
-        if not segments:
-            # benign routing race (segments moved since the broker's
-            # external-view read): tell the broker to skip this partial
-            return encode_error(
-                "no_segments",
-                f"server {self.instance_id} hosts none of the requested "
-                f"segments for table {q.table_name!r}",
-            )
-        # requested-but-missing segments (assignment raced ahead of loading)
-        # are simply absent from this partial, like the reference's
-        # missing-segment accounting
-        merged = self.engine.execute_segments(q, segments)
+        acquired = [] if tdm is None else tdm.acquire()
+        try:
+            segments = [s for s in acquired if s.name in wanted]
+            if not segments:
+                # benign routing race (segments moved since the broker's
+                # external-view read): tell the broker to skip this partial
+                return encode_error(
+                    "no_segments",
+                    f"server {self.instance_id} hosts none of the requested "
+                    f"segments for table {q.table_name!r}",
+                )
+            # requested-but-missing segments (assignment raced ahead of
+            # loading) are simply absent from this partial, like the
+            # reference's missing-segment accounting
+            merged = self.engine.execute_segments(q, segments)
+        finally:
+            if tdm is not None:
+                tdm.release(acquired)
+        merged = trim_group_by(q, merged, self.group_trim_size)
         self.queries_served += 1
         return encode(merged)
 
@@ -135,22 +157,94 @@ class ServerInstance:
                 log.exception("segment sync failed")
             self._stop.wait(self.sync_interval_s)
 
+    def _local_segment_dir(self, table: str, name: str) -> str:
+        return os.path.join(self.data_dir, "segments", table, name)
+
+    def _download_segment(self, table: str, rec) -> str:
+        """Deep store → local working copy before load, like the reference's
+        BaseTableDataManager.downloadSegment: queries never mmap deep-store
+        files that a controller delete (retention, minion swap) can rm mid-
+        read. Paths already under this server's data_dir (own realtime
+        seals) are served in place; a CRC change (refresh push) re-copies."""
+        import shutil
+
+        src = rec.location
+        if os.path.commonpath([os.path.abspath(src),
+                               os.path.abspath(self.data_dir)]) \
+                == os.path.abspath(self.data_dir):
+            return src
+        local = self._local_segment_dir(table, rec.name)
+        if os.path.isdir(local):
+            if rec.crc is None:
+                return local
+            try:
+                if ImmutableSegment(local).metadata.crc == rec.crc:
+                    return local
+            except Exception:  # noqa: BLE001 — corrupt copy: re-download
+                pass
+            shutil.rmtree(local, ignore_errors=True)
+        os.makedirs(os.path.dirname(local), exist_ok=True)
+        tmp = f"{local}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)  # debris from a dead copy
+        try:
+            shutil.copytree(src, tmp)
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if os.path.isdir(local):  # another loader won the copy race
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            os.replace(tmp, local)
+        return local
+
+    def _on_segment_unload(self, tdm, seg) -> None:
+        """Last reference drained after an unload: drop the local copy
+        (deferred teardown is what the refcount buys — an in-flight query
+        finished with the mmap before the files went away). If the segment
+        was REASSIGNED meanwhile and a live entry is serving from the same
+        directory, the delete is skipped — removing it would orphan the
+        re-added copy's lazily-mmap'd files."""
+        import shutil
+
+        local_root = os.path.abspath(os.path.join(self.data_dir, "segments"))
+        seg_dir = os.path.abspath(seg.dir)
+        if os.path.commonpath([seg_dir, local_root]) != local_root:
+            return
+        cur = tdm.segments.get(seg.name)
+        if cur is not None and os.path.abspath(cur.dir) == seg_dir:
+            return
+        shutil.rmtree(seg_dir, ignore_errors=True)
+
     def _sync_once(self) -> None:
         assigned = self.registry.assigned_segments(self.instance_id)
         # load newly-assigned sealed segments (OFFLINE→ONLINE)
         for table, names in assigned.items():
             records = self.registry.segments(table)
             tdm = self.engine.table(table)
+            if tdm.on_unload is None:
+                tdm.on_unload = (
+                    lambda seg, _tdm=tdm: self._on_segment_unload(_tdm, seg))
             for name in names:
                 rec = records.get(name)
                 if rec is None or rec.state != SegmentState.ONLINE:
                     continue
-                if name not in tdm.segments:
-                    try:
-                        tdm.add_segment(ImmutableSegment(rec.location))
-                    except Exception:
-                        log.exception("failed to load segment %s from %s",
-                                      name, rec.location)
+                cur = tdm.segments.get(name)
+                if cur is not None:
+                    # self-heal the unload/re-add race: if a deferred delete
+                    # won and this entry's files vanished, drop it so the
+                    # next tick re-downloads a fresh copy
+                    if not os.path.isfile(os.path.join(cur.dir, "metadata.json")):
+                        log.warning("segment %s lost its local files; "
+                                    "reloading", name)
+                        tdm.remove_segment(name)
+                    continue
+                try:
+                    tdm.add_segment(
+                        ImmutableSegment(self._download_segment(table, rec))
+                    )
+                except Exception:
+                    log.exception("failed to load segment %s from %s",
+                                  name, rec.location)
         # unload segments no longer assigned (ONLINE→OFFLINE/DROPPED);
         # consuming (mutable) segments belong to the realtime managers
         for table, tdm in list(self.engine.tables.items()):

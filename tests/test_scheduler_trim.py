@@ -1,0 +1,245 @@
+"""Server-side group trim, query scheduler admission, segment refcounts.
+
+Reference analogs: TableResizer / trimSize semantics, QueryScheduler +
+BoundedAccountingExecutor rejection, TableDataManager acquire/release
+with deferred teardown.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.engine.engine import QueryEngine, TableDataManager
+from pinot_tpu.engine.reduce import trim_group_by
+from pinot_tpu.engine.scheduler import QueryScheduler, SchedulerSaturated
+from pinot_tpu.query.optimizer import optimize_query
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.sql.compiler import compile_query
+from pinot_tpu.storage.creator import build_segment
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _seg(tmp_path, name, n=4000, seed=0):
+    schema = Schema.build(
+        name="s",
+        dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG)],
+    )
+    rng = np.random.default_rng(seed)
+    cols = {
+        "k": np.array([f"key{i:05d}" for i in rng.integers(0, 2000, n)]),
+        "v": rng.integers(1, 100, n).astype(np.int64),
+    }
+    d = str(tmp_path / name)
+    build_segment(schema, cols, d, TableConfig(table_name="s"), name)
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    return schema, cols, ImmutableSegment(d)
+
+
+class TestGroupTrim:
+    def _merged(self, tmp_path, sql):
+        schema, cols, seg = _seg(tmp_path, "t0")
+        engine = QueryEngine(device_executor=None)
+        q = optimize_query(compile_query(sql))
+        return q, engine.execute_segments(q, [seg]), cols
+
+    def test_trim_bounds_groups_and_keeps_topk_exact(self, tmp_path):
+        sql = ("SELECT k, SUM(v) FROM s GROUP BY k "
+               "ORDER BY SUM(v) DESC LIMIT 4")
+        q, merged, cols = self._merged(tmp_path, sql)
+        n_full = len(merged.group_keys[0])
+        assert n_full > 100
+        trimmed = trim_group_by(q, merged, min_trim_size=50)
+        assert len(trimmed.group_keys[0]) == 50
+        # top-LIMIT result identical to the untrimmed reduce
+        from pinot_tpu.engine.reduce import finalize
+
+        assert finalize(q, trimmed).rows == finalize(q, merged).rows
+
+    def test_no_trim_without_order_by_or_with_having(self, tmp_path):
+        q, merged, _ = self._merged(
+            tmp_path, "SELECT k, SUM(v) FROM s GROUP BY k LIMIT 4")
+        assert trim_group_by(q, merged, min_trim_size=10) is merged
+        q2, merged2, _ = self._merged(
+            tmp_path,
+            "SELECT k, SUM(v) FROM s GROUP BY k HAVING SUM(v) > 50 "
+            "ORDER BY SUM(v) DESC LIMIT 4",
+        )
+        assert trim_group_by(q2, merged2, min_trim_size=10) is merged2
+
+    def test_trim_respects_5x_headroom(self, tmp_path):
+        q, merged, _ = self._merged(
+            tmp_path,
+            "SELECT k, SUM(v) FROM s GROUP BY k ORDER BY SUM(v) DESC LIMIT 30",
+        )
+        trimmed = trim_group_by(q, merged, min_trim_size=10)
+        assert len(trimmed.group_keys[0]) == 150  # 5 * limit > min_trim
+
+    def test_cluster_trimmed_group_by_matches_oracle(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        servers = [
+            ServerInstance(f"server_{i}", registry, str(tmp_path / f"s{i}"),
+                           device_executor=None, group_trim_size=40)
+            for i in range(2)
+        ]
+        for s in servers:
+            s.start()
+        from pinot_tpu.broker.broker import Broker
+
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            schema = Schema.build(
+                name="sales",
+                dimensions=[("k", DataType.STRING)],
+                metrics=[("v", DataType.LONG)],
+            )
+            cfg = TableConfig(table_name="sales", replication=1)
+            controller.add_table(cfg, schema)
+            # Per-group values identical in every segment, so local order ==
+            # global order and the (by-design approximate) trim must return
+            # the exact global top-K. 500 groups >> trim size 40.
+            for i in range(4):
+                cols = {
+                    "k": np.array([f"g{j:04d}" for j in range(500)]),
+                    "v": np.arange(500, dtype=np.int64),
+                }
+                d = str(tmp_path / f"up{i}")
+                build_segment(schema, cols, d, cfg, f"sales_{i}")
+                controller.upload_segment("sales", d)
+            assert wait_until(
+                lambda: len(registry.external_view("sales_OFFLINE")) == 4)
+            r = broker.execute(
+                "SELECT k, SUM(v) FROM sales GROUP BY k "
+                "ORDER BY SUM(v) DESC, k ASC LIMIT 5"
+            )
+            assert not r.get("exceptions"), r
+            want = [(f"g{j:04d}", 4.0 * j) for j in range(499, 494, -1)]
+            assert [tuple(row) for row in r["resultTable"]["rows"]] == want
+        finally:
+            broker.close()
+            for s in servers:
+                s.stop()
+
+
+class TestQueryScheduler:
+    def test_rejects_when_saturated(self):
+        sched = QueryScheduler(max_concurrent=1, max_queued=1,
+                               queue_timeout_s=5.0)
+        release = threading.Event()
+        started = threading.Event()
+        results = []
+
+        def slow():
+            started.set()
+            release.wait(10)
+            return "slow-done"
+
+        t1 = threading.Thread(
+            target=lambda: results.append(sched.run(slow)))
+        t1.start()
+        assert started.wait(5)
+        # one waiter fits in the queue...
+        t2 = threading.Thread(
+            target=lambda: results.append(sched.run(lambda: "queued-done")))
+        t2.start()
+        assert wait_until(lambda: sched._waiting == 1, timeout=5)
+        # ...the next is rejected immediately
+        with pytest.raises(SchedulerSaturated):
+            sched.run(lambda: "never")
+        assert sched.num_rejected == 1
+        release.set()
+        t1.join(5)
+        t2.join(5)
+        assert sorted(results) == ["queued-done", "slow-done"]
+        assert sched.num_executed == 2
+
+    def test_slot_wait_timeout(self):
+        sched = QueryScheduler(max_concurrent=1, max_queued=4,
+                               queue_timeout_s=0.05)
+        release = threading.Event()
+        t = threading.Thread(
+            target=lambda: sched.run(lambda: release.wait(10)))
+        t.start()
+        assert wait_until(lambda: sched.num_executed == 1, timeout=5)
+        with pytest.raises(SchedulerSaturated, match="slot"):
+            sched.run(lambda: "never")
+        release.set()
+        t.join(5)
+
+
+class TestSegmentRefcounts:
+    def test_remove_defers_unload_until_release(self, tmp_path):
+        _, _, seg = _seg(tmp_path, "rc0", n=100)
+        tdm = TableDataManager("t")
+        unloaded = []
+        tdm.on_unload = unloaded.append
+        tdm.add_segment(seg)
+        held = tdm.acquire()
+        assert held == [seg]
+        tdm.remove_segment(seg.name)
+        assert seg.name not in tdm.segments  # no new queries see it
+        assert unloaded == []                # but teardown is deferred
+        # the in-flight query can still read data
+        assert len(np.asarray(seg.values("k"))) == 100
+        tdm.release(held)
+        assert unloaded == [seg]
+
+    def test_unreferenced_remove_unloads_immediately(self, tmp_path):
+        _, _, seg = _seg(tmp_path, "rc1", n=50)
+        tdm = TableDataManager("t")
+        unloaded = []
+        tdm.on_unload = unloaded.append
+        tdm.add_segment(seg)
+        tdm.remove_segment(seg.name)
+        assert unloaded == [seg]
+
+    def test_server_downloads_local_copy_and_cleans_up(self, tmp_path):
+        import os
+
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("server_0", registry, str(tmp_path / "s0"),
+                                device_executor=None)
+        server.start()
+        from pinot_tpu.broker.broker import Broker
+
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            schema = Schema.build(
+                name="sales",
+                dimensions=[("k", DataType.STRING)],
+                metrics=[("v", DataType.LONG)],
+            )
+            cfg = TableConfig(table_name="sales")
+            controller.add_table(cfg, schema)
+            d = str(tmp_path / "up")
+            build_segment(schema, {"k": ["a", "b"], "v": [1, 2]}, d, cfg, "seg0")
+            controller.upload_segment("sales", d)
+            local = os.path.join(str(tmp_path / "s0"), "segments",
+                                 "sales_OFFLINE", "seg0")
+            assert wait_until(lambda: os.path.isdir(local))
+            r = broker.execute("SELECT SUM(v) FROM sales")
+            assert r["resultTable"]["rows"] == [[3]]
+            # delete: registry entry goes, server unloads, local copy removed
+            controller.delete_segment("sales", "seg0")
+            assert wait_until(lambda: not os.path.isdir(local))
+        finally:
+            broker.close()
+            server.stop()
