@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from pinot_tpu.ops import hll as hll_ops
+from pinot_tpu.ops import quantile_digest as qd
 from pinot_tpu.query.context import Expression
 
 
@@ -256,39 +257,76 @@ class DistinctCountHLLSpec(AggSpec):
 
 
 class PercentileSpec(AggSpec):
-    """Exact percentile: collects values (reference PercentileAggregationFunction
-    also materializes a DoubleArrayList)."""
+    """Percentile over a mergeable t-digest (merging variant,
+    ops/quantile_digest.py) instead of the reference PERCENTILE's raw
+    DoubleArrayList — bounded per-group state (≲2·compression centroids)
+    shipped over the wire as (means, weights) lists, matching
+    PercentileTDigestAggregationFunction's state algebra. Deliberate
+    divergence: plain PERCENTILE is approximate here (rank error
+    ~1/compression); O(matched rows) wire state was a scaling hazard the
+    round-2 review flagged."""
 
     name = "percentile"
+    compression = float(qd.DEFAULT_COMPRESSION)  # δ: <1% mid-range rank error
 
     def __init__(self, expr: Expression):
         super().__init__(expr)
         if len(expr.args) < 2 or not expr.args[1].is_literal:
-            raise ValueError("percentile(column, p) requires a literal p")
+            raise ValueError(f"{expr.name}(column, p) requires a literal p")
         self.p = float(expr.args[1].value)
+        if len(expr.args) >= 3 and expr.args[2].is_literal:
+            self.compression = float(expr.args[2].value)
         self.args = expr.args[:1]
 
     def host_groups(self, arg_values, group_idx, n):
         v = np.asarray(arg_values[0], dtype=np.float64)
-        lists = _obj_array(n, list)
-        for g, val in zip(group_idx, v):
-            lists[g].append(val)
-        return {"vals": lists}
+        means = _obj_array(n, list)
+        weights = _obj_array(n, list)
+        if len(v):
+            order = np.argsort(group_idx, kind="stable")
+            gs = np.asarray(group_idx)[order]
+            vs = v[order]
+            bounds = np.flatnonzero(np.diff(gs)) + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [len(gs)]])
+            for s, e in zip(starts, ends):
+                g = int(gs[s])
+                m, w = qd.add_values([], [], vs[s:e], self.compression)
+                means[g] = m.tolist()
+                weights[g] = w.tolist()
+        return {"means": means, "weights": weights}
 
     def empty(self, n):
-        return {"vals": _obj_array(n, list)}
+        return {"means": _obj_array(n, list), "weights": _obj_array(n, list)}
 
     def scatter_merge(self, acc, idx, part):
         for i, g in enumerate(idx):
-            acc["vals"][g].extend(part["vals"][i])
+            if not len(part["means"][i]):
+                continue
+            if not len(acc["means"][g]):
+                acc["means"][g] = list(part["means"][i])
+                acc["weights"][g] = list(part["weights"][i])
+                continue
+            m, w = qd.merge(acc["means"][g], acc["weights"][g],
+                            part["means"][i], part["weights"][i],
+                            self.compression)
+            acc["means"][g] = m.tolist()
+            acc["weights"][g] = w.tolist()
 
     def finalize(self, part):
-        out = np.full(len(part["vals"]), np.nan)
-        for i, vals in enumerate(part["vals"]):
-            if vals:
-                # reference semantics: lower-interpolation rank percentile
-                out[i] = np.percentile(np.asarray(vals), self.p, method="lower")
+        out = np.full(len(part["means"]), np.nan)
+        for i, (m, w) in enumerate(zip(part["means"], part["weights"])):
+            if len(m):
+                out[i] = qd.quantile(m, w, self.p / 100.0)
         return out
+
+
+class PercentileTDigestSpec(PercentileSpec):
+    """PERCENTILETDIGEST(col, p[, compression]) — same digest algebra with
+    the reference's default compression (100)."""
+
+    name = "percentiletdigest"
+    compression = 100.0
 
 
 class ModeSpec(AggSpec):
@@ -448,7 +486,7 @@ _SPECS = {
     "distinctcounthll": DistinctCountHLLSpec,
     "percentile": PercentileSpec,
     "percentileest": PercentileSpec,
-    "percentiletdigest": PercentileSpec,
+    "percentiletdigest": PercentileTDigestSpec,
     "mode": ModeSpec,
     "summv": SumMVSpec,
     "minmv": MinMVSpec,

@@ -218,10 +218,18 @@ class SegmentEvaluator:
                 self.entries_scanned_in_filter += self.n
                 fwd = np.asarray(self.seg.forward(lhs.name))[: self.n]
                 return lut[fwd]
-        if p.type is PredicateType.IS_NULL:
-            return np.zeros(self.n, dtype=bool)  # nulls: see creator
-        if p.type is PredicateType.IS_NOT_NULL:
-            return np.ones(self.n, dtype=bool)
+        if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            # null-vector semantics (NullValueVectorReader): the forward
+            # index stores default values for nulls; nullness lives in the
+            # per-column bitmap. Expressions over columns are never null
+            # (defaults flow through), matching basic null handling.
+            null_mask = np.zeros(self.n, dtype=bool)
+            if lhs.is_identifier and hasattr(self.seg, "null_vector"):
+                nv = self.seg.null_vector(lhs.name)
+                if nv is not None:
+                    nv = np.asarray(nv)[: self.n]
+                    null_mask[: len(nv)] = nv
+            return null_mask if p.type is PredicateType.IS_NULL else ~null_mask
         self.entries_scanned_in_filter += self.n
         values = self.eval(lhs)
         return self._predicate_over_values(p, np.asarray(values))

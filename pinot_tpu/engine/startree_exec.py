@@ -40,6 +40,15 @@ def _available_pairs(meta: dict) -> set:
     return {tuple(parse_pair(p)) for p in meta["function_column_pairs"]}
 
 
+def _has_null_predicate(f) -> bool:
+    from pinot_tpu.query.context import FilterNodeType, PredicateType
+
+    if f.type is FilterNodeType.PREDICATE:
+        return f.predicate.type in (PredicateType.IS_NULL,
+                                    PredicateType.IS_NOT_NULL)
+    return any(_has_null_predicate(c) for c in f.children or ())
+
+
 def fit(q: QueryContext, meta: dict) -> Optional[list]:
     """StarTreeUtils.isFitForStarTree analog. Returns the per-agg rewrite
     mapping, or None."""
@@ -48,8 +57,13 @@ def fit(q: QueryContext, meta: dict) -> Optional[list]:
     if dict(q.options).get("useStarTree") is False:
         return None
     dims = set(meta["dimensions_split_order"])
-    if q.filter is not None and not q.filter.columns() <= dims:
-        return None
+    if q.filter is not None:
+        if not q.filter.columns() <= dims:
+            return None
+        # null vectors don't survive into the pre-aggregated tree (its rows
+        # carry substituted default values), so IS_NULL must scan
+        if _has_null_predicate(q.filter):
+            return None
     for g in q.group_by:
         if not g.is_identifier or g.name not in dims:
             return None

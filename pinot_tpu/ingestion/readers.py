@@ -41,9 +41,12 @@ class RecordReader:
 
 
 def rows_to_columns(rows: list, schema: Schema, mv_delimiter: str = ";") -> dict:
-    """Row dicts -> coerced columns. Missing/None values take the field's
-    default null (FieldSpec.getDefaultNullValue semantics); MV cells accept
-    lists or delimiter-joined strings (CSV multiValueDelimiter)."""
+    """Row dicts -> coerced columns. Missing/empty/JSON-null values stay
+    ``None`` — the segment creator substitutes the field's default null AND
+    records the doc in the column's null vector (CSVRecordReader treats
+    empty cells as null the same way). MV cells accept lists or
+    delimiter-joined strings (CSV multiValueDelimiter); an explicitly null
+    MV ROW is null, an empty string is an empty row."""
     out: dict = {}
     for name in schema.column_names():
         spec = schema.field(name)
@@ -52,10 +55,12 @@ def rows_to_columns(rows: list, schema: Schema, mv_delimiter: str = ";") -> dict
         for row in rows:
             v = row.get(name)
             if spec.single_value:
-                col.append(dt.default_null if v is None or v == ""
-                           else dt.convert(v))
+                col.append(None if v is None or v == "" else dt.convert(v))
             else:
-                if v is None or v == "":
+                if v is None:
+                    col.append(None)
+                    continue
+                if v == "":
                     vals = []
                 elif isinstance(v, str):
                     vals = v.split(mv_delimiter)

@@ -53,16 +53,25 @@ def _wait_until(cond, timeout_s: float = 30.0, interval_s: float = 0.05) -> bool
     return False
 
 
-def _read_columns(segments: list, schema, row_masks=None) -> dict:
+def _read_columns(segments: list, schema, row_masks=None) -> tuple:
     """Concatenate decoded columns across segments (optionally row-masked).
     SV columns come back as typed arrays, MV columns as lists of per-row
-    value arrays (what ``build_segment`` expects)."""
+    value arrays (what ``build_segment`` expects). Returns
+    ``(columns, null_masks)`` — nullness lives only in the per-column null
+    vectors (the forward index stores substituted defaults), so a rebuild
+    that dropped them would silently un-null every row."""
     out: dict = {}
+    null_out: dict = {}
     for name in schema.column_names():
         spec = schema.field(name)
         parts = []
+        null_parts = []
         for i, seg in enumerate(segments):
             mask = None if row_masks is None else row_masks[i]
+            nv = seg.null_vector(name) if hasattr(seg, "null_vector") else None
+            nulls = (np.zeros(seg.n_docs, dtype=bool) if nv is None
+                     else np.asarray(nv, dtype=bool)[: seg.n_docs])
+            null_parts.append(nulls if mask is None else nulls[mask])
             if spec.single_value:
                 vals = np.asarray(seg.flat_values(name))
                 parts.append(vals if mask is None else vals[mask])
@@ -75,7 +84,10 @@ def _read_columns(segments: list, schema, row_masks=None) -> dict:
             out[name] = np.concatenate(parts) if parts else np.array([])
         else:
             out[name] = parts
-    return out
+        combined = np.concatenate(null_parts) if null_parts else np.empty(0, bool)
+        if combined.any():
+            null_out[name] = combined
+    return out, null_out or None
 
 
 def _rollup(columns: dict, schema, aggregates: dict) -> dict:
@@ -215,16 +227,21 @@ def execute_merge_rollup(ctx: TaskContext, task: dict) -> str:
     if len(names) < 2:
         return f"skipped: only {len(names)} input segments still exist"
     segments = [ImmutableSegment(records[n].location) for n in names]
-    columns = _read_columns(segments, schema)
+    columns, null_masks = _read_columns(segments, schema)
     if cfg.get("mode", "concat") == "rollup":
         columns = _rollup(columns, schema, cfg.get("rollup_aggregates", {}))
+        # rollup re-groups rows: per-row nullness no longer maps through
+        # (aggregated metrics are non-null; dims grouped by substituted
+        # value). Matches the reference, where rollup drops null vectors.
+        null_masks = None
     # name is unique per task AND per attempt: a requeued re-run must never
     # collide with a half-dead prior attempt's upload
     merged_name = (f"merged_{table}_"
                    + "_".join(task["id"].split("_")[-2:])
                    + f"_a{task.get('attempts', 1)}")
     out_dir = os.path.join(ctx.scratch(task["id"]), merged_name)
-    build_segment(schema, columns, out_dir, table_cfg, merged_name)
+    build_segment(schema, columns, out_dir, table_cfg, merged_name,
+                  null_masks=null_masks)
     _lineage_swap(ctx, table, names, out_dir, merged_name)
     n_docs = len(next(iter(columns.values())))
     return f"merged {len(names)} segments -> {merged_name} ({n_docs} docs)"
@@ -264,11 +281,12 @@ def execute_realtime_to_offline(ctx: TaskContext, task: dict) -> str:
             masks.append(mask)
     moved = 0
     if segs:
-        columns = _read_columns(segs, schema, masks)
+        columns, null_masks = _read_columns(segs, schema, masks)
         moved = len(next(iter(columns.values())))
         name = f"{raw}_{ws}_{we}"
         out_dir = os.path.join(ctx.scratch(task["id"]), name)
-        build_segment(schema, columns, out_dir, off_cfg, name)
+        build_segment(schema, columns, out_dir, off_cfg, name,
+                      null_masks=null_masks)
         ctx.controller.upload_segment(off_table, out_dir)
         # Gate on a server actually serving the pushed segment before
         # advancing the watermark: the hybrid time boundary only moves for
@@ -326,10 +344,11 @@ def execute_purge(ctx: TaskContext, task: dict) -> str:
             out_msgs.append(f"{name}: fully purged ({n_drop} docs), deleted")
         else:
             keep = ~drop
-            columns = _read_columns([seg], schema, [keep])
+            columns, null_masks = _read_columns([seg], schema, [keep])
             new_name = f"{name}_purged_{int(time.time() * 1000)}"
             out_dir = os.path.join(ctx.scratch(task["id"]), new_name)
-            build_segment(schema, columns, out_dir, table_cfg, new_name)
+            build_segment(schema, columns, out_dir, table_cfg, new_name,
+                          null_masks=null_masks)
             _lineage_swap(ctx, table, [name], out_dir, new_name)
             done[new_name] = int(time.time() * 1000)
             out_msgs.append(f"{name}: purged {n_drop} docs -> {new_name}")

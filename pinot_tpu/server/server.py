@@ -165,7 +165,10 @@ class ServerInstance:
         BaseTableDataManager.downloadSegment: queries never mmap deep-store
         files that a controller delete (retention, minion swap) can rm mid-
         read. Paths already under this server's data_dir (own realtime
-        seals) are served in place; a CRC change (refresh push) re-copies."""
+        seals) are served in place. Local copies are CRC-VERSIONED
+        (``name__<crc>``): a refresh push lands in a fresh directory, and
+        the old one is torn down through the refcounted unload path once
+        the last in-flight query over it drains — never rmtree'd in place."""
         import shutil
 
         src = rec.location
@@ -173,16 +176,10 @@ class ServerInstance:
                                os.path.abspath(self.data_dir)]) \
                 == os.path.abspath(self.data_dir):
             return src
-        local = self._local_segment_dir(table, rec.name)
+        dirname = rec.name if not rec.crc else f"{rec.name}__{rec.crc}"
+        local = self._local_segment_dir(table, dirname)
         if os.path.isdir(local):
-            if rec.crc is None:
-                return local
-            try:
-                if ImmutableSegment(local).metadata.crc == rec.crc:
-                    return local
-            except Exception:  # noqa: BLE001 — corrupt copy: re-download
-                pass
-            shutil.rmtree(local, ignore_errors=True)
+            return local
         os.makedirs(os.path.dirname(local), exist_ok=True)
         tmp = f"{local}.tmp{os.getpid()}"
         shutil.rmtree(tmp, ignore_errors=True)  # debris from a dead copy
@@ -237,7 +234,14 @@ class ServerInstance:
                         log.warning("segment %s lost its local files; "
                                     "reloading", name)
                         tdm.remove_segment(name)
-                    continue
+                        continue
+                    if rec.crc and cur.metadata.crc \
+                            and cur.metadata.crc != rec.crc:
+                        # refresh push: retire the old copy via the doomed/
+                        # unload path and load the new CRC's dir this tick
+                        tdm.remove_segment(name)
+                    else:
+                        continue
                 try:
                     tdm.add_segment(
                         ImmutableSegment(self._download_segment(table, rec))

@@ -74,8 +74,16 @@ class SegmentCreator:
         self.table_config = table_config or TableConfig(table_name=schema.name)
         self.segment_name = segment_name
 
-    def build(self, columns: Mapping[str, Sequence], out_dir: str) -> str:
-        """Build a sealed segment from column arrays; returns the segment dir."""
+    def build(self, columns: Mapping[str, Sequence], out_dir: str,
+              null_masks: Optional[Mapping[str, Sequence]] = None) -> str:
+        """Build a sealed segment from column arrays; returns the segment dir.
+
+        Null semantics (NullValueVectorReader analog): ``None`` entries are
+        detected, replaced by the field's default null value in the forward
+        index, and recorded in a per-column null vector
+        (``<col>.nullvec.npy``). ``null_masks`` lets callers that already
+        substituted defaults (the mutable-segment seal) pass explicit masks.
+        """
         os.makedirs(out_dir, exist_ok=True)
         idx_cfg = self.table_config.indexing
         n_docs = None
@@ -86,6 +94,13 @@ class SegmentCreator:
             if name not in columns:
                 raise KeyError(f"input data missing column {name!r}")
             raw_in = columns[name]
+
+            detected = _detect_none(raw_in)
+            null_mask = None if null_masks is None else null_masks.get(name)
+            if detected is not None:
+                raw_in = _substitute_nulls(raw_in, detected, spec)
+                null_mask = detected if null_mask is None \
+                    else (np.asarray(null_mask, dtype=bool) | detected)
 
             if not spec.single_value:
                 # multi-value: flatten + offsets
@@ -108,6 +123,10 @@ class SegmentCreator:
             meta = self._write_column(
                 name, spec, raw, mv_off, out_dir, use_dict, idx_cfg, nd
             )
+            if null_mask is not None and np.asarray(null_mask).any():
+                np.save(os.path.join(out_dir, f"{name}.nullvec.npy"),
+                        np.asarray(null_mask, dtype=bool), allow_pickle=False)
+                meta.has_null_vector = True
             col_meta[name] = meta
 
         time_col = self.table_config.time_column
@@ -124,6 +143,7 @@ class SegmentCreator:
             time_column=time_col,
             start_time=start,
             end_time=end,
+            crc=_segment_crc(out_dir),
         )
         with open(os.path.join(out_dir, METADATA_FILE), "w") as f:
             json.dump(meta.to_json(), f, indent=1, default=_json_default)
@@ -251,6 +271,46 @@ class SegmentCreator:
         np.save(os.path.join(out_dir, f"{name}.inv.off.npy"), offsets, allow_pickle=False)
 
 
+def _segment_crc(out_dir: str) -> str:
+    """Content fingerprint over the segment's index files (the reference's
+    segment CRC role: refresh-push detection, download validation). Hashes
+    every file's name + size + first/last 1MB — full-content hashing of
+    multi-GB forward indexes would tax large builds for a fingerprint whose
+    job is change detection, not bit-rot integrity."""
+    import zlib
+
+    h = 0
+    for fname in sorted(os.listdir(out_dir)):
+        path = os.path.join(out_dir, fname)
+        if fname == METADATA_FILE or not os.path.isfile(path):
+            continue
+        size = os.path.getsize(path)
+        h = zlib.crc32(f"{fname}:{size};".encode(), h)
+        with open(path, "rb") as f:
+            h = zlib.crc32(f.read(1 << 20), h)
+            if size > (2 << 20):
+                f.seek(-(1 << 20), os.SEEK_END)
+                h = zlib.crc32(f.read(), h)
+    return format(h, "08x")
+
+
+def _detect_none(raw_in) -> Optional[np.ndarray]:
+    """Per-doc ``is None`` mask, or None when no entry can be null (typed
+    numpy input) or none is. MV rows count as null when the ROW is None."""
+    if isinstance(raw_in, np.ndarray) and raw_in.dtype != object:
+        return None
+    mask = np.fromiter((v is None for v in raw_in), dtype=bool,
+                       count=len(raw_in))
+    return mask if mask.any() else None
+
+
+def _substitute_nulls(raw_in, mask: np.ndarray, spec) -> list:
+    """Replace null entries with the field's default null value
+    (FieldSpec.getDefaultNullValue), empty list for MV rows."""
+    filler = [] if not spec.single_value else spec.null_value()
+    return [filler if is_null else v for v, is_null in zip(raw_in, mask)]
+
+
 def _scalar(v):
     if isinstance(v, np.generic):
         return v.item()
@@ -271,6 +331,9 @@ def build_segment(
     out_dir: str,
     table_config: Optional[TableConfig] = None,
     segment_name: str = "segment_0",
+    null_masks: Optional[Mapping[str, Sequence]] = None,
 ) -> ImmutableSegment:
-    SegmentCreator(schema, table_config, segment_name).build(columns, out_dir)
+    SegmentCreator(schema, table_config, segment_name).build(
+        columns, out_dir, null_masks=null_masks
+    )
     return ImmutableSegment(out_dir)

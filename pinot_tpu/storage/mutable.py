@@ -47,6 +47,7 @@ class MutableColumn:
             self._data = np.empty(_INITIAL_CAPACITY, dtype=spec.data_type.np_dtype)
         self.min_value = None
         self.max_value = None
+        self.null_docs: list = []  # grow-only; readers slice to snapshot n
 
     def _grow(self, n: int) -> None:
         if n >= len(self._data):
@@ -144,7 +145,11 @@ class MutableSegment:
             for name, col in self._cols.items():
                 v = row.get(name)
                 if v is None:
-                    v = col.spec.null_value()
+                    # record nullness BEFORE substituting the default value
+                    # (IS_NULL reads this; the forward index stores the
+                    # default, same as the sealed null-vector contract)
+                    col.null_docs.append(doc_id)
+                    v = [] if not col.single_value else col.spec.null_value()
                 col.append(v, doc_id)
             if self._valid is not None and doc_id >= len(self._valid):
                 new = np.ones(len(self._valid) * 2, dtype=bool)
@@ -210,6 +215,17 @@ class MutableSegment:
             return None
         return self._valid[:n]
 
+    def null_vector(self, col: str):
+        """Per-doc null bitmap over all indexed docs, or None when clean
+        (readers slice to their snapshot length)."""
+        docs = self._cols[col].null_docs
+        if not docs:
+            return None
+        mask = np.zeros(self._count, dtype=bool)
+        ids = np.asarray(docs[:], dtype=np.int64)
+        mask[ids[ids < self._count]] = True
+        return mask
+
     # ---- seal ------------------------------------------------------------
     def seal(self, out_dir: str):
         """Consuming → immutable conversion (RealtimeSegmentConverter.java):
@@ -220,7 +236,13 @@ class MutableSegment:
 
         n = self._count
         columns = {name: self._cols[name].values(n) for name in self._cols}
-        build_segment(self.schema, columns, out_dir, self.table_config, self.segment_name)
+        null_masks = {}
+        for name in self._cols:
+            nv = self.null_vector(name)
+            if nv is not None and nv[:n].any():
+                null_masks[name] = nv[:n]
+        build_segment(self.schema, columns, out_dir, self.table_config,
+                      self.segment_name, null_masks=null_masks or None)
         seg = ImmutableSegment(out_dir)
         if self._valid is not None:
             seg.valid_docs_mask = self._valid[:n].copy()

@@ -66,6 +66,7 @@ class ColumnMetadata:
     has_inverted: bool = False
     has_range: bool = False
     has_bloom: bool = False
+    has_null_vector: bool = False
     total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
     partition_function: Optional[str] = None
     num_partitions: Optional[int] = None
@@ -195,6 +196,14 @@ class ImmutableSegment:
         if not self.column_metadata(col).has_bloom:
             return None
         return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
+
+    def null_vector(self, col: str) -> Optional[np.ndarray]:
+        """Per-doc null bitmap, or None when the column has no nulls
+        (NullValueVectorReader analog; absent file == empty bitmap)."""
+        if not self.column_metadata(col).has_null_vector:
+            return None
+        return np.load(self._path(f"{col}.nullvec.npy"), mmap_mode="r",
+                       allow_pickle=False)
 
     # ---- raw value access (host-side materialization) -------------------
     def values(self, col: str) -> np.ndarray:

@@ -58,7 +58,9 @@ class TestReaders:
         cols = CSVRecordReader().read_columns(str(p), SCHEMA)
         assert cols["name"] == ["alice", "bob", "carol"]
         assert cols["tags"] == [["red", "blue"], [], ["green"]]
-        assert cols["score"] == [1.5, 2.0, DataType.DOUBLE.default_null]
+        # empty cell stays None: the creator substitutes the default AND
+        # records the null vector
+        assert cols["score"] == [1.5, 2.0, None]
         assert cols["ts"] == [100, 200, 300]
 
     def test_json_lines_and_array(self, tmp_path):
@@ -76,11 +78,11 @@ class TestReaders:
             assert cols["tags"] == [["x"], []]
             assert cols["score"] == [1.0, 2.5]
 
-    def test_missing_column_takes_default_null(self):
+    def test_missing_column_stays_none_for_null_vector(self):
         cols = rows_to_columns([{"name": "a"}], SCHEMA)
-        assert cols["score"] == [DataType.DOUBLE.default_null]
-        assert cols["ts"] == [DataType.LONG.default_null]
-        assert cols["tags"] == [[]]
+        assert cols["score"] == [None]
+        assert cols["ts"] == [None]
+        assert cols["tags"] == [None]
 
     def test_unknown_format_raises(self):
         with pytest.raises(ValueError, match="unknown input format"):

@@ -151,6 +151,37 @@ class TestMergeRollup:
                      "SELECT site, SUM(hits) FROM traffic GROUP BY site ORDER BY site")
         assert rows == [["a", 303], ["b", 30]]
 
+    def test_merge_preserves_null_vectors(self, cluster, tmp_path):
+        """Nullness lives in per-column null vectors, not the forward index;
+        a rebuild that dropped them would silently un-null rows."""
+        registry, controller, servers, broker, minion = cluster
+        schema = Schema.build(
+            name="nv",
+            dimensions=[("k", DataType.STRING)],
+            metrics=[("v", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="nv", replication=1,
+                          task_configs={"MergeRollupTask": {}})
+        controller.add_table(cfg, schema)
+        from pinot_tpu.storage.creator import build_segment as _bs
+
+        for i in range(2):
+            _bs(schema, {"k": ["a", None, "b"], "v": [1, None, 3]},
+                str(tmp_path / f"nv{i}"), cfg, f"nv_{i}")
+            controller.upload_segment("nv", str(tmp_path / f"nv{i}"))
+        assert wait_until(
+            lambda: len(registry.external_view("nv_OFFLINE")) == 2)
+        assert _rows(broker, "SELECT COUNT(*) FROM nv WHERE k IS NULL") == [[2]]
+        controller.run_task_generation()
+        task = minion.run_one()
+        assert task["state"] == "DONE", task
+        segs = registry.segments("nv_OFFLINE")
+        assert len(segs) == 1
+        assert wait_until(
+            lambda: set(registry.external_view("nv_OFFLINE")) == set(segs))
+        assert _rows(broker, "SELECT COUNT(*) FROM nv WHERE k IS NULL") == [[2]]
+        assert _rows(broker, "SELECT COUNT(*) FROM nv WHERE v IS NOT NULL") == [[4]]
+
     def test_worker_thread_drains_queue(self, cluster, tmp_path):
         registry, controller, servers, broker, minion = cluster
         _sales_table(tmp_path, controller,

@@ -1,0 +1,184 @@
+"""Null-value vectors + mergeable percentile digest.
+
+Reference analogs: NullValueVectorReaderImpl + IS_NULL predicate
+evaluation, PercentileTDigestAggregationFunction's bounded mergeable
+state with error-bounded estimates.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.ops import quantile_digest as qd
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.mutable import MutableSegment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+
+SCHEMA = Schema.build(
+    name="t",
+    dimensions=[("k", DataType.STRING)],
+    metrics=[("v", DataType.LONG), ("f", DataType.DOUBLE)],
+)
+
+
+def _engine_with(seg):
+    engine = QueryEngine(device_executor=None)
+    engine.add_segment("t", seg)
+    return engine
+
+
+def _rows(engine, sql):
+    r = engine.execute(sql)
+    assert not r.get("exceptions"), r
+    return r["resultTable"]["rows"]
+
+
+class TestNullVectors:
+    def _seg(self, tmp_path):
+        cols = {
+            "k": ["a", None, "b", None, "c"],
+            "v": [1, 2, None, 4, None],
+            "f": [1.0, 2.0, 3.0, 4.0, 5.0],
+        }
+        return build_segment(SCHEMA, cols, str(tmp_path / "s"),
+                             TableConfig(table_name="t"), "s0")
+
+    def test_nullvec_written_and_read(self, tmp_path):
+        seg = self._seg(tmp_path)
+        assert seg.column_metadata("k").has_null_vector
+        assert seg.column_metadata("v").has_null_vector
+        assert not seg.column_metadata("f").has_null_vector
+        assert seg.null_vector("k").tolist() == [False, True, False, True, False]
+        assert seg.null_vector("v").tolist() == [False, False, True, False, True]
+        assert seg.null_vector("f") is None
+        # forward index stores substituted defaults
+        assert seg.values("k")[1] == DataType.STRING.default_null
+        assert int(seg.values("v")[2]) == DataType.LONG.default_null
+
+    def test_is_null_predicates(self, tmp_path):
+        engine = _engine_with(self._seg(tmp_path))
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE k IS NULL") == [[2]]
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE k IS NOT NULL") == [[3]]
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE v IS NULL") == [[2]]
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE f IS NULL") == [[0]]
+        assert _rows(engine,
+                     "SELECT COUNT(*) FROM t WHERE k IS NULL AND v IS NULL"
+                     ) == [[0]]
+        assert _rows(engine,
+                     "SELECT SUM(f) FROM t WHERE v IS NOT NULL") == [[7.0]]
+
+    def test_segment_reload_preserves_nulls(self, tmp_path):
+        self._seg(tmp_path)
+        seg = ImmutableSegment(str(tmp_path / "s"))
+        assert seg.null_vector("k").tolist() == [False, True, False, True, False]
+
+    def test_mutable_nulls_and_seal(self, tmp_path):
+        ms = MutableSegment(SCHEMA, "m0", TableConfig(table_name="t"))
+        for row in ({"k": "a", "v": 1, "f": 0.5}, {"k": None, "v": None, "f": 1.5},
+                    {"v": 3, "f": 2.5}):  # missing key counts as null too
+            ms.index(row)
+        assert ms.null_vector("k").tolist() == [False, True, True]
+        assert ms.null_vector("v").tolist() == [False, True, False]
+        assert ms.null_vector("f") is None
+        engine = _engine_with(ms)
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE k IS NULL") == [[2]]
+        sealed = ms.seal(str(tmp_path / "sealed"))
+        assert sealed.null_vector("k").tolist() == [False, True, True]
+        engine2 = _engine_with(sealed)
+        assert _rows(engine2, "SELECT COUNT(*) FROM t WHERE v IS NULL") == [[1]]
+
+    def test_star_tree_not_used_for_null_predicates(self, tmp_path):
+        from pinot_tpu.common.table_config import (
+            IndexingConfig,
+            StarTreeIndexConfig,
+        )
+
+        cfg = TableConfig(
+            table_name="t",
+            indexing=IndexingConfig(
+                star_tree_configs=[StarTreeIndexConfig(
+                    dimensions_split_order=["k"],
+                    function_column_pairs=["COUNT__*", "SUM__v"],
+                )]),
+        )
+        cols = {"k": ["a", None, "a", "b"], "v": [1, 2, 3, None],
+                "f": [0.0, 0.0, 0.0, 0.0]}
+        seg = build_segment(SCHEMA, cols, str(tmp_path / "st"), cfg, "st0")
+        engine = _engine_with(seg)
+        # the tree sees substituted defaults; IS_NULL must bypass it
+        assert _rows(engine, "SELECT COUNT(*) FROM t WHERE k IS NULL") == [[1]]
+        assert _rows(engine, "SELECT SUM(v) FROM t WHERE v IS NOT NULL") == [[6]]
+
+
+class TestQuantileDigest:
+    @pytest.mark.parametrize("dist", ["uniform", "normal", "lognormal"])
+    def test_rank_error_bounded(self, dist):
+        rng = np.random.default_rng(11)
+        n = 50_000
+        vals = {
+            "uniform": rng.uniform(0, 1000, n),
+            "normal": rng.normal(500, 100, n),
+            "lognormal": rng.lognormal(3, 1, n),
+        }[dist]
+        # fold in three chunks + merge (the distributed path)
+        m = w = np.empty(0)
+        digests = []
+        for chunk in np.array_split(vals, 3):
+            digests.append(qd.add_values([], [], chunk))
+        m, w = digests[0]
+        for m2, w2 in digests[1:]:
+            m, w = qd.merge(m, w, m2, w2)
+        assert len(m) <= 2 * qd.DEFAULT_COMPRESSION
+        s = np.sort(vals)
+        for q in (0.01, 0.25, 0.5, 0.75, 0.9, 0.99):
+            est = qd.quantile(m, w, q)
+            rank = np.searchsorted(s, est) / n
+            assert abs(rank - q) <= 0.015, (dist, q, est, rank)
+
+    def test_empty_and_single(self):
+        assert np.isnan(qd.quantile([], [], 0.5))
+        m, w = qd.add_values([], [], [42.0])
+        assert qd.quantile(m, w, 0.0) == 42.0
+        assert qd.quantile(m, w, 1.0) == 42.0
+
+    def test_group_by_percentile_through_engine(self, tmp_path):
+        rng = np.random.default_rng(4)
+        n = 30_000
+        ks = np.array(["a", "b"])[rng.integers(0, 2, n)]
+        vs = rng.integers(0, 10_000, n).astype(np.int64)
+        seg = build_segment(
+            SCHEMA, {"k": ks, "v": vs, "f": np.zeros(n)},
+            str(tmp_path / "gp"), TableConfig(table_name="t"), "gp0")
+        engine = _engine_with(seg)
+        rows = _rows(engine,
+                     "SELECT k, PERCENTILE(v, 90) FROM t GROUP BY k ORDER BY k")
+        for key, est in rows:
+            grp = np.sort(vs[ks == key])
+            rank = np.searchsorted(grp, est) / len(grp)
+            assert abs(rank - 0.9) <= 0.02, (key, est, rank)
+
+    def test_wire_roundtrip_of_digest_partials(self, tmp_path):
+        from pinot_tpu.engine.datatable import decode, encode
+        from pinot_tpu.query.optimizer import optimize_query
+        from pinot_tpu.sql.compiler import compile_query
+
+        rng = np.random.default_rng(8)
+        n = 5000
+        seg = build_segment(
+            SCHEMA,
+            {"k": np.array(["a", "b"])[rng.integers(0, 2, n)],
+             "v": rng.integers(0, 1000, n).astype(np.int64),
+             "f": np.zeros(n)},
+            str(tmp_path / "wr"), TableConfig(table_name="t"), "wr0")
+        engine = QueryEngine(device_executor=None)
+        q = optimize_query(compile_query(
+            "SELECT k, PERCENTILE(v, 50) FROM t GROUP BY k ORDER BY k"))
+        merged = engine.execute_segments(q, [seg])
+        again = decode(encode(merged))
+        from pinot_tpu.engine.reduce import finalize
+
+        assert finalize(q, again).rows == finalize(q, merged).rows

@@ -213,12 +213,15 @@ class TestAggregation:
         )
 
     def test_percentile(self, setup):
+        # PERCENTILE is digest-backed (bounded mergeable state): assert the
+        # estimate's RANK error, not value equality with the exact oracle
         engine, con = setup
         resp = engine.execute("SELECT PERCENTILE(runs, 50) FROM baseballStats")
         got = resp["resultTable"]["rows"][0][0]
         vals = np.array([r[0] for r in con.execute("SELECT runs FROM baseballStats").fetchall()])
-        want = float(np.percentile(vals, 50, method="lower"))
-        assert got == pytest.approx(want)
+        rank_lo = float((vals < got).mean())
+        rank_hi = float((vals <= got).mean())
+        assert rank_lo - 0.02 <= 0.5 <= rank_hi + 0.02, (got, rank_lo, rank_hi)
 
 
 class TestGroupBy:

@@ -232,14 +232,16 @@ class TestSegmentRefcounts:
             d = str(tmp_path / "up")
             build_segment(schema, {"k": ["a", "b"], "v": [1, 2]}, d, cfg, "seg0")
             controller.upload_segment("sales", d)
-            local = os.path.join(str(tmp_path / "s0"), "segments",
-                                 "sales_OFFLINE", "seg0")
-            assert wait_until(lambda: os.path.isdir(local))
+            import glob
+
+            pattern = os.path.join(str(tmp_path / "s0"), "segments",
+                                   "sales_OFFLINE", "seg0*")
+            assert wait_until(lambda: glob.glob(pattern), timeout=30)
             r = broker.execute("SELECT SUM(v) FROM sales")
             assert r["resultTable"]["rows"] == [[3]]
             # delete: registry entry goes, server unloads, local copy removed
             controller.delete_segment("sales", "seg0")
-            assert wait_until(lambda: not os.path.isdir(local))
+            assert wait_until(lambda: not glob.glob(pattern), timeout=30)
         finally:
             broker.close()
             server.stop()
