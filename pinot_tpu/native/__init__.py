@@ -1,0 +1,152 @@
+"""Native runtime pieces: on-demand-compiled C++ with numpy fallbacks.
+
+The compute path is JAX/XLA; the runtime around it uses native code where
+the reference does (here: the bit-packing codec backing
+``<col>.fwdpacked.bin``, the FixedBitSVForwardIndexWriter/PinotDataBitSet
+analog). The shared library is compiled once per checkout with the system
+``g++`` (no pip/pybind11 — plain ``extern "C"`` + ctypes) and cached next
+to the source; when no toolchain is available the vectorized numpy
+fallback serves the same format, so segments stay portable either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger("pinot_tpu.native")
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "packer.cpp")
+_LIB = os.path.join(_HERE, "_libpinot_packer.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _compile() -> bool:
+    # compile to a pid-suffixed temp then os.replace: concurrent processes
+    # racing through a fresh checkout must never dlopen a half-written .so
+    tmp = f"{_LIB}.{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception as e:  # noqa: BLE001 — fall back to numpy
+        log.warning("native packer build failed (%s); using numpy fallback", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _load():
+    """ctypes handle on the packer library, or None (numpy fallback)."""
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                if not _compile():
+                    return None
+            lib = ctypes.CDLL(_LIB)
+            lib.pack_bits.argtypes = [
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.unpack_bits.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+            ]
+            _lib = lib
+        except Exception as e:  # noqa: BLE001
+            log.warning("native packer load failed (%s); numpy fallback", e)
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def bits_needed(cardinality: int) -> int:
+    """Bits per dict id (>=1), PinotDataBitSet.getNumBitsPerValue analog."""
+    if cardinality <= 1:
+        return 1
+    return int(cardinality - 1).bit_length()
+
+
+def packed_size(n: int, bits: int) -> int:
+    return (n * bits + 7) // 8
+
+
+def pack(ids: np.ndarray, bits: int) -> np.ndarray:
+    """int32 dict ids -> packed uint8 buffer (little-endian bit order)."""
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    n = len(ids)
+    out = np.zeros(packed_size(n, bits), dtype=np.uint8)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        lib.pack_bits(
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int64(n), ctypes.c_int(bits),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        return out
+    return _pack_np(ids, bits, out)
+
+
+def unpack(buf: np.ndarray, n: int, bits: int) -> np.ndarray:
+    """Packed uint8 buffer -> int32 dict ids."""
+    buf = np.ascontiguousarray(buf, dtype=np.uint8)
+    out = np.empty(n, dtype=np.int32)
+    if n == 0:
+        return out
+    lib = _load()
+    if lib is not None:
+        lib.unpack_bits(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.c_int64(n), ctypes.c_int(bits),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return out
+    return _unpack_np(buf, n, bits)
+
+
+# ---------------------------------------------------------------------------
+# numpy fallback (same byte format, vectorized via a per-value bit matrix)
+# ---------------------------------------------------------------------------
+
+
+def _pack_np(ids: np.ndarray, bits: int, out: np.ndarray) -> np.ndarray:
+    n = len(ids)
+    # (n, bits) value bits, little-endian per value, flattened to the
+    # global little-endian bitstream then repacked 8 at a time
+    shifts = np.arange(bits, dtype=np.uint32)
+    bitmat = ((ids.astype(np.uint32)[:, None] >> shifts) & 1).astype(np.uint8)
+    stream = bitmat.reshape(-1)
+    pad = (-len(stream)) % 8
+    if pad:
+        stream = np.concatenate([stream, np.zeros(pad, dtype=np.uint8)])
+    out[:] = np.packbits(stream.reshape(-1, 8), axis=1, bitorder="little").reshape(-1)
+    return out
+
+
+def _unpack_np(buf: np.ndarray, n: int, bits: int) -> np.ndarray:
+    stream = np.unpackbits(buf, bitorder="little")[: n * bits]
+    bitmat = stream.reshape(n, bits).astype(np.uint32)
+    shifts = np.arange(bits, dtype=np.uint32)
+    return (bitmat << shifts).sum(axis=1).astype(np.int32)

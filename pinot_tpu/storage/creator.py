@@ -178,7 +178,23 @@ class SegmentCreator:
             from pinot_tpu.storage.dictionary import Dictionary
 
             dictionary, ids = Dictionary.build(raw)
-            np.save(p(f"{name}.fwd.npy"), ids, allow_pickle=False)
+            packed_bits = None
+            if idx_cfg.enable_bit_packing and spec.single_value:
+                from pinot_tpu import native
+
+                bits = native.bits_needed(dictionary.cardinality)
+                if bits <= 16:  # >=2x smaller than int32, else not worth it
+                    native.pack(ids, bits).tofile(p(f"{name}.fwdpacked.bin"))
+                    packed_bits = bits
+            if packed_bits is None:
+                np.save(p(f"{name}.fwd.npy"), ids, allow_pickle=False)
+            # a rebuild into the same dir with packing toggled must not
+            # leave the other format behind (stale file skews the CRC and
+            # rides every download)
+            stale = p(f"{name}.fwd.npy") if packed_bits is not None \
+                else p(f"{name}.fwdpacked.bin")
+            if os.path.exists(stale):
+                os.unlink(stale)
             dictionary.save(p(f"{name}.dict.npy"))
             cardinality = dictionary.cardinality
             if cardinality:
@@ -190,7 +206,10 @@ class SegmentCreator:
             dict_values = dictionary.values
         else:
             dict_values = None
+            packed_bits = None
             np.save(p(f"{name}.fwd.npy"), raw, allow_pickle=False)
+            if os.path.exists(p(f"{name}.fwdpacked.bin")):
+                os.unlink(p(f"{name}.fwdpacked.bin"))  # stale from a rebuild
             cardinality = int(len(np.unique(raw)))
             minv, maxv = (raw.min(), raw.max()) if len(raw) else (None, None)
             encoding = Encoding.RAW
@@ -239,6 +258,7 @@ class SegmentCreator:
             has_inverted=has_inverted,
             has_range=has_range,
             has_bloom=has_bloom,
+            packed_bits=packed_bits,
             total_number_of_entries=int(total_entries),
             partition_function=part_fn,
             num_partitions=part_n,

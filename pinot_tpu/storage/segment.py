@@ -67,6 +67,7 @@ class ColumnMetadata:
     has_range: bool = False
     has_bloom: bool = False
     has_null_vector: bool = False
+    packed_bits: Optional[int] = None  # bit-packed fwd index width, else None
     total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
     partition_function: Optional[str] = None
     num_partitions: Optional[int] = None
@@ -172,11 +173,33 @@ class ImmutableSegment:
         return self._dict_cache[col]
 
     def forward(self, col: str) -> np.ndarray:
-        """Dict ids (int32) for DICT columns, raw values for RAW columns."""
+        """Dict ids (int32) for DICT columns, raw values for RAW columns.
+        Bit-packed columns decode through the native codec
+        (FixedBitSVForwardIndexReader analog) into an in-memory int32
+        array; plain columns stay mmap'd."""
         if col not in self._fwd_cache:
-            self._fwd_cache[col] = np.load(
-                self._path(f"{col}.fwd.npy"), mmap_mode="r", allow_pickle=False
-            )
+            meta = self.column_metadata(col)
+            if meta.packed_bits is not None:
+                from pinot_tpu import native
+
+                buf = np.fromfile(self._path(f"{col}.fwdpacked.bin"),
+                                  dtype=np.uint8)
+                n = (self.n_docs if meta.single_value
+                     else meta.total_number_of_entries)
+                need = native.packed_size(n, meta.packed_bits)
+                if len(buf) < need:
+                    # the native decoder trusts its length args — a short
+                    # buffer must fail loudly, not read past the heap
+                    raise ValueError(
+                        f"{col}.fwdpacked.bin truncated: {len(buf)} bytes, "
+                        f"need {need} for {n} x {meta.packed_bits} bits"
+                    )
+                self._fwd_cache[col] = native.unpack(buf, n, meta.packed_bits)
+            else:
+                self._fwd_cache[col] = np.load(
+                    self._path(f"{col}.fwd.npy"), mmap_mode="r",
+                    allow_pickle=False,
+                )
         return self._fwd_cache[col]
 
     def mv_offsets(self, col: str) -> Optional[np.ndarray]:
