@@ -109,6 +109,9 @@ class Broker:
         self.registry = registry
         self.broker_id = broker_id
         self.timeout_s = timeout_s
+        from pinot_tpu.common.metrics import get_metrics
+
+        self.metrics = get_metrics("broker")
         self.failures = FailureDetector()
         self.routing = RoutingManager(registry, self.failures)
         self._channels: dict[str, QueryRouterChannel] = {}
@@ -140,7 +143,11 @@ class Broker:
     def execute(self, sql: str) -> dict:
         """HTTP POST /query/sql equivalent (PinotClientRequest →
         BaseBrokerRequestHandler.handleRequest)."""
+        from pinot_tpu.common import trace
+
         t0 = time.time()
+        self.metrics.count("queries")
+        tracer = None
         try:
             q = optimize_query(compile_query(sql))
             if q.explain:
@@ -150,10 +157,19 @@ class Broker:
                     device = None
 
                 return explain_plan(_NoDevice(), q)
+            if dict(q.options).get("trace"):
+                tracer = trace.start_trace()
             resp = self._scatter_gather(q, sql)
+            if tracer is not None:
+                resp.setdefault("traceInfo", {})["broker"] = tracer.to_json()
         except Exception as e:  # noqa: BLE001 — in-band errors like the reference
+            self.metrics.count("queryErrors")
             return {"exceptions": [{"errorCode": 450, "message": f"{type(e).__name__}: {e}"}]}
+        finally:
+            if tracer is not None:
+                trace.end_trace()
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
+        self.metrics.time_ms("query", resp["timeUsedMs"])
         return resp
 
     def _expand_star(self, q: QueryContext) -> QueryContext:
@@ -226,6 +242,8 @@ class Broker:
         return out
 
     def _scatter_gather(self, q: QueryContext, sql: str) -> dict:
+        from pinot_tpu.common.trace import span
+
         q = self._expand_star(q)
         request_id = next(self._request_id)
 
@@ -277,34 +295,44 @@ class Broker:
 
         results, exceptions = [], []
         query_errors = []
-        for fut, inst in futs.items():
-            try:
-                results.append(fut.result(timeout=self.timeout_s + 1))
-                self.failures.mark_success(inst)
-            except NoSegmentsHosted:
-                # benign routing/sync race: segments moved between the
-                # external-view read and the RPC; not a server failure
-                self.failures.mark_success(inst)
-            except ServerQueryError as e:
-                # query-level error (bad column etc.): the server is healthy;
-                # report in-band without poisoning the failure detector
-                self.failures.mark_success(inst)
-                query_errors.append(
-                    {"errorCode": 200, "message": f"{inst}: {e}"}
-                )
-            except Exception as e:  # noqa: BLE001 — transport-level failure
-                self.failures.mark_failure(inst)
-                exceptions.append(
-                    {"errorCode": 427, "message": f"SERVER_NOT_RESPONDING: {inst}: {e}"}
-                )
+        server_traces = {}
+        with span("broker.scatter_gather"):
+            for fut, inst in futs.items():
+                try:
+                    r = fut.result(timeout=self.timeout_s + 1)
+                    if r.trace is not None:
+                        server_traces[inst] = r.trace
+                    results.append(r)
+                    self.failures.mark_success(inst)
+                except NoSegmentsHosted:
+                    # benign routing/sync race: segments moved between the
+                    # external-view read and the RPC; not a server failure
+                    self.failures.mark_success(inst)
+                except ServerQueryError as e:
+                    # query-level error (bad column etc.): the server is
+                    # healthy; report in-band, don't poison the detector
+                    self.failures.mark_success(inst)
+                    query_errors.append(
+                        {"errorCode": 200, "message": f"{inst}: {e}"}
+                    )
+                except Exception as e:  # noqa: BLE001 — transport failure
+                    self.failures.mark_failure(inst)
+                    exceptions.append(
+                        {"errorCode": 427,
+                         "message": f"SERVER_NOT_RESPONDING: {inst}: {e}"}
+                    )
         if query_errors:
             return {"exceptions": query_errors}
         if not results:
+            self.metrics.count("serverFailures", len(exceptions))
             raise ConnectionError(f"all servers failed: {exceptions}")
 
-        merged = merge_intermediates(q, results)
-        table = finalize(q, merged)
+        with span("broker.reduce"):
+            merged = merge_intermediates(q, results)
+            table = finalize(q, merged)
         resp = table.to_json()
+        if server_traces:
+            resp["traceInfo"] = server_traces
         stats = merged.stats
         resp.update(
             {

@@ -33,6 +33,20 @@ class BrokerHttpServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "OK"})
+                elif self.path == "/metrics":
+                    from pinot_tpu.common.metrics import all_snapshots
+
+                    self._send(200, all_snapshots())
+                elif self.path == "/metrics/prometheus":
+                    from pinot_tpu.common.metrics import all_prometheus_text
+
+                    body = all_prometheus_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": "not found"})
 

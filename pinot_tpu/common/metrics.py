@@ -1,0 +1,171 @@
+"""Metrics registry: counters, gauges, timers per component.
+
+Equivalent of the reference's metrics SPI
+(pinot-common/.../metrics/AbstractMetrics.java + BrokerMetrics /
+ServerMetrics / ControllerMetrics / MinionMetrics over yammer): named
+meters/gauges/timers keyed ``component.name[.tag]``, aggregated
+in-process and exported as a snapshot dict or Prometheus text. The
+yammer backend is replaced by lock-cheap python primitives — emission to
+an external system is a reporter's job (register one with
+``add_reporter``), matching the SPI split."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Timer:
+    """count / total / min / max over observed durations (ms)."""
+
+    __slots__ = ("count", "total_ms", "min_ms", "max_ms")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+
+    def update(self, ms: float) -> None:
+        self.count += 1
+        self.total_ms += ms
+        if ms < self.min_ms:
+            self.min_ms = ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    def snapshot(self) -> dict:
+        avg = self.total_ms / self.count if self.count else 0.0
+        return {"count": self.count, "totalMs": round(self.total_ms, 3),
+                "avgMs": round(avg, 3),
+                "minMs": round(self.min_ms, 3) if self.count else 0.0,
+                "maxMs": round(self.max_ms, 3)}
+
+
+class MetricsRegistry:
+    def __init__(self, component: str = ""):
+        self.component = component
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, Callable | float] = {}
+        self._timers: dict[str, Timer] = {}
+        self._reporters: list[Callable] = []
+
+    def _key(self, name: str, tag: Optional[str]) -> str:
+        parts = [p for p in (self.component, name, tag) if p]
+        return ".".join(parts)
+
+    # ---- meters (addMeteredTableValue analog) ---------------------------
+    def count(self, name: str, value: float = 1, tag: Optional[str] = None) -> None:
+        key = self._key(name, tag)
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + value
+
+    # ---- gauges (setOrUpdateGauge analog) -------------------------------
+    def gauge(self, name: str, value, tag: Optional[str] = None) -> None:
+        """``value``: a number, or a zero-arg callable sampled at snapshot
+        time (the reference's Gauge<Long> suppliers)."""
+        with self._lock:
+            self._gauges[self._key(name, tag)] = value
+
+    def remove_gauge(self, name: str, tag: Optional[str] = None) -> None:
+        """Unregister (removeGauge analog) — component teardown MUST call
+        this for callable gauges, or their closures pin the dead component
+        (and everything it references) in the process-global registry."""
+        with self._lock:
+            self._gauges.pop(self._key(name, tag), None)
+
+    # ---- timers (addTimedTableValue analog) -----------------------------
+    def time_ms(self, name: str, ms: float, tag: Optional[str] = None) -> None:
+        key = self._key(name, tag)
+        with self._lock:
+            t = self._timers.get(key)
+            if t is None:
+                t = self._timers[key] = Timer()
+            t.update(ms)
+
+    class _Span:
+        __slots__ = ("reg", "name", "tag", "t0")
+
+        def __init__(self, reg, name, tag):
+            self.reg, self.name, self.tag = reg, name, tag
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.reg.time_ms(self.name, (time.perf_counter() - self.t0) * 1000,
+                             self.tag)
+            return False
+
+    def timed(self, name: str, tag: Optional[str] = None) -> "_Span":
+        return self._Span(self, name, tag)
+
+    # ---- export ---------------------------------------------------------
+    def add_reporter(self, fn: Callable[[dict], None]) -> None:
+        self._reporters.append(fn)
+
+    def report(self) -> None:
+        snap = self.snapshot()
+        for fn in self._reporters:
+            fn(snap)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            gauges = {}
+            for k, v in self._gauges.items():
+                try:
+                    gauges[k] = v() if callable(v) else v
+                except Exception:  # noqa: BLE001 — sampling must not throw
+                    gauges[k] = None
+            return {
+                "counters": dict(self._counters),
+                "gauges": gauges,
+                "timers": {k: t.snapshot() for k, t in self._timers.items()},
+            }
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (the common reporter target)."""
+
+        def sanitize(k: str) -> str:
+            return "pinot_tpu_" + k.replace(".", "_").replace("-", "_")
+
+        lines = []
+        snap = self.snapshot()
+        for k, v in sorted(snap["counters"].items()):
+            lines.append(f"{sanitize(k)}_total {v}")
+        for k, v in sorted(snap["gauges"].items()):
+            if v is not None:
+                lines.append(f"{sanitize(k)} {v}")
+        for k, t in sorted(snap["timers"].items()):
+            base = sanitize(k)
+            lines.append(f"{base}_ms_count {t['count']}")
+            lines.append(f"{base}_ms_sum {t['totalMs']}")
+            lines.append(f"{base}_ms_max {t['maxMs']}")
+        return "\n".join(lines) + "\n"
+
+
+# process-wide default registries, one per role (BrokerMetrics.get() style)
+_registries: dict[str, MetricsRegistry] = {}
+_reg_lock = threading.Lock()
+
+
+def get_metrics(component: str) -> MetricsRegistry:
+    with _reg_lock:
+        reg = _registries.get(component)
+        if reg is None:
+            reg = _registries[component] = MetricsRegistry(component)
+        return reg
+
+
+def all_snapshots() -> dict:
+    with _reg_lock:
+        return {name: reg.snapshot() for name, reg in _registries.items()}
+
+
+def all_prometheus_text() -> str:
+    with _reg_lock:
+        regs = list(_registries.values())
+    return "".join(reg.prometheus_text() for reg in regs)

@@ -1,0 +1,74 @@
+"""Request tracing: per-query phase spans surfaced in the response.
+
+Equivalent of the reference's trace SPI
+(pinot-spi/.../trace/Tracing.java:32 + RequestContext /
+DefaultRequestContext and the broker's ``trace`` query option): a
+thread-local tracer records named phase spans (nesting flattened to
+dotted names); when the query sets ``SET trace = true`` the spans ride
+back in the broker response as ``traceInfo``, the reference's
+BrokerResponse trace payload. Tracing off costs one thread-local read
+per span."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+_local = threading.local()
+
+
+class Tracer:
+    def __init__(self):
+        self.spans: list = []  # (name, start_ms_rel, duration_ms)
+        self._t0 = time.perf_counter()
+        self._stack: list = []
+
+    class _Span:
+        __slots__ = ("tracer", "name", "t0")
+
+        def __init__(self, tracer, name):
+            self.tracer, self.name = tracer, name
+
+        def __enter__(self):
+            if self.tracer is not None:
+                self.tracer._stack.append(self.name)
+                self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            if self.tracer is not None:
+                t = self.tracer
+                name = ".".join(t._stack)
+                t._stack.pop()
+                t.spans.append((
+                    name,
+                    round((self.t0 - t._t0) * 1000, 3),
+                    round((time.perf_counter() - self.t0) * 1000, 3),
+                ))
+            return False
+
+    def to_json(self) -> list:
+        return [{"phase": n, "startMs": s, "durationMs": d}
+                for n, s, d in self.spans]
+
+
+def start_trace() -> Tracer:
+    """Install a tracer for this thread (request entry point)."""
+    t = Tracer()
+    _local.tracer = t
+    return t
+
+
+def end_trace() -> None:
+    _local.tracer = None
+
+
+def active() -> Optional[Tracer]:
+    return getattr(_local, "tracer", None)
+
+
+def span(name: str) -> "Tracer._Span":
+    """Context manager recording a phase on the active tracer; a no-op
+    (shared constant-cost object) when tracing is off."""
+    return Tracer._Span(active(), name)

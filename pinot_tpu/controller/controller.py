@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import logging
 import os
-import shutil
 import threading
 import time
 from typing import Optional
@@ -114,9 +113,14 @@ class SegmentAssigner:
 class Controller:
     def __init__(self, registry: ClusterRegistry, deep_store_dir: str,
                  controller_id: str = "controller_0"):
+        from pinot_tpu.storage.fs import create_fs
+
         self.registry = registry
         self.deep_store = deep_store_dir
-        os.makedirs(deep_store_dir, exist_ok=True)
+        # deep-store IO routes through the PinotFS SPI: swapping the scheme
+        # (s3://, gs://) swaps the storage backend via the plugin registry
+        self.fs = create_fs(deep_store_dir)
+        self.fs.mkdir(deep_store_dir)
         self.assigner = SegmentAssigner(registry)
         registry.register_instance(InstanceInfo(controller_id, Role.CONTROLLER))
 
@@ -223,10 +227,7 @@ class Controller:
         if copy_to_deep_store:
             location = os.path.join(self.deep_store, table, seg.name)
             if os.path.abspath(location) != os.path.abspath(segment_dir):
-                os.makedirs(os.path.dirname(location), exist_ok=True)
-                if os.path.exists(location):
-                    shutil.rmtree(location)
-                shutil.copytree(segment_dir, location)
+                self.fs.copy(segment_dir, location)
         meta = seg.metadata
         record = SegmentRecord(
             name=seg.name, table=table, n_docs=seg.n_docs, location=location,
@@ -243,7 +244,7 @@ class Controller:
         rec = self.registry.segments(table).get(name)
         self.registry.remove_segment(table, name)
         if rec is not None and rec.location.startswith(self.deep_store):
-            shutil.rmtree(rec.location, ignore_errors=True)
+            self.fs.delete(rec.location)
 
     def rebalance(self, table: str) -> dict:
         table = self.resolve(table)

@@ -122,6 +122,7 @@ def encode(result: IntermediateResult) -> bytes:
         "objects": {},
         "partials": None,
         "n_keys": None,
+        "trace": result.trace,
     }
 
     if result.group_keys is not None:
@@ -212,4 +213,5 @@ def decode(data: bytes) -> IntermediateResult:
         group_keys=group_keys,
         rows=rows,
         stats=stats,
+        trace=meta.get("trace"),
     )

@@ -56,6 +56,7 @@ class IntermediateResult:
     group_keys: Optional[tuple] = None
     rows: Optional[dict] = None
     stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
+    trace: Optional[list] = None  # phase spans when SET trace = true
 
 
 @dataclasses.dataclass

@@ -78,6 +78,10 @@ class MinionWorker:
             stop_touch.set()
             toucher.join(1)
         self.registry.finish_task(task["id"], ok, output)
+        from pinot_tpu.common.metrics import get_metrics
+
+        get_metrics("minion").count(
+            "tasksCompleted" if ok else "tasksFailed", tag=task["type"])
         self.tasks_run += 1
         task.update(state="DONE" if ok else "FAILED", output=output)
         return task

@@ -60,6 +60,15 @@ class ServerInstance:
         self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries,
                                         max_queued=max_queued_queries)
         self.group_trim_size = group_trim_size
+        from pinot_tpu.common.metrics import get_metrics
+
+        self.metrics = get_metrics("server")
+        self.metrics.gauge("segmentsLoaded", lambda: sum(
+            len(t.segments) for t in self.engine.tables.values()),
+            tag=instance_id)
+        self.metrics.gauge("schedulerRejected",
+                           lambda: self.scheduler.num_rejected,
+                           tag=instance_id)
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
@@ -80,6 +89,10 @@ class ServerInstance:
 
     def stop(self) -> None:
         self._stop.set()
+        # drop the callable gauges: their closures would otherwise pin this
+        # instance (and its loaded segments) in the process-global registry
+        self.metrics.remove_gauge("segmentsLoaded", tag=self.instance_id)
+        self.metrics.remove_gauge("schedulerRejected", tag=self.instance_id)
         if self._sync_thread is not None:
             self._sync_thread.join(5)
         for mgr in self._realtime_managers.values():
@@ -91,17 +104,24 @@ class ServerInstance:
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)
         try:
+            # NOTE: the latency timer lives inside _handle_submit_inner —
+            # wrapping the scheduler here would fold rejection queue-waits
+            # into server.query and poison latency dashboards under load
             return self.scheduler.run(lambda: self._handle_submit_inner(req))
         except SchedulerSaturated as e:
             # admission rejection is a query-level error: the server is
             # healthy (broker must not poison its failure detector)
+            self.metrics.count("queriesRejected")
             return encode_error("query_error", f"QUERY_SCHEDULING_TIMEOUT: {e}")
         except Exception as e:  # noqa: BLE001 — query errors ship in-band
+            self.metrics.count("queryErrors")
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
     def _handle_submit_inner(self, req: dict) -> bytes:
         import dataclasses
 
+        from pinot_tpu.common import trace
+        from pinot_tpu.common.trace import span
         from pinot_tpu.query.context import (
             Expression,
             FilterNode,
@@ -109,43 +129,58 @@ class ServerInstance:
             PredicateType,
         )
 
+        self.metrics.count("queries")
+        timer = self.metrics.timed("query")
+        timer.__enter__()
         q = optimize_query(compile_query(req["sql"]))
-        if req.get("table"):
-            q = dataclasses.replace(q, table_name=req["table"])
-        tf = req.get("timeFilter")
-        if tf:  # hybrid time-boundary predicate, AND-ed into the filter
-            pred = Predicate(
-                PredicateType.RANGE, Expression.identifier(tf["column"]),
-                upper=tf["value"] if tf["op"] == "le" else None,
-                lower=tf["value"] if tf["op"] == "gt" else None,
-                lower_inclusive=False,
-            )
-            node = FilterNode.pred(pred)
-            new_filter = node if q.filter is None else FilterNode.and_(q.filter, node)
-            q = dataclasses.replace(q, filter=new_filter)
-        tdm = self.engine.tables.get(q.table_name)
-        wanted = set(req["segments"])
-        acquired = [] if tdm is None else tdm.acquire()
+        tracer = trace.start_trace() if dict(q.options).get("trace") else None
         try:
-            segments = [s for s in acquired if s.name in wanted]
-            if not segments:
-                # benign routing race (segments moved since the broker's
-                # external-view read): tell the broker to skip this partial
-                return encode_error(
-                    "no_segments",
-                    f"server {self.instance_id} hosts none of the requested "
-                    f"segments for table {q.table_name!r}",
+            if req.get("table"):
+                q = dataclasses.replace(q, table_name=req["table"])
+            tf = req.get("timeFilter")
+            if tf:  # hybrid time-boundary predicate, AND-ed into the filter
+                pred = Predicate(
+                    PredicateType.RANGE, Expression.identifier(tf["column"]),
+                    upper=tf["value"] if tf["op"] == "le" else None,
+                    lower=tf["value"] if tf["op"] == "gt" else None,
+                    lower_inclusive=False,
                 )
-            # requested-but-missing segments (assignment raced ahead of
-            # loading) are simply absent from this partial, like the
-            # reference's missing-segment accounting
-            merged = self.engine.execute_segments(q, segments)
+                node = FilterNode.pred(pred)
+                new_filter = node if q.filter is None else FilterNode.and_(q.filter, node)
+                q = dataclasses.replace(q, filter=new_filter)
+            tdm = self.engine.tables.get(q.table_name)
+            wanted = set(req["segments"])
+            acquired = [] if tdm is None else tdm.acquire()
+            try:
+                segments = [s for s in acquired if s.name in wanted]
+                if not segments:
+                    # benign routing race (segments moved since the broker's
+                    # external-view read): broker skips this partial
+                    return encode_error(
+                        "no_segments",
+                        f"server {self.instance_id} hosts none of the "
+                        f"requested segments for table {q.table_name!r}",
+                    )
+                # requested-but-missing segments (assignment raced ahead of
+                # loading) are simply absent from this partial, like the
+                # reference's missing-segment accounting
+                with span("server.execute"):
+                    merged = self.engine.execute_segments(q, segments)
+            finally:
+                if tdm is not None:
+                    tdm.release(acquired)
+            with span("server.trim"):
+                merged = trim_group_by(q, merged, self.group_trim_size)
+            self.queries_served += 1
+            if tracer is not None:
+                # encode itself can't appear in the trace: the spans are
+                # serialized INTO the payload encode produces
+                merged.trace = tracer.to_json()
+            return encode(merged)
         finally:
-            if tdm is not None:
-                tdm.release(acquired)
-        merged = trim_group_by(q, merged, self.group_trim_size)
-        self.queries_served += 1
-        return encode(merged)
+            if tracer is not None:
+                trace.end_trace()
+            timer.__exit__()
 
     # ---- segment sync (state model replacement) --------------------------
     def _sync_loop(self) -> None:

@@ -1,0 +1,82 @@
+"""Deep-store filesystem SPI.
+
+Equivalent of the reference's ``PinotFS``
+(pinot-spi/.../filesystem/PinotFS.java + LocalPinotFS, with S3/GCS/HDFS
+as plugins): scheme-keyed factories resolve a URI to a filesystem
+offering the segment-lifecycle operations the controller needs (copy
+dir/file, delete, exists, listFiles, mkdir). Only ``file://`` ships
+in-tree — object-store impls register through the plugin registry
+(common/plugins.py) exactly like the reference's pinot-file-system
+plugins.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from urllib.parse import urlparse
+
+
+class PinotFS:
+    """SPI surface (PinotFS.java subset the controller exercises)."""
+
+    def mkdir(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def copy(self, src: str, dst: str) -> None:
+        """File or directory; dst is replaced."""
+        raise NotImplementedError
+
+    def list_files(self, path: str) -> list:
+        raise NotImplementedError
+
+
+class LocalFS(PinotFS):
+    """LocalPinotFS analog over the host filesystem."""
+
+    @staticmethod
+    def _p(path: str) -> str:
+        u = urlparse(path)
+        return u.path if u.scheme == "file" else path
+
+    def mkdir(self, path: str) -> None:
+        os.makedirs(self._p(path), exist_ok=True)
+
+    def delete(self, path: str) -> None:
+        p = self._p(path)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.unlink(p)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._p(path))
+
+    def copy(self, src: str, dst: str) -> None:
+        s, d = self._p(src), self._p(dst)
+        os.makedirs(os.path.dirname(d) or ".", exist_ok=True)
+        if os.path.isdir(s):
+            if os.path.exists(d):
+                shutil.rmtree(d)
+            shutil.copytree(s, d)
+        else:
+            shutil.copy2(s, d)
+
+    def list_files(self, path: str) -> list:
+        p = self._p(path)
+        return sorted(os.listdir(p)) if os.path.isdir(p) else []
+
+
+def create_fs(uri: str) -> PinotFS:
+    """Scheme → filesystem via the plugin registry (PinotFSFactory.create)."""
+    from pinot_tpu.common.plugins import plugin_registry
+
+    scheme = urlparse(uri).scheme or "file"
+    factory = plugin_registry.load("fs", scheme)
+    return factory()

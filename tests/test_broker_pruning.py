@@ -83,11 +83,16 @@ def _partitioned_table(tmp_path, controller, n_segments=N_PART, rows=500):
 
 
 def _loaded(servers, n):
-    return lambda: sum(
-        len(s.engine.tables["orders_OFFLINE"].segments)
-        if s.engine.tables.get("orders_OFFLINE") else 0
-        for s in servers
-    ) >= n
+    # the broker routes on the EXTERNAL VIEW, which a server publishes at
+    # the end of its sync tick — waiting on server-local loads alone races
+    # one tick ahead of routability
+    registry = servers[0].registry
+    return lambda: (
+        sum(len(s.engine.tables["orders_OFFLINE"].segments)
+            if s.engine.tables.get("orders_OFFLINE") else 0
+            for s in servers) >= n
+        and len(registry.external_view("orders_OFFLINE")) >= n
+    )
 
 
 class TestBrokerPruning:

@@ -1,0 +1,190 @@
+"""Metrics registry, request tracing, FS SPI, plugin loader.
+
+Reference analogs: AbstractMetrics + yammer reporters, Tracing.java /
+trace query option surfaced in BrokerResponse, PinotFS + LocalPinotFS,
+PluginManager + ServiceLoader-style registration.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.broker.http_api import BrokerHttpServer
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.metrics import MetricsRegistry, get_metrics
+from pinot_tpu.common.plugins import plugin_registry
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.fs import LocalFS, create_fs
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_timers(self):
+        reg = MetricsRegistry("test")
+        reg.count("q")
+        reg.count("q", 4)
+        reg.gauge("depth", 7)
+        reg.gauge("dynamic", lambda: 3)
+        with reg.timed("phase"):
+            pass
+        reg.time_ms("phase", 5.0)
+        snap = reg.snapshot()
+        assert snap["counters"]["test.q"] == 5
+        assert snap["gauges"]["test.depth"] == 7
+        assert snap["gauges"]["test.dynamic"] == 3
+        t = snap["timers"]["test.phase"]
+        assert t["count"] == 2 and t["maxMs"] >= 5.0
+
+    def test_tags_and_prometheus(self):
+        reg = MetricsRegistry("b")
+        reg.count("queries", tag="t1")
+        reg.gauge("g", 1.5)
+        reg.time_ms("lat", 10)
+        text = reg.prometheus_text()
+        assert "pinot_tpu_b_queries_t1_total 1" in text
+        assert "pinot_tpu_b_g 1.5" in text
+        assert "pinot_tpu_b_lat_ms_count 1" in text
+
+    def test_reporter(self):
+        reg = MetricsRegistry("r")
+        seen = []
+        reg.add_reporter(seen.append)
+        reg.count("x")
+        reg.report()
+        assert seen and seen[0]["counters"]["r.x"] == 1
+
+    def test_gauge_sampling_never_throws(self):
+        reg = MetricsRegistry("g")
+        reg.gauge("bad", lambda: 1 / 0)
+        assert reg.snapshot()["gauges"]["g.bad"] is None
+
+
+class TestFsSpi:
+    def test_localfs_ops(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "a")
+        fs.mkdir(d)
+        assert fs.exists(d)
+        with open(os.path.join(d, "f.txt"), "w") as f:
+            f.write("hi")
+        fs.copy(d, str(tmp_path / "b"))
+        assert fs.list_files(str(tmp_path / "b")) == ["f.txt"]
+        fs.copy(os.path.join(d, "f.txt"), str(tmp_path / "c" / "f.txt"))
+        assert fs.exists(str(tmp_path / "c" / "f.txt"))
+        fs.delete(d)
+        assert not fs.exists(d)
+        assert fs.exists("file://" + str(tmp_path / "b"))
+
+    def test_create_fs_via_plugin_registry(self, tmp_path):
+        assert isinstance(create_fs(str(tmp_path)), LocalFS)
+        assert isinstance(create_fs("file:///x"), LocalFS)
+        with pytest.raises(KeyError, match="no 'fs' plugin"):
+            create_fs("s3://bucket/x")
+
+
+class TestPluginRegistry:
+    def test_builtins_registered(self):
+        assert "memory" in plugin_registry.available("stream")
+        assert "json" in plugin_registry.available("decoder")
+        assert {"csv", "json", "parquet"} <= set(
+            plugin_registry.available("record_reader"))
+        assert "mergerolluptask" in plugin_registry.available("minion_task")
+        assert plugin_registry.load("fs", "file") is LocalFS
+
+    def test_unknown_plugin_raises_with_inventory(self):
+        with pytest.raises(KeyError, match="registered"):
+            plugin_registry.load("stream", "kafka")
+
+    def test_env_plugin_module_loads(self, tmp_path, monkeypatch):
+        mod_dir = tmp_path / "plugmod"
+        mod_dir.mkdir()
+        (mod_dir / "my_plugin.py").write_text(
+            "from pinot_tpu.common.plugins import plugin_registry\n"
+            "plugin_registry.register('decoder', 'upper', lambda b: b.upper())\n"
+        )
+        monkeypatch.syspath_prepend(str(mod_dir))
+        monkeypatch.setenv("PINOT_TPU_PLUGINS", "my_plugin")
+        # plugin modules register on the GLOBAL registry at import
+        assert plugin_registry.load_env_plugins()
+        assert plugin_registry.load("decoder", "upper")(b"x") == b"X"
+
+
+class TestClusterObservability:
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("server_0", registry, str(tmp_path / "s0"),
+                                device_executor=None)
+        server.start()
+        broker = Broker(registry, timeout_s=10.0)
+        http = BrokerHttpServer(broker)
+        http.start()
+        schema = Schema.build(
+            name="sales",
+            dimensions=[("k", DataType.STRING)],
+            metrics=[("v", DataType.LONG)],
+        )
+        cfg = TableConfig(table_name="sales")
+        controller.add_table(cfg, schema)
+        d = str(tmp_path / "up")
+        build_segment(
+            schema,
+            {"k": np.array(["a", "b"] * 50), "v": np.arange(100, dtype=np.int64)},
+            d, cfg, "s0")
+        controller.upload_segment("sales", d)
+        assert wait_until(lambda: len(registry.external_view("sales_OFFLINE")) == 1)
+        yield broker, http
+        http.stop()
+        broker.close()
+        server.stop()
+
+    def test_trace_option_returns_phase_spans(self, cluster):
+        broker, _ = cluster
+        r = broker.execute(
+            "SET trace = true; SELECT k, SUM(v) FROM sales GROUP BY k")
+        assert not r.get("exceptions"), r
+        info = r["traceInfo"]
+        assert "broker" in info and "server_0" in info
+        broker_phases = {s["phase"] for s in info["broker"]}
+        assert {"broker.scatter_gather", "broker.reduce"} <= broker_phases
+        server_phases = {s["phase"] for s in info["server_0"]}
+        assert "server.execute" in server_phases
+        assert all(s["durationMs"] >= 0 for s in info["server_0"])
+        # tracing off → no traceInfo
+        r2 = broker.execute("SELECT COUNT(*) FROM sales")
+        assert "traceInfo" not in r2
+
+    def test_metrics_http_endpoints(self, cluster):
+        broker, http = cluster
+        broker.execute("SELECT COUNT(*) FROM sales")
+        with urllib.request.urlopen(http.url + "/metrics", timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["broker"]["counters"]["broker.queries"] >= 1
+        assert snap["server"]["counters"]["server.queries"] >= 1
+        assert snap["server"]["timers"]["server.query"]["count"] >= 1
+        gauges = snap["server"]["gauges"]
+        assert gauges["server.segmentsLoaded.server_0"] >= 1
+        with urllib.request.urlopen(http.url + "/metrics/prometheus",
+                                    timeout=5) as resp:
+            text = resp.read().decode()
+        assert "pinot_tpu_broker_queries_total" in text
