@@ -277,6 +277,22 @@ class Broker:
         if not scatter:
             raise KeyError(f"no routing entry for table {q.table_name!r}")
 
+        # Streaming execution (StreamingReduceService analog): selection
+        # without ORDER BY has any-subset semantics, so servers stream one
+        # DataTable block per segment and the broker cancels every stream
+        # as soon as offset+limit rows arrived — no full materialization on
+        # either side. SET streaming = false forces the unary path.
+        use_streaming = (
+            not q.aggregations() and not q.distinct and not q.order_by
+            and dict(q.options).get("streaming") is not False
+            # tracing rides the unary DataTable header; streaming blocks
+            # don't carry spans, so a traced query takes the unary path
+            and not dict(q.options).get("trace")
+        )
+        row_budget = q.offset + q.limit
+        rows_seen = [0]
+        rows_lock = threading.Lock()
+
         def call(instance_id: str, physical: str, segments: list, time_filter):
             ch = self._channel(instance_id)
             if ch is None:
@@ -285,7 +301,21 @@ class Broker:
                 sql, segments, request_id, self.broker_id,
                 table=physical, time_filter=time_filter,
             )
-            return decode(ch.submit(payload, self.timeout_s))
+            if not use_streaming:
+                return [decode(ch.submit(payload, self.timeout_s))]
+            stream = ch.submit_streaming(payload, self.timeout_s)
+            parts = []
+            for block in stream:
+                r = decode(bytes(block))
+                parts.append(r)
+                n = len(next(iter(r.rows.values()))) if r.rows else 0
+                with rows_lock:
+                    rows_seen[0] += n
+                    done = rows_seen[0] >= row_budget
+                if done:
+                    stream.cancel()
+                    break
+            return parts
 
         futs = {
             self._pool.submit(call, inst, phys, segs, tf): inst
@@ -296,13 +326,15 @@ class Broker:
         results, exceptions = [], []
         query_errors = []
         server_traces = {}
+        responded = set()  # instances, not blocks (streaming yields many)
         with span("broker.scatter_gather"):
             for fut, inst in futs.items():
                 try:
-                    r = fut.result(timeout=self.timeout_s + 1)
-                    if r.trace is not None:
-                        server_traces[inst] = r.trace
-                    results.append(r)
+                    for r in fut.result(timeout=self.timeout_s + 1):
+                        if r.trace is not None:
+                            server_traces[inst] = r.trace
+                        results.append(r)
+                    responded.add(inst)
                     self.failures.mark_success(inst)
                 except NoSegmentsHosted:
                     # benign routing/sync race: segments moved between the
@@ -339,7 +371,7 @@ class Broker:
                 "exceptions": exceptions,
                 "partialResult": bool(exceptions),
                 "numServersQueried": len(n_servers),
-                "numServersResponded": len(results),
+                "numServersResponded": len(responded),
                 "numDocsScanned": stats.num_docs_scanned,
                 "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,
                 "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,

@@ -321,6 +321,77 @@ class PercentileSpec(AggSpec):
         return out
 
 
+class DistinctCountThetaSketchSpec(AggSpec):
+    """DISTINCTCOUNTTHETASKETCH(col[, nominalEntries]) — mergeable KMV
+    theta sketch (ops/theta.py), the role DataSketches' QuickSelect sketch
+    plays in DistinctCountThetaSketchAggregationFunction.java. The
+    reference's optional filter-expression arguments (sketch set algebra)
+    are not modeled. State per group: theta + <=k retained hashes."""
+
+    name = "distinctcountthetasketch"
+
+    def __init__(self, expr: Expression):
+        from pinot_tpu.ops import theta as theta_ops
+
+        super().__init__(expr)
+        self.k = theta_ops.DEFAULT_NOMINAL
+        if len(expr.args) >= 2 and expr.args[1].is_literal:
+            self.k = int(expr.args[1].value)
+        self.args = expr.args[:1]
+
+    def host_groups(self, arg_values, group_idx, n):
+        from pinot_tpu.ops import theta as theta_ops
+
+        v = np.asarray(arg_values[0])
+        thetas = np.full(n, float(theta_ops.MAX_HASH))
+        hashes = _obj_array(n, list)
+        if len(v):
+            order = np.argsort(group_idx, kind="stable")
+            gs = np.asarray(group_idx)[order]
+            vs = v[order]
+            bounds = np.flatnonzero(np.diff(gs)) + 1
+            starts = np.concatenate([[0], bounds])
+            ends = np.concatenate([bounds, [len(gs)]])
+            for s, e in zip(starts, ends):
+                g = int(gs[s])
+                th, h = theta_ops.build(vs[s:e], self.k)
+                thetas[g] = float(th)
+                hashes[g] = h.tolist()
+        return {"theta": thetas, "hashes": hashes}
+
+    def empty(self, n):
+        from pinot_tpu.ops import theta as theta_ops
+
+        return {"theta": np.full(n, float(theta_ops.MAX_HASH)),
+                "hashes": _obj_array(n, list)}
+
+    def scatter_merge(self, acc, idx, part):
+        from pinot_tpu.ops import theta as theta_ops
+
+        for i, g in enumerate(idx):
+            if not len(part["hashes"][i]) \
+                    and part["theta"][i] >= float(theta_ops.MAX_HASH):
+                continue
+            th, h = theta_ops.merge(
+                int(acc["theta"][g]), np.asarray(acc["hashes"][g], np.int64),
+                int(part["theta"][i]), np.asarray(part["hashes"][i], np.int64),
+                self.k,
+            )
+            acc["theta"][g] = float(th)
+            acc["hashes"][g] = h.tolist()
+
+    def finalize(self, part):
+        from pinot_tpu.ops import theta as theta_ops
+
+        return np.array([
+            round(theta_ops.estimate(int(t), h))
+            for t, h in zip(part["theta"], part["hashes"])
+        ], dtype=np.int64)
+
+    def result_type(self):
+        return "LONG"
+
+
 class PercentileTDigestSpec(PercentileSpec):
     """PERCENTILETDIGEST(col, p[, compression]) — same digest algebra with
     the reference's default compression (100)."""
@@ -484,6 +555,8 @@ _SPECS = {
     "distinctcountbitmap": DistinctCountSpec,  # same exact semantics
     "segmentpartitioneddistinctcount": DistinctCountSpec,
     "distinctcounthll": DistinctCountHLLSpec,
+    "distinctcountthetasketch": DistinctCountThetaSketchSpec,
+    "distinctcountrawthetasketch": DistinctCountThetaSketchSpec,
     "percentile": PercentileSpec,
     "percentileest": PercentileSpec,
     "percentiletdigest": PercentileTDigestSpec,

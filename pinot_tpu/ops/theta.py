@@ -1,0 +1,75 @@
+"""Theta sketch: mergeable approximate distinct counting (KMV variant).
+
+Equivalent of the reference's theta-sketch distinct count
+(DistinctCountThetaSketchAggregationFunction.java over Apache
+DataSketches' QuickSelect theta sketch): keep the k smallest 63-bit
+hashes; theta is the (k+1)-th smallest, every retained hash is < theta,
+and the estimate is |retained| / (theta / 2^63). Merging is
+min(theta) + union + re-trim — order-insensitive, fixed-size state that
+rides the DataTable wire as a plain int list per group.
+
+Hashing reuses the canonical murmur-finalizer pipeline (ops/hll.py
+hash32_np) twice with decorrelated seeds to form 63-bit hashes, so host
+and (future) device builders agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pinot_tpu.ops.hll import hash32_np
+
+DEFAULT_NOMINAL = 16384  # reference default nominalEntries
+MAX_HASH = np.int64(1) << np.int64(62)  # theta space: hashes in [0, 2^62)
+
+
+def _fmix32(h: np.ndarray) -> np.ndarray:
+    h = h.copy()
+    h ^= h >> 16
+    h = (h * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    h ^= h >> 13
+    h = (h * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    h ^= h >> 16
+    return h
+
+
+def hash63(values: np.ndarray) -> np.ndarray:
+    """Deterministic 62-bit hashes as int64 (top bits clear so the values
+    survive the int64 wire format and float math without sign trouble)."""
+    h1 = hash32_np(values).astype(np.uint64)
+    h2 = _fmix32((h1 ^ np.uint64(0x9E3779B9)).astype(np.uint32)).astype(np.uint64)
+    h = ((h1 << np.uint64(31)) ^ h2) & np.uint64((1 << 62) - 1)
+    return h.astype(np.int64)
+
+
+def build(values: np.ndarray, k: int) -> tuple:
+    """values -> (theta:int, sorted retained hashes:int64 array)."""
+    h = np.unique(hash63(values))
+    return trim(int(MAX_HASH), h, k)
+
+
+def trim(theta: int, hashes: np.ndarray, k: int) -> tuple:
+    """Enforce the k-entry bound: theta becomes the (k+1)-th smallest and
+    only hashes strictly below it are retained."""
+    hashes = hashes[hashes < theta]
+    if len(hashes) > k:
+        hashes = np.sort(hashes)
+        theta = int(hashes[k])
+        hashes = hashes[:k]
+        hashes = hashes[hashes < theta]  # duplicates of theta fall out
+    return theta, hashes
+
+
+def merge(theta_a: int, ha: np.ndarray, theta_b: int, hb: np.ndarray,
+          k: int) -> tuple:
+    theta = min(theta_a, theta_b)
+    union = np.union1d(np.asarray(ha, dtype=np.int64),
+                       np.asarray(hb, dtype=np.int64))
+    return trim(theta, union, k)
+
+
+def estimate(theta: int, hashes) -> float:
+    n = len(hashes)
+    if theta >= int(MAX_HASH):
+        return float(n)  # exact mode: never trimmed
+    return n / (theta / float(MAX_HASH))

@@ -103,6 +103,8 @@ AGGREGATION_FUNCTIONS = frozenset(
         "distinctcount",
         "distinctcountbitmap",
         "distinctcounthll",
+        "distinctcountthetasketch",
+        "distinctcountrawthetasketch",
         "distinctcountsmart",
         "segmentpartitioneddistinctcount",
         "percentile",

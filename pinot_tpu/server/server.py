@@ -37,6 +37,35 @@ from pinot_tpu.transport.grpc_transport import QueryServerTransport, parse_insta
 log = logging.getLogger("pinot_tpu.server")
 
 
+def _apply_request_overrides(q, req: dict):
+    """Physical-table override + the hybrid time-boundary predicate from
+    the instance request, shared by the unary and streaming paths (dropping
+    the timeFilter on either path double-reads the hybrid overlap)."""
+    import dataclasses
+
+    from pinot_tpu.query.context import (
+        Expression,
+        FilterNode,
+        Predicate,
+        PredicateType,
+    )
+
+    if req.get("table"):
+        q = dataclasses.replace(q, table_name=req["table"])
+    tf = req.get("timeFilter")
+    if tf:
+        pred = Predicate(
+            PredicateType.RANGE, Expression.identifier(tf["column"]),
+            upper=tf["value"] if tf["op"] == "le" else None,
+            lower=tf["value"] if tf["op"] == "gt" else None,
+            lower_inclusive=False,
+        )
+        node = FilterNode.pred(pred)
+        new_filter = node if q.filter is None else FilterNode.and_(q.filter, node)
+        q = dataclasses.replace(q, filter=new_filter)
+    return q
+
+
 class ServerInstance:
     def __init__(self, instance_id: str, registry: ClusterRegistry,
                  data_dir: str, host: str = "127.0.0.1", port: int = 0,
@@ -55,6 +84,7 @@ class ServerInstance:
         self.transport = QueryServerTransport(
             self._handle_submit, host=host, port=port,
             max_workers=max_concurrent_queries + max_queued_queries + 2,
+            submit_streaming_fn=self._handle_submit_streaming,
         )
         self.sync_interval_s = sync_interval_s
         self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries,
@@ -118,16 +148,8 @@ class ServerInstance:
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
     def _handle_submit_inner(self, req: dict) -> bytes:
-        import dataclasses
-
         from pinot_tpu.common import trace
         from pinot_tpu.common.trace import span
-        from pinot_tpu.query.context import (
-            Expression,
-            FilterNode,
-            Predicate,
-            PredicateType,
-        )
 
         self.metrics.count("queries")
         timer = self.metrics.timed("query")
@@ -135,19 +157,7 @@ class ServerInstance:
         q = optimize_query(compile_query(req["sql"]))
         tracer = trace.start_trace() if dict(q.options).get("trace") else None
         try:
-            if req.get("table"):
-                q = dataclasses.replace(q, table_name=req["table"])
-            tf = req.get("timeFilter")
-            if tf:  # hybrid time-boundary predicate, AND-ed into the filter
-                pred = Predicate(
-                    PredicateType.RANGE, Expression.identifier(tf["column"]),
-                    upper=tf["value"] if tf["op"] == "le" else None,
-                    lower=tf["value"] if tf["op"] == "gt" else None,
-                    lower_inclusive=False,
-                )
-                node = FilterNode.pred(pred)
-                new_filter = node if q.filter is None else FilterNode.and_(q.filter, node)
-                q = dataclasses.replace(q, filter=new_filter)
+            q = _apply_request_overrides(q, req)
             tdm = self.engine.tables.get(q.table_name)
             wanted = set(req["segments"])
             acquired = [] if tdm is None else tdm.acquire()
@@ -181,6 +191,84 @@ class ServerInstance:
             if tracer is not None:
                 trace.end_trace()
             timer.__exit__()
+
+    # ---- streaming query path (GrpcQueryServer streaming Submit) ---------
+    def _handle_submit_streaming(self, request: bytes):
+        """Generator: one DataTable block per executed segment, so large
+        selection results never materialize whole server-side (the
+        reference's streaming operator + StreamingReduceService contract).
+        The per-request row budget (offset+limit) stops segment execution
+        early — selection without ORDER BY is any-subset semantics."""
+        req = parse_instance_request(request)
+        try:
+            yield from self.scheduler.run(
+                lambda: self._stream_blocks(req)
+            )
+        except SchedulerSaturated as e:
+            self.metrics.count("queriesRejected")
+            yield encode_error("query_error", f"QUERY_SCHEDULING_TIMEOUT: {e}")
+        except Exception as e:  # noqa: BLE001 — in-band, like unary
+            self.metrics.count("queryErrors")
+            yield encode_error("query_error", f"{type(e).__name__}: {e}")
+
+    def _stream_blocks(self, req: dict):
+        """Materialize the block list under the scheduler slot (bounded by
+        the row budget), releasing the slot before slow network drain."""
+        q = optimize_query(compile_query(req["sql"]))
+        q = _apply_request_overrides(q, req)
+        if q.aggregations() or q.distinct or q.order_by:
+            raise ValueError(
+                "streaming submit only serves selection-without-order queries"
+            )
+        self.metrics.count("queries")
+        tdm = self.engine.tables.get(q.table_name)
+        wanted = set(req["segments"])
+        acquired = [] if tdm is None else tdm.acquire()
+        blocks = []
+        try:
+            segments = [s for s in acquired if s.name in wanted]
+            if not segments:
+                return [encode_error(
+                    "no_segments",
+                    f"server {self.instance_id} hosts none of the requested "
+                    f"segments for table {q.table_name!r}",
+                )]
+            q = self.engine._expand_star(q, segments[0])
+            budget = q.offset + q.limit
+            produced = 0
+            pruned = 0
+            unexecuted_docs = 0  # pruned/budget-skipped: count toward totalDocs
+            remaining = list(segments)
+            while remaining:
+                seg = remaining.pop(0)
+                if self.engine.pruner.prune(q, seg):
+                    pruned += 1
+                    unexecuted_docs += seg.n_docs
+                    continue
+                r = self.engine.host.execute_segment(q, seg)
+                r.stats.num_segments_queried = 0  # set once on the last block
+                produced += len(next(iter(r.rows.values()))) if r.rows else 0
+                blocks.append(r)
+                if produced >= budget:
+                    break  # row budget hit: remaining segments unprocessed
+            if not blocks:
+                from pinot_tpu.engine.engine import _impossible
+
+                blocks.append(self.engine.host.execute_segment(
+                    _impossible(q), segments[0]))
+            # same stats contract as execute_segments: every requested
+            # segment counts toward numSegmentsQueried and totalDocs, even
+            # when pruning or the row budget skipped its execution
+            last = blocks[-1].stats
+            last.num_segments_queried = len(segments)
+            last.num_segments_pruned = pruned
+            last.total_docs += unexecuted_docs + sum(
+                s.n_docs for s in remaining)
+            self.queries_served += 1
+            return [encode(b) for b in blocks]
+        finally:
+            if tdm is not None:
+                tdm.release(acquired)
 
     # ---- segment sync (state model replacement) --------------------------
     def _sync_loop(self) -> None:

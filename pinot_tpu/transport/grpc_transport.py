@@ -23,6 +23,7 @@ from typing import Callable, Optional
 import grpc
 
 SUBMIT_METHOD = "/pinot.PinotQueryServer/Submit"
+SUBMIT_STREAMING_METHOD = "/pinot.PinotQueryServer/SubmitStreaming"
 
 
 def make_instance_request(sql: str, segments: list, request_id: int,
@@ -49,13 +50,24 @@ def parse_instance_request(data: bytes) -> dict:
 
 
 class _BytesHandler(grpc.GenericRpcHandler):
-    def __init__(self, submit_fn: Callable[[bytes], bytes]):
+    def __init__(self, submit_fn: Callable[[bytes], bytes],
+                 submit_streaming_fn: Optional[Callable] = None):
         self._submit = submit_fn
+        self._submit_streaming = submit_streaming_fn
 
     def service(self, handler_call_details):
         if handler_call_details.method == SUBMIT_METHOD:
             return grpc.unary_unary_rpc_method_handler(
                 lambda req, ctx: self._submit(req),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        if (handler_call_details.method == SUBMIT_STREAMING_METHOD
+                and self._submit_streaming is not None):
+            # server-streaming: one DataTable block per yield
+            # (server.proto:43-47 streaming Submit analog)
+            return grpc.unary_stream_rpc_method_handler(
+                lambda req, ctx: self._submit_streaming(req),
                 request_deserializer=None,
                 response_serializer=None,
             )
@@ -66,10 +78,11 @@ class QueryServerTransport:
     """Server side: listens and dispatches Submit to the handler."""
 
     def __init__(self, submit_fn: Callable[[bytes], bytes],
-                 host: str = "127.0.0.1", port: int = 0, max_workers: int = 8):
+                 host: str = "127.0.0.1", port: int = 0, max_workers: int = 8,
+                 submit_streaming_fn: Optional[Callable] = None):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
-            handlers=(_BytesHandler(submit_fn),),
+            handlers=(_BytesHandler(submit_fn, submit_streaming_fn),),
         )
         self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
@@ -95,9 +108,19 @@ class QueryRouterChannel:
         self._submit = self._channel.unary_unary(
             SUBMIT_METHOD, request_serializer=None, response_deserializer=None
         )
+        self._submit_streaming = self._channel.unary_stream(
+            SUBMIT_STREAMING_METHOD, request_serializer=None,
+            response_deserializer=None,
+        )
 
     def submit(self, request: bytes, timeout_s: float) -> bytes:
         return self._submit(request, timeout=timeout_s)
+
+    def submit_streaming(self, request: bytes, timeout_s: float):
+        """Returns the gRPC response iterator (also a Call: the consumer
+        may ``.cancel()`` it for early termination once it has enough
+        rows — the streaming reduce's short-circuit)."""
+        return self._submit_streaming(request, timeout=timeout_s)
 
     def close(self) -> None:
         self._channel.close()
