@@ -1,0 +1,210 @@
+"""Python client: DB-API-flavored access to a broker.
+
+Equivalent of the reference's client libraries (pinot-clients/
+pinot-java-client's Connection/ResultSetGroup and the external pinotdb
+driver): ``connect()`` to a broker HTTP endpoint (or wrap an in-process
+Broker / registry for embedded use), cursors with ``execute`` /
+``fetch*`` / ``description`` / ``rowcount``, and broker response stats
+on the cursor. Read-only by design — DML raises, like the reference.
+
+    from pinot_tpu.client import connect
+    conn = connect("http://localhost:8099")
+    cur = conn.cursor()
+    cur.execute("SELECT city, COUNT(*) FROM t GROUP BY city")
+    for row in cur:
+        ...
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Optional
+
+apilevel = "2.0"
+threadsafety = 1
+paramstyle = "qmark"
+
+
+class Error(Exception):
+    """DB-API base error."""
+
+
+class DatabaseError(Error):
+    """Query-level failure reported by the cluster."""
+
+
+class ProgrammingError(Error):
+    """Client misuse (closed cursor, fetch before execute...)."""
+
+
+class Cursor:
+    arraysize = 1
+
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self._rows: Optional[list] = None
+        self._pos = 0
+        self.description = None
+        self.rowcount = -1
+        self.stats: dict = {}
+        self._closed = False
+
+    # ---- DB-API surface -------------------------------------------------
+    def execute(self, sql: str, params=None) -> "Cursor":
+        if self._closed:
+            raise ProgrammingError("cursor is closed")
+        if params is not None:
+            # qmark substitution with conservative literal quoting;
+            # ? inside single-quoted literals is not a placeholder
+            parts = _split_placeholders(sql)
+            if len(parts) != len(params) + 1:
+                raise ProgrammingError(
+                    f"query has {len(parts) - 1} placeholders, "
+                    f"{len(params)} params given")
+            out = []
+            for i, p in enumerate(parts):
+                out.append(p)
+                if i < len(params):
+                    out.append(_quote(params[i]))
+            sql = "".join(out)
+        resp = self._conn._execute(sql)
+        if resp.get("exceptions"):
+            raise DatabaseError(resp["exceptions"])
+        rt = resp.get("resultTable") or {"dataSchema": {"columnNames": [],
+                                                        "columnDataTypes": []},
+                                         "rows": []}
+        names = rt["dataSchema"]["columnNames"]
+        types = rt["dataSchema"]["columnDataTypes"]
+        self.description = [(n, t, None, None, None, None, None)
+                            for n, t in zip(names, types)]
+        self._rows = [tuple(r) for r in rt["rows"]]
+        self._pos = 0
+        self.rowcount = len(self._rows)
+        self.stats = {k: v for k, v in resp.items()
+                      if k not in ("resultTable", "exceptions")}
+        return self
+
+    def _require_rows(self) -> list:
+        if self._rows is None:
+            raise ProgrammingError("fetch before execute")
+        return self._rows
+
+    def fetchone(self):
+        rows = self._require_rows()
+        if self._pos >= len(rows):
+            return None
+        row = rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size: Optional[int] = None) -> list:
+        rows = self._require_rows()
+        if size is None:
+            size = self.arraysize
+        out = rows[self._pos: self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self) -> list:
+        rows = self._require_rows()
+        out = rows[self._pos:]
+        self._pos = len(rows)
+        return out
+
+    def __iter__(self):
+        while True:
+            row = self.fetchone()
+            if row is None:
+                return
+            yield row
+
+    def close(self) -> None:
+        self._closed = True
+        self._rows = None
+
+
+def _split_placeholders(sql: str) -> list:
+    parts, cur, in_str = [], [], False
+    for ch in sql:
+        if ch == "'":
+            in_str = not in_str
+            cur.append(ch)
+        elif ch == "?" and not in_str:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _quote(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
+class Connection:
+    def __init__(self, broker_url: Optional[str] = None, broker=None,
+                 registry=None, timeout_s: float = 30.0):
+        if broker_url is None and broker is None and registry is None:
+            raise ProgrammingError(
+                "connect() needs a broker_url, a Broker, or a registry")
+        self._url = broker_url.rstrip("/") if broker_url else None
+        self._broker = broker
+        self._owns_broker = False
+        if self._broker is None and registry is not None:
+            from pinot_tpu.broker.broker import Broker
+
+            self._broker = Broker(registry, timeout_s=timeout_s)
+            self._owns_broker = True
+        self._timeout_s = timeout_s
+        self._closed = False
+
+    def _execute(self, sql: str) -> dict:
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        if self._broker is not None:
+            return self._broker.execute(sql)
+        req = urllib.request.Request(
+            self._url + "/query/sql",
+            data=json.dumps({"sql": sql}).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+                return json.loads(resp.read())
+        except Error:
+            raise
+        except Exception as e:  # noqa: BLE001 — transport failure
+            raise DatabaseError(f"broker unreachable: {e}") from e
+
+    def cursor(self) -> Cursor:
+        if self._closed:
+            raise ProgrammingError("connection is closed")
+        return Cursor(self)
+
+    def close(self) -> None:
+        self._closed = True
+        if self._owns_broker and self._broker is not None:
+            self._broker.close()
+
+    def commit(self) -> None:
+        pass  # read-only: DB-API requires the method to exist
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def connect(broker_url: Optional[str] = None, **kwargs) -> Connection:
+    return Connection(broker_url, **kwargs)

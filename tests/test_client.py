@@ -1,0 +1,149 @@
+"""Python client (DB-API flavored) against broker HTTP and embedded.
+
+Reference analogs: pinot-java-client Connection/ResultSetGroup, the
+external pinotdb DB-API driver.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.broker.http_api import BrokerHttpServer
+from pinot_tpu.client import Connection, DatabaseError, ProgrammingError, connect
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("client")
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp / "ds"))
+    server = ServerInstance("server_0", registry, str(tmp / "s0"),
+                            device_executor=None)
+    server.start()
+    broker = Broker(registry, timeout_s=10.0)
+    http = BrokerHttpServer(broker)
+    http.start()
+    schema = Schema.build(
+        name="cities",
+        dimensions=[("name", DataType.STRING)],
+        metrics=[("pop", DataType.LONG)],
+    )
+    cfg = TableConfig(table_name="cities")
+    controller.add_table(cfg, schema)
+    build_segment(
+        schema,
+        {"name": ["springfield", "shelbyville", "ogdenville", "o'brienville"],
+         "pop": np.array([30000, 20000, 5000, 1000], dtype=np.int64)},
+        str(tmp / "up"), cfg, "c0")
+    controller.upload_segment("cities", str(tmp / "up"))
+    assert wait_until(lambda: len(registry.external_view("cities_OFFLINE")) == 1)
+    yield registry, broker, http
+    http.stop()
+    broker.close()
+    server.stop()
+
+
+class TestClient:
+    def test_http_connection_fetch(self, cluster):
+        registry, broker, http = cluster
+        with connect(http.url) as conn:
+            cur = conn.cursor()
+            cur.execute("SELECT name, pop FROM cities ORDER BY pop DESC")
+            assert cur.rowcount == 4
+            assert [d[0] for d in cur.description] == ["name", "pop"]
+            assert cur.fetchone() == ("springfield", 30000)
+            assert cur.fetchmany(2) == [("shelbyville", 20000),
+                                        ("ogdenville", 5000)]
+            assert cur.fetchall() == [("o'brienville", 1000)]
+            assert cur.fetchone() is None
+            assert cur.stats["numDocsScanned"] >= 4
+
+    def test_iteration_and_aggregate(self, cluster):
+        registry, broker, http = cluster
+        conn = connect(http.url)
+        cur = conn.cursor().execute("SELECT SUM(pop) FROM cities")
+        assert list(cur) == [(56000,)]
+        conn.close()
+
+    def test_qmark_params_quote_safely(self, cluster):
+        registry, broker, http = cluster
+        with connect(http.url) as conn:
+            cur = conn.cursor()
+            cur.execute("SELECT pop FROM cities WHERE name = ?",
+                        ["o'brienville"])
+            assert cur.fetchall() == [(1000,)]
+            cur.execute("SELECT name FROM cities WHERE pop > ? ORDER BY name",
+                        [19000])
+            assert cur.fetchall() == [("shelbyville",), ("springfield",)]
+            with pytest.raises(ProgrammingError, match="placeholders"):
+                cur.execute("SELECT 1 FROM cities WHERE pop > ?", [1, 2])
+            # empty params still validates placeholder count
+            with pytest.raises(ProgrammingError, match="placeholders"):
+                cur.execute("SELECT 1 FROM cities WHERE pop > ?", [])
+            # ? inside a string literal is not a placeholder
+            cur.execute("SELECT pop FROM cities WHERE name <> '?' "
+                        "AND pop < ?", [2000])
+            assert cur.fetchall() == [(1000,)]
+
+    def test_fetchmany_zero_returns_empty(self, cluster):
+        registry, broker, http = cluster
+        with connect(http.url) as conn:
+            cur = conn.cursor().execute("SELECT name FROM cities")
+            assert cur.fetchmany(0) == []
+            assert len(cur.fetchall()) == 4
+
+    def test_embedded_connection_over_registry(self, cluster):
+        registry, broker, http = cluster
+        with connect(registry=registry) as conn:
+            cur = conn.cursor().execute("SELECT COUNT(*) FROM cities")
+            assert cur.fetchall() == [(4,)]
+
+    def test_wrapping_existing_broker(self, cluster):
+        registry, broker, http = cluster
+        conn = Connection(broker=broker)
+        assert conn.cursor().execute(
+            "SELECT MAX(pop) FROM cities").fetchone() == (30000,)
+        conn.close()
+        # wrapping does not own the broker: it keeps working
+        assert broker.execute("SELECT COUNT(*) FROM cities")[
+            "resultTable"]["rows"] == [[4]]
+
+    def test_errors_raise_database_error(self, cluster):
+        registry, broker, http = cluster
+        with connect(http.url) as conn:
+            cur = conn.cursor()
+            with pytest.raises(DatabaseError):
+                cur.execute("SELECT nosuch FROM cities")
+            with pytest.raises(DatabaseError):
+                cur.execute("SELECT COUNT(*) FROM nosuchtable")
+
+    def test_closed_states(self, cluster):
+        registry, broker, http = cluster
+        conn = connect(http.url)
+        cur = conn.cursor()
+        with pytest.raises(ProgrammingError, match="fetch before execute"):
+            cur.fetchall()
+        cur.close()
+        with pytest.raises(ProgrammingError, match="closed"):
+            cur.execute("SELECT 1 FROM cities")
+        conn.close()
+        with pytest.raises(ProgrammingError, match="closed"):
+            conn.cursor()
