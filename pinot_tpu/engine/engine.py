@@ -16,6 +16,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from typing import Optional
 
 from pinot_tpu.engine.host import HostExecutor
 from pinot_tpu.engine.reduce import finalize, merge_intermediates
@@ -95,15 +96,18 @@ class TableDataManager:
     ``on_unload`` fires once the last reference drains (the server deletes
     its local working copy there)."""
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, host_name: Optional[str] = None):
         self.name = name
         self.segments: dict[str, ImmutableSegment] = {}
         self._refs: dict[str, int] = {}
         self._doomed: dict[str, ImmutableSegment] = {}
         self._lock = threading.Lock()
         self.on_unload = None  # callback(segment) after last ref drops
+        self.host_name = host_name  # stamps $hostName on hosted segments
 
     def add_segment(self, seg: ImmutableSegment) -> None:
+        if self.host_name is not None and getattr(seg, "host_name", None) is None:
+            seg.host_name = self.host_name
         with self._lock:
             self.segments[seg.name] = seg
             self._doomed.pop(seg.name, None)  # re-add wins over unload
@@ -152,8 +156,10 @@ class TableDataManager:
 class QueryEngine:
     """SQL in, response out, over in-process tables."""
 
-    def __init__(self, device_executor="auto", num_groups_limit: int = 100_000):
+    def __init__(self, device_executor="auto", num_groups_limit: int = 100_000,
+                 host_name: Optional[str] = None):
         self.tables: dict[str, TableDataManager] = {}
+        self.host_name = host_name  # server instance id for $hostName
         self.host = HostExecutor(num_groups_limit=num_groups_limit)
         self.pruner = SegmentPruner()
         if device_executor == "auto":
@@ -165,7 +171,7 @@ class QueryEngine:
     # ---- table management -----------------------------------------------
     def table(self, name: str) -> TableDataManager:
         if name not in self.tables:
-            self.tables[name] = TableDataManager(name)
+            self.tables[name] = TableDataManager(name, host_name=self.host_name)
         return self.tables[name]
 
     def add_segment(self, table: str, seg: ImmutableSegment) -> None:

@@ -113,6 +113,8 @@ class SegmentEvaluator:
         if expr.is_literal:
             return np.asarray(expr.value)
         if expr.is_identifier:
+            if expr.name.startswith("$"):
+                return self._virtual_column(expr.name)
             return np.asarray(self.seg.values(expr.name))[: self.n]
         fn = get_function(expr.name)
         if expr.name == "cast":
@@ -120,6 +122,22 @@ class SegmentEvaluator:
             return fn.np_fn(arg, expr.args[1].value)
         args = [self._eval_all(a) for a in expr.args]
         return fn.np_fn(*args)
+
+    def _virtual_column(self, name: str) -> np.ndarray:
+        """Built-in virtual columns (segment/virtualcolumn/ analog:
+        DocIdVirtualColumnProvider etc.) — synthesized, never stored."""
+        if name == "$docId":
+            return np.arange(self.n, dtype=np.int64)
+        if name == "$segmentName":
+            return np.full(self.n, str(getattr(self.seg, "name", "")))
+        if name == "$hostName":
+            host = getattr(self.seg, "host_name", None)
+            if host is None:
+                import socket
+
+                host = socket.gethostname()
+            return np.full(self.n, str(host))
+        raise KeyError(f"unknown virtual column {name!r}")
 
     # ---- filter evaluation ----------------------------------------------
     def filter_mask(self, f: FilterNode) -> np.ndarray:

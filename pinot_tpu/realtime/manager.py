@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.realtime import merger
 from pinot_tpu.realtime.upsert import PartitionUpsertMetadataManager
 from pinot_tpu.storage.mutable import MutableSegment
 from pinot_tpu.stream.spi import (
@@ -131,6 +132,10 @@ class RealtimePartitionManager:
         self.on_consuming_segment = on_consuming_segment
         self.on_committed_segment = on_committed_segment
         self.upsert = upsert_manager
+        self.partial_merger = None
+        if upsert_manager is not None and table_config.upsert.mode == "PARTIAL":
+            self.partial_merger = merger.PartialUpsertMerger(
+                schema, table_config.upsert)
         self.fetch_timeout_ms = fetch_timeout_ms
         self.idle_sleep_s = idle_sleep_s
         self.completion = completion
@@ -232,12 +237,24 @@ class RealtimePartitionManager:
             consumer.close()
 
     def _index_row(self, row: dict, msg) -> None:
-        doc_id = self.segment.index(row)
         if self.upsert is not None:
             key = tuple(row[k] for k in self.schema.primary_key_columns)
             cmp_col = self.upsert.comparison_column
             cmp_val = row.get(cmp_col) if cmp_col else msg.offset.value
+            if self.partial_merger is not None:
+                prev = self.upsert.get_location(key)
+                # out-of-order events don't merge (the CAS below drops them),
+                # mirroring the reference's ordered partial-upsert contract
+                if prev is not None and (
+                    cmp_col is None or cmp_val >= prev.comparison_value
+                ):
+                    prev_row = merger.read_row(
+                        prev.segment, prev.doc_id, self.schema.column_names())
+                    row = self.partial_merger.merge(prev_row, row)
+            doc_id = self.segment.index(row)
             self.upsert.add_record(self.segment, doc_id, key, cmp_val)
+        else:
+            self.segment.index(row)
 
     def _should_flush(self) -> bool:
         if self.segment.n_docs >= self.rows_threshold:

@@ -51,6 +51,12 @@ class PartitionUpsertMetadataManager:
     def __len__(self) -> int:
         return len(self._map)
 
+    def get_location(self, key: tuple) -> Optional[RecordLocation]:
+        """Current winner for a key (partial-upsert previous-version read).
+        Safe under the single-consumer-per-partition writer contract."""
+        with self._lock:
+            return self._map.get(key)
+
     def add_record(self, segment, doc_id: int, key: tuple, comparison_value) -> bool:
         """CAS semantics (reference :102-117): the record with the greater
         comparison value wins; ties go to the newer record."""

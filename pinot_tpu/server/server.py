@@ -76,7 +76,8 @@ class ServerInstance:
         self.registry = registry
         self.data_dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
-        self.engine = QueryEngine(device_executor=device_executor)
+        self.engine = QueryEngine(device_executor=device_executor,
+                                  host_name=instance_id)
         # transport threads must cover running + queued queries, or requests
         # queue invisibly in grpc's executor and time out as transport
         # failures (poisoning the broker's failure detector) before the

@@ -215,6 +215,25 @@ class MutableSegment:
             return None
         return self._valid[:n]
 
+    def row_value(self, col: str, doc_id: int):
+        """One doc's decoded value, or None when null there — O(1), used by
+        the partial-upsert previous-version read (no column materialization).
+        null_docs appends in doc order, so membership is a binary search."""
+        import bisect
+
+        c = self._cols[col]
+        nd = c.null_docs
+        if nd:
+            i = bisect.bisect_left(nd, doc_id, 0, len(nd))
+            if i < len(nd) and nd[i] == doc_id:
+                return None
+        if not c.single_value:
+            return c._rows[doc_id].tolist()
+        if c.dict_encoded:
+            return c._dict_values[int(c._data[doc_id])]
+        v = c._data[doc_id]
+        return v.item() if isinstance(v, np.generic) else v
+
     def null_vector(self, col: str):
         """Per-doc null bitmap over all indexed docs, or None when clean
         (readers slice to their snapshot length)."""

@@ -253,6 +253,27 @@ class ImmutableSegment:
             return self.dictionary(col).take(np.asarray(fwd))
         return np.asarray(fwd)
 
+    def row_value(self, col: str, doc_id: int):
+        """One doc's decoded value, or None when the doc is null there —
+        O(1) via the cached forward index + dictionary, used by the
+        partial-upsert previous-version read."""
+        nv = self.null_vector(col)
+        if nv is not None and doc_id < len(nv) and nv[doc_id]:
+            return None
+        meta = self.column_metadata(col)
+        fwd = self.forward(col)
+        if meta.single_value:
+            v = fwd[doc_id]
+            if meta.encoding == Encoding.DICT:
+                v = self.dictionary(col).values[int(v)]
+        else:
+            off = np.asarray(self.mv_offsets(col))
+            ent = np.asarray(fwd[off[doc_id]: off[doc_id + 1]])
+            if meta.encoding == Encoding.DICT:
+                ent = self.dictionary(col).take(ent)
+            return ent.tolist()
+        return v.item() if isinstance(v, np.generic) else v
+
     def has_star_tree(self) -> bool:
         return os.path.isdir(self._path("startree"))
 

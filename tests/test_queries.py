@@ -383,6 +383,41 @@ class TestMisc:
         assert resp["exceptions"]
 
 
+class TestVirtualColumns:
+    """$docId / $segmentName / $hostName providers
+    (segment/virtualcolumn/ analog)."""
+
+    def test_doc_id_selection(self, setup):
+        engine, _ = setup
+        resp = engine.execute(
+            "SELECT $docId, runs FROM baseballStats "
+            "WHERE $docId < 3 AND $segmentName = 's0' ORDER BY $docId"
+        )
+        rows = resp["resultTable"]["rows"]
+        assert [r[0] for r in rows] == [0, 1, 2]
+
+    def test_segment_name_group_by(self, setup):
+        engine, _ = setup
+        resp = engine.execute(
+            "SELECT $segmentName, COUNT(*) FROM baseballStats "
+            "GROUP BY $segmentName ORDER BY $segmentName"
+        )
+        assert resp["resultTable"]["rows"] == [["s0", 3000], ["s1", 3000]]
+
+    def test_host_name_defaults_to_hostname(self, setup):
+        import socket
+
+        engine, _ = setup
+        resp = engine.execute(
+            "SELECT DISTINCT $hostName FROM baseballStats")
+        assert resp["resultTable"]["rows"] == [[socket.gethostname()]]
+
+    def test_unknown_virtual_column_errors(self, setup):
+        engine, _ = setup
+        resp = engine.execute("SELECT $bogus FROM baseballStats")
+        assert resp["exceptions"]
+
+
 class TestHashing:
     def test_murmur3_32_known_vectors(self):
         """Deterministic murmur3_32 (ADVICE r1: builtin hash() is

@@ -333,6 +333,169 @@ class TestUpsert:
             mgr.stop(commit_remaining=False)
 
 
+def _partial_setup(tmp_path, topic_name, strategies, flush_rows=10_000,
+                   cmp_col="ts"):
+    TopicRegistry.delete(topic_name)
+    topic = TopicRegistry.create(topic_name, 1)
+    cfg = TableConfig(
+        table_name="events",
+        table_type=TableType.REALTIME,
+        upsert=UpsertConfig(mode="PARTIAL", comparison_column=cmp_col,
+                            partial_upsert_strategies=strategies),
+        stream=StreamConfig(
+            stream_type="memory", topic=topic_name, decoder="json",
+            segment_flush_threshold_rows=flush_rows,
+            segment_flush_threshold_seconds=3600,
+        ),
+    )
+    eng = QueryEngine()
+    mgr = RealtimeTableDataManager(
+        make_schema(pk=True), cfg, eng.table("events"), str(tmp_path / "rt")
+    )
+    return topic, cfg, eng, mgr
+
+
+class TestPartialUpsert:
+    """upsert/merger/ analog: per-column merge of the previous version."""
+
+    def test_increment_and_ignore(self, tmp_path):
+        topic, cfg, eng, mgr = _partial_setup(
+            tmp_path, "t_partial1",
+            {"amount": "INCREMENT", "action": "IGNORE"})
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "action": "first", "amount": 10, "ts": 1})
+            topic.publish_json({"user": "a", "action": "second", "amount": 5, "ts": 2})
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            r = eng.execute("SELECT action, amount FROM events WHERE user = 'a'")
+            assert r["resultTable"]["rows"] == [["first", 15]]
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_missing_column_carries_over(self, tmp_path):
+        topic, cfg, eng, mgr = _partial_setup(tmp_path, "t_partial2", {})
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "action": "x", "amount": 42, "ts": 1})
+            topic.publish_json({"user": "a", "ts": 2})  # no action/amount
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            r = eng.execute("SELECT action, amount FROM events WHERE user = 'a'")
+            assert r["resultTable"]["rows"] == [["x", 42]]
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_out_of_order_does_not_merge(self, tmp_path):
+        topic, cfg, eng, mgr = _partial_setup(
+            tmp_path, "t_partial3", {"amount": "INCREMENT"})
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "action": "n", "amount": 10, "ts": 500})
+            topic.publish_json({"user": "a", "action": "o", "amount": 7, "ts": 100})
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            assert _total(eng, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 10
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_merge_from_sealed_segment_and_restart(self, tmp_path):
+        topic, cfg, eng, mgr = _partial_setup(
+            tmp_path, "t_partial4", {"amount": "INCREMENT", "action": "IGNORE"},
+            flush_rows=2)
+        mgr.start()
+        topic.publish_json({"user": "a", "action": "keep", "amount": 1, "ts": 1})
+        topic.publish_json({"user": "b", "action": "y", "amount": 2, "ts": 1})  # seals S0
+        assert wait_until(lambda: sum(m.commits for m in mgr.partition_managers.values()) >= 1)
+        # previous version now lives in a sealed segment
+        topic.publish_json({"user": "a", "action": "drop", "amount": 9, "ts": 2})
+        assert wait_until(
+            lambda: _total(eng, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 10)
+        r = eng.execute("SELECT action FROM events WHERE user = 'a'")
+        assert r["resultTable"]["rows"] == [["keep"]]
+        mgr.stop(commit_remaining=True)
+
+        # restart: sealed rows hold merged values, replay reconstructs state
+        eng2 = QueryEngine()
+        mgr2 = RealtimeTableDataManager(
+            make_schema(pk=True), cfg, eng2.table("events"), str(tmp_path / "rt")
+        )
+        mgr2.start()
+        try:
+            assert _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 10
+            topic.publish_json({"user": "a", "action": "later", "amount": 5, "ts": 3})
+            assert wait_until(
+                lambda: _total(eng2, "SELECT SUM(amount) FROM events WHERE user = 'a'") == 15)
+            r = eng2.execute("SELECT action FROM events WHERE user = 'a'")
+            assert r["resultTable"]["rows"] == [["keep"]]
+        finally:
+            mgr2.stop(commit_remaining=False)
+
+    def test_explicit_null_carries_previous(self, tmp_path):
+        """An explicit JSON null in the incoming event must keep the
+        previous value, not crash the merge (r3 review finding: the
+        TypeError made the whole event a dropped poison message)."""
+        topic, cfg, eng, mgr = _partial_setup(
+            tmp_path, "t_partial_null", {"amount": "INCREMENT"})
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "action": "x", "amount": 10, "ts": 1})
+            topic.publish_json({"user": "a", "action": "y", "amount": None, "ts": 2})
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            assert not any(
+                m.index_errors for m in mgr.partition_managers.values())
+            r = eng.execute("SELECT action, amount FROM events WHERE user = 'a'")
+            assert r["resultTable"]["rows"] == [["y", 10]]
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_previous_null_takes_incoming(self, tmp_path):
+        """IGNORE must not resurrect a default-fill value over a real
+        incoming one when the previous version was null (r3 review
+        finding: read_row couldn't distinguish null from default)."""
+        topic, cfg, eng, mgr = _partial_setup(
+            tmp_path, "t_partial_null2", {"action": "IGNORE"})
+        mgr.start()
+        try:
+            topic.publish_json({"user": "a", "amount": 1, "ts": 1})  # action null
+            topic.publish_json({"user": "a", "action": "real", "amount": 2, "ts": 2})
+            assert wait_until(lambda: _total_indexed(mgr) == 2)
+            r = eng.execute("SELECT action FROM events WHERE user = 'a'")
+            assert r["resultTable"]["rows"] == [["real"]]
+            # a still-null carried-over column stays null for IS_NULL
+            topic.publish_json({"user": "b", "amount": 1, "ts": 1})
+            topic.publish_json({"user": "b", "amount": 2, "ts": 2})
+            assert wait_until(lambda: _total_indexed(mgr) == 4)
+            r = eng.execute(
+                "SELECT COUNT(*) FROM events WHERE user = 'b' AND action IS NULL")
+            assert r["resultTable"]["rows"][0][0] == 1
+        finally:
+            mgr.stop(commit_remaining=False)
+
+    def test_strategy_validation(self):
+        from pinot_tpu.realtime.merger import PartialUpsertMerger
+
+        with pytest.raises(ValueError, match="unknown"):
+            PartialUpsertMerger(
+                make_schema(pk=True),
+                UpsertConfig(mode="PARTIAL",
+                             partial_upsert_strategies={"amount": "BOGUS"}))
+        with pytest.raises(ValueError, match="key/comparison"):
+            PartialUpsertMerger(
+                make_schema(pk=True),
+                UpsertConfig(mode="PARTIAL", comparison_column="ts",
+                             partial_upsert_strategies={"ts": "MAX"}))
+
+    def test_strategy_functions(self):
+        from pinot_tpu.realtime.merger import STRATEGIES
+
+        assert STRATEGIES["APPEND"]([1, 2], [3]) == [1, 2, 3]
+        assert STRATEGIES["APPEND"](1, 2) == [1, 2]
+        assert STRATEGIES["UNION"]([1, 2], [2, 3]) == [1, 2, 3]
+        assert STRATEGIES["MAX"](3, 5) == 5
+        assert STRATEGIES["MIN"](3, 5) == 3
+        assert STRATEGIES["OVERWRITE"]("a", "b") == "b"
+        assert STRATEGIES["IGNORE"]("a", "b") == "a"
+        assert STRATEGIES["INCREMENT"](2, 3) == 5
+
+
 def _count(eng):
     r = eng.execute("SELECT COUNT(*) FROM events")
     if r.get("exceptions"):
