@@ -153,8 +153,12 @@ class Broker:
             if q.explain:
                 from pinot_tpu.engine.explain import explain_plan
 
-                class _NoDevice:  # broker-side explain has no local executor
+                class _NoDevice:
+                    # broker-side explain has no local executor or segments:
+                    # filter lines show generic PREDICATE operators (index
+                    # choice is per-segment, server-side)
                     device = None
+                    tables: dict = {}
 
                 return explain_plan(_NoDevice(), q)
             if dict(q.options).get("trace"):

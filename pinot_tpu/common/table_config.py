@@ -34,6 +34,8 @@ class IndexingConfig:
     inverted_index_columns: list[str] = dataclasses.field(default_factory=list)
     range_index_columns: list[str] = dataclasses.field(default_factory=list)
     bloom_filter_columns: list[str] = dataclasses.field(default_factory=list)
+    json_index_columns: list[str] = dataclasses.field(default_factory=list)
+    text_index_columns: list[str] = dataclasses.field(default_factory=list)
     sorted_column: Optional[str] = None
     no_dictionary_columns: list[str] = dataclasses.field(default_factory=list)
     star_tree_configs: list[StarTreeIndexConfig] = dataclasses.field(default_factory=list)

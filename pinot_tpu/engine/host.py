@@ -58,6 +58,12 @@ def filter_operator_for(seg, p: Predicate) -> str:
     if not (lhs.is_identifier and lhs.name in seg.metadata.columns):
         return "FULL_SCAN"
     meta = seg.column_metadata(lhs.name)
+    if p.type is PredicateType.JSON_MATCH:
+        return "JSON_INDEX" if getattr(meta, "has_json_index", False) \
+            else "FULL_SCAN"
+    if p.type is PredicateType.TEXT_MATCH:
+        return "TEXT_INDEX" if getattr(meta, "has_text_index", False) \
+            else "FULL_SCAN"
     if meta.encoding != Encoding.DICT or not meta.single_value or \
             p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
         return "FULL_SCAN"
@@ -220,6 +226,10 @@ class SegmentEvaluator:
 
     def predicate_mask(self, p: Predicate) -> np.ndarray:
         lhs = p.lhs
+        if p.type is PredicateType.JSON_MATCH:
+            return self._json_match_mask(p)
+        if p.type is PredicateType.TEXT_MATCH:
+            return self._text_match_mask(p)
         # dictionary-space fast path
         if lhs.is_identifier and lhs.name in self.seg.metadata.columns:
             meta = self.seg.column_metadata(lhs.name)
@@ -251,6 +261,43 @@ class SegmentEvaluator:
         self.entries_scanned_in_filter += self.n
         values = self.eval(lhs)
         return self._predicate_over_values(p, np.asarray(values))
+
+    def _json_match_mask(self, p: Predicate) -> np.ndarray:
+        """JSON_MATCH(col, '<expr>'): posting-list evaluation when the
+        segment has a JSON index, flatten-per-doc scan otherwise — identical
+        flat-row semantics either way (ImmutableJsonIndexReader analog)."""
+        from pinot_tpu.storage import jsonindex
+
+        if not p.lhs.is_identifier:
+            raise ValueError("JSON_MATCH takes a column as its first arg")
+        col = p.lhs.name
+        f = jsonindex.parse_match_expression(p.value)
+        idx = None
+        if hasattr(self.seg, "json_index"):
+            idx = self.seg.json_index(col)
+        if idx is not None:
+            return idx.match(f, self.n)[: self.n]
+        self.entries_scanned_in_filter += self.n
+        values = np.asarray(self.seg.values(col))[: self.n]
+        return jsonindex.match_scan(values, f, self.n)
+
+    def _text_match_mask(self, p: Predicate) -> np.ndarray:
+        """TEXT_MATCH(col, '<lucene-subset query>'): posting-list evaluation
+        on the text index, tokenized scan otherwise — identical term/phrase
+        semantics either way (LuceneTextIndexReader analog)."""
+        from pinot_tpu.storage import textindex
+
+        if not p.lhs.is_identifier:
+            raise ValueError("TEXT_MATCH takes a column as its first arg")
+        col = p.lhs.name
+        idx = None
+        if hasattr(self.seg, "text_index"):
+            idx = self.seg.text_index(col)
+        if idx is None:
+            self.entries_scanned_in_filter += self.n
+            values = np.asarray(self.seg.values(col))[: self.n]
+            idx = textindex.ScanTextIndex(values)
+        return idx.match(p.value, self.n)
 
     def _indexed_mask(self, col: str, meta, p: Predicate, ids: np.ndarray):
         """Index-served mask for a dict predicate whose matching dict ids are
@@ -307,7 +354,7 @@ class SegmentEvaluator:
                 hi = self._coerce(p.upper, v)
                 m &= (v <= hi) if p.upper_inclusive else (v < hi)
             return m
-        if t in (PredicateType.LIKE, PredicateType.REGEXP_LIKE, PredicateType.TEXT_MATCH):
+        if t in (PredicateType.LIKE, PredicateType.REGEXP_LIKE):
             pat = p.value if t is not PredicateType.LIKE else like_to_regex(p.value)
             rx = re.compile(pat)
             search = rx.search if t is not PredicateType.LIKE else rx.match

@@ -202,6 +202,91 @@ def _substr(a, start, end=None):
     return np.array([x[start:int(end)] for x in s])
 
 
+# ---- JSON (host-only; JsonFunctions.java / JsonExtractScalar analog) ------
+
+_JSON_PATH_RE = None  # compiled lazily
+
+
+def _json_path_steps(path: str) -> list:
+    import re as _re
+
+    global _JSON_PATH_RE
+    if _JSON_PATH_RE is None:
+        _JSON_PATH_RE = _re.compile(r"\.([^.\[\]]+)|\[(\d+)\]")
+    if not path.startswith("$"):
+        raise ValueError(f"json path must start with $: {path!r}")
+    steps = []
+    pos = 1
+    for m in _JSON_PATH_RE.finditer(path, 1):
+        if m.start() != pos:
+            # unparsable segment (e.g. [*] or a typo): reject instead of
+            # silently navigating a different path
+            raise ValueError(f"unsupported json path {path!r} "
+                             f"(scalar paths only, no wildcards)")
+        steps.append(m.group(1) if m.group(1) is not None else int(m.group(2)))
+        pos = m.end()
+    if pos != len(path):
+        raise ValueError(f"unsupported json path {path!r} "
+                         f"(scalar paths only, no wildcards)")
+    return steps
+
+
+def _json_nav(obj, steps):
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(obj, list) or s >= len(obj):
+                return None
+            obj = obj[s]
+        else:
+            if not isinstance(obj, dict):
+                return None
+            obj = obj.get(s)
+        if obj is None:
+            return None
+    return obj
+
+
+_JSON_RESULT_TYPES = {
+    "INT": (np.int32, 0), "LONG": (np.int64, 0),
+    "FLOAT": (np.float32, 0.0), "DOUBLE": (np.float64, 0.0),
+    "STRING": (np.str_, ""), "BOOLEAN": (np.bool_, False),
+}
+
+
+def _json_extract_scalar(col, path, result_type, default=None):
+    import json as _json
+
+    def lit(x):
+        a = np.asarray(x)
+        return a.item() if a.ndim == 0 else x
+
+    path, result_type = str(lit(path)), str(lit(result_type)).upper()
+    if result_type not in _JSON_RESULT_TYPES:
+        raise KeyError(f"json_extract_scalar result type {result_type}")
+    dtype, type_default = _JSON_RESULT_TYPES[result_type]
+    default = type_default if default is None else lit(default)
+    steps = _json_path_steps(path)
+    out = []
+    for s in np.asarray(col).ravel():
+        try:
+            v = _json_nav(_json.loads(str(s)), steps)
+        except (ValueError, TypeError):
+            v = None
+        if v is None or isinstance(v, (dict, list)):
+            out.append(default)
+        elif result_type == "BOOLEAN":
+            out.append(v if isinstance(v, bool) else str(v).lower() == "true")
+        else:
+            out.append(v)
+    if dtype is np.str_:
+        return np.asarray([str(v) for v in out], dtype=np.str_)
+    return np.asarray(out).astype(dtype)
+
+
+_reg("json_extract_scalar", _json_extract_scalar, min_args=3, max_args=4)
+_reg("jsonextractscalar", _json_extract_scalar, min_args=3, max_args=4)
+
+
 # ---- datetime (host-only) -------------------------------------------------
 
 _reg("year", lambda a: _dtfield(a, "year"))

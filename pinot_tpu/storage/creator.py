@@ -230,6 +230,28 @@ class SegmentCreator:
             build_bloom(raw if dict_values is None else None, dict_values, p(f"{name}.bloom.npy"))
             has_bloom = True
 
+        has_json_index = False
+        if name in idx_cfg.json_index_columns:
+            if not (spec.single_value and spec.data_type.is_string_like):
+                raise ValueError(
+                    f"json index requires a single-value STRING/JSON column, "
+                    f"got {name}")
+            from pinot_tpu.storage.jsonindex import build_json_index
+
+            build_json_index(raw, p(f"{name}.jsonidx"))
+            has_json_index = True
+
+        has_text_index = False
+        if name in idx_cfg.text_index_columns:
+            if not (spec.single_value and spec.data_type.is_string_like):
+                raise ValueError(
+                    f"text index requires a single-value STRING column, "
+                    f"got {name}")
+            from pinot_tpu.storage.textindex import build_text_index
+
+            build_text_index(raw, p(f"{name}.textidx"))
+            has_text_index = True
+
         # Range acceleration: DICT columns get it for free — the sorted
         # dictionary maps a value range to a dict-id interval. RAW columns
         # fall back to scan until the bit-sliced range index lands, so the
@@ -258,6 +280,8 @@ class SegmentCreator:
             has_inverted=has_inverted,
             has_range=has_range,
             has_bloom=has_bloom,
+            has_json_index=has_json_index,
+            has_text_index=has_text_index,
             packed_bits=packed_bits,
             total_number_of_entries=int(total_entries),
             partition_function=part_fn,

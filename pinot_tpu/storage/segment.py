@@ -66,6 +66,8 @@ class ColumnMetadata:
     has_inverted: bool = False
     has_range: bool = False
     has_bloom: bool = False
+    has_json_index: bool = False
+    has_text_index: bool = False
     has_null_vector: bool = False
     packed_bits: Optional[int] = None  # bit-packed fwd index width, else None
     total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
@@ -143,6 +145,8 @@ class ImmutableSegment:
             self.metadata = SegmentMetadata.from_json(json.load(f))
         self._dict_cache: dict[str, Optional[Dictionary]] = {}
         self._fwd_cache: dict[str, np.ndarray] = {}
+        self._json_cache: dict = {}
+        self._text_cache: dict = {}
 
     # ---- identity -------------------------------------------------------
     @property
@@ -219,6 +223,30 @@ class ImmutableSegment:
         if not self.column_metadata(col).has_bloom:
             return None
         return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
+
+    def json_index(self, col: str):
+        """JSON index reader (ImmutableJsonIndexReader analog), or None."""
+        if col not in self._json_cache:
+            if not self.column_metadata(col).has_json_index:
+                self._json_cache[col] = None
+            else:
+                from pinot_tpu.storage.jsonindex import JsonIndexReader
+
+                self._json_cache[col] = JsonIndexReader(
+                    self._path(f"{col}.jsonidx.npz"))
+        return self._json_cache[col]
+
+    def text_index(self, col: str):
+        """Text index reader (LuceneTextIndexReader analog), or None."""
+        if col not in self._text_cache:
+            if not self.column_metadata(col).has_text_index:
+                self._text_cache[col] = None
+            else:
+                from pinot_tpu.storage.textindex import TextIndexReader
+
+                self._text_cache[col] = TextIndexReader(
+                    self._path(f"{col}.textidx.npz"))
+        return self._text_cache[col]
 
     def null_vector(self, col: str) -> Optional[np.ndarray]:
         """Per-doc null bitmap, or None when the column has no nulls

@@ -1,0 +1,185 @@
+"""JSON column type, JSON index, JSON_MATCH, JSON_EXTRACT_SCALAR.
+
+Reference analogs: ImmutableJsonIndexReader/JsonIndexCreator
+(pinot-segment-local/.../readers/json/), JsonExtractScalar transform,
+JsonMatchPredicate — including the same-flattened-doc semantics for
+array wildcards.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.jsonindex import flatten_doc
+
+DOCS = [
+    {"name": "ann", "age": 30,
+     "addresses": [{"country": "us", "city": "nyc"},
+                   {"country": "ca", "city": "yyz"}]},
+    {"name": "bob", "age": 25,
+     "addresses": [{"country": "us", "city": "sf"}], "vip": True},
+    {"name": "cat", "age": 41, "addresses": [],
+     "scores": [7, 9]},
+    {"name": "dan"},  # no age, no addresses
+    {"name": "eve", "age": 30,
+     "addresses": [{"country": "de", "city": "ber"},
+                   {"country": "us", "city": "aus"}]},
+]
+
+
+class TestFlatten:
+    def test_nested_and_wildcard(self):
+        rows = flatten_doc(DOCS[0])
+        assert len(rows) == 2  # one per addresses element
+        r0 = rows[0]
+        assert r0["$.name"] == "ann"
+        assert r0["$.addresses[0].country"] == "us"
+        assert r0["$.addresses[*].country"] == "us"
+        assert rows[1]["$.addresses[*].country"] == "ca"
+
+    def test_scalar_array(self):
+        rows = flatten_doc(DOCS[2])
+        assert {r["$.scores[*]"] for r in rows} == {"7", "9"}
+
+    def test_empty_doc_one_row(self):
+        assert flatten_doc({}) == [{}]
+        assert flatten_doc(None) == [{}]
+
+    def test_bool_and_float_canonical(self):
+        rows = flatten_doc({"a": True, "b": 3.0, "c": 2.5})
+        assert rows[0] == {"$.a": "true", "$.b": "3", "$.c": "2.5"}
+
+
+def _engine(tmp_path, with_index: bool):
+    schema = Schema.build(
+        name="people",
+        dimensions=[("person", DataType.JSON), ("id", DataType.INT)],
+    )
+    idx = IndexingConfig(json_index_columns=["person"] if with_index else [])
+    cfg = TableConfig(table_name="people", indexing=idx)
+    col = np.asarray([json.dumps(d) for d in DOCS], dtype=np.str_)
+    eng = QueryEngine(device_executor=None)
+    tag = "idx" if with_index else "scan"
+    seg = build_segment(schema, {"person": col, "id": np.arange(len(DOCS), dtype=np.int32)},
+                        str(tmp_path / f"seg_{tag}"), cfg, f"s_{tag}")
+    eng.add_segment("people", seg)
+    return eng
+
+
+@pytest.fixture(scope="module", params=[True, False], ids=["indexed", "scan"])
+def engine(request, tmp_path_factory):
+    return _engine(tmp_path_factory.mktemp("json"), request.param)
+
+
+def ids(eng, match_expr):
+    r = eng.execute(
+        f"SELECT id FROM people WHERE JSON_MATCH(person, '{match_expr}') ORDER BY id")
+    assert not r.get("exceptions"), r
+    return [row[0] for row in r["resultTable"]["rows"]]
+
+
+class TestJsonMatch:
+    def test_eq_nested(self, engine):
+        assert ids(engine, "\"$.name\" = \'\'ann\'\'") == [0]
+
+    def test_wildcard_array(self, engine):
+        assert ids(engine, "\"$.addresses[*].country\" = \'\'us\'\'") == [0, 1, 4]
+
+    def test_exact_index_path(self, engine):
+        assert ids(engine, "\"$.addresses[0].country\" = \'\'us\'\'") == [0, 1]
+
+    def test_same_element_and_semantics(self, engine):
+        # us+nyc in the SAME element: only ann. eve has us and aus but
+        # us pairs with aus, not ber
+        assert ids(engine,
+                   "\"$.addresses[*].country\" = ''us'' AND "
+                  "\"$.addresses[*].city\" = ''nyc''") == [0]
+        assert ids(engine,
+                   "\"$.addresses[*].country\" = ''de'' AND "
+                  "\"$.addresses[*].city\" = ''aus''") == []
+
+    def test_numeric_eq_and_in(self, engine):
+        assert ids(engine, '"$.age" = 30') == [0, 4]
+        assert ids(engine, '"$.age" IN (25, 41)') == [1, 2]
+
+    def test_not_eq_requires_path(self, engine):
+        # dan has no age: NE matches only docs where the path exists
+        assert ids(engine, '"$.age" <> 30') == [1, 2]
+
+    def test_is_null_and_not_null(self, engine):
+        assert ids(engine, '"$.age" IS NULL') == [3]
+        assert ids(engine, '"$.vip" IS NOT NULL') == [1]
+
+    def test_range_numeric(self, engine):
+        assert ids(engine, '"$.age" > 26 AND "$.age" <= 41') == [0, 2, 4]
+        assert ids(engine, '"$.scores[*]" >= 8') == [2]
+
+    def test_range_string_bounds(self, engine):
+        # string bounds compare lexicographically, not crash (r3 review)
+        assert ids(engine, "\"$.name\" > ''cat''") == [3, 4]
+        assert ids(engine, "\"$.name\" >= ''ann'' AND \"$.name\" < ''c''") == [0, 1]
+
+    def test_or_and_not(self, engine):
+        assert ids(engine, "\"$.name\" = \'\'dan\'\' OR \"$.age\" = 25") == [1, 3]
+        assert ids(engine, "NOT \"$.addresses[*].country\" = \'\'us\'\'") == [2, 3]
+
+    def test_combined_with_regular_predicate(self, engine):
+        r = engine.execute(
+            "SELECT COUNT(*) FROM people WHERE id < 4 AND "
+            "JSON_MATCH(person, '\"$.addresses[*].country\" = ''us''')")
+        assert r["resultTable"]["rows"][0][0] == 2
+
+    def test_explain_names_operator(self, engine):
+        r = engine.execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM people WHERE "
+            "JSON_MATCH(person, '\"$.name\" = ''ann''')")
+        ops = " ".join(row[0] for row in r["resultTable"]["rows"])
+        assert "FILTER_JSON_INDEX" in ops or "FILTER_FULL_SCAN" in ops
+
+
+class TestJsonExtractScalar:
+    def test_extract_string_and_int(self, engine):
+        r = engine.execute(
+            "SELECT JSON_EXTRACT_SCALAR(person, '$.name', 'STRING'), "
+            "JSON_EXTRACT_SCALAR(person, '$.age', 'INT', -1) "
+            "FROM people ORDER BY id")
+        rows = r["resultTable"]["rows"]
+        assert rows == [["ann", 30], ["bob", 25], ["cat", 41],
+                        ["dan", -1], ["eve", 30]]
+
+    def test_extract_array_element(self, engine):
+        r = engine.execute(
+            "SELECT JSON_EXTRACT_SCALAR(person, '$.addresses[0].city', "
+            "'STRING', 'none') FROM people ORDER BY id")
+        assert [x[0] for x in r["resultTable"]["rows"]] == [
+            "nyc", "sf", "none", "none", "ber"]
+
+    def test_wildcard_path_rejected(self, engine):
+        # [*] in a scalar path must error, not silently read $.addresses.city
+        r = engine.execute(
+            "SELECT JSON_EXTRACT_SCALAR(person, '$.addresses[*].city', "
+            "'STRING', 'x') FROM people")
+        assert r.get("exceptions")
+
+    def test_group_by_extracted(self, engine):
+        r = engine.execute(
+            "SELECT JSON_EXTRACT_SCALAR(person, '$.age', 'INT', 0), COUNT(*) "
+            "FROM people GROUP BY JSON_EXTRACT_SCALAR(person, '$.age', 'INT', 0) "
+            "ORDER BY JSON_EXTRACT_SCALAR(person, '$.age', 'INT', 0)")
+        assert r["resultTable"]["rows"] == [[0, 1], [25, 1], [30, 2], [41, 1]]
+
+
+class TestJsonIndexConfigValidation:
+    def test_requires_string_column(self, tmp_path):
+        schema = Schema.build(name="t", dimensions=[("x", DataType.INT)])
+        cfg = TableConfig(table_name="t",
+                          indexing=IndexingConfig(json_index_columns=["x"]))
+        with pytest.raises(ValueError, match="json index"):
+            build_segment(schema, {"x": np.arange(3, dtype=np.int32)},
+                          str(tmp_path / "s"), cfg, "s0")

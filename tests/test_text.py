@@ -1,0 +1,125 @@
+"""Text index + TEXT_MATCH (Lucene analog).
+
+Reference analogs: LuceneTextIndexReader/Creator, TextMatchFilterOperator
+(pinot-core text_match tests) — terms, AND/OR, phrases, prefix wildcard.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.textindex import parse_text_query, tokenize_text
+
+REVIEWS = [
+    "Distributed query processing at scale",          # 0
+    "Query planning and optimization for OLAP",       # 1
+    "The quick brown fox jumps over the lazy dog",    # 2
+    "Real-time stream processing with exactly-once",  # 3
+    "Scale-out storage; query-processing pipelines",  # 4
+]
+
+
+@pytest.fixture(scope="module", params=[True, False], ids=["indexed", "scan"])
+def engine(request, tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("text")
+    schema = Schema.build(
+        name="docs",
+        dimensions=[("body", DataType.STRING), ("id", DataType.INT)],
+    )
+    cfg = TableConfig(
+        table_name="docs",
+        indexing=IndexingConfig(
+            text_index_columns=["body"] if request.param else []),
+    )
+    eng = QueryEngine(device_executor=None)
+    seg = build_segment(
+        schema,
+        {"body": np.asarray(REVIEWS, dtype=np.str_),
+         "id": np.arange(len(REVIEWS), dtype=np.int32)},
+        str(tmp / "seg"), cfg, "s0")
+    eng.add_segment("docs", seg)
+    return eng
+
+
+def ids(eng, query):
+    r = eng.execute(
+        f"SELECT id FROM docs WHERE TEXT_MATCH(body, '{query}') ORDER BY id")
+    assert not r.get("exceptions"), r
+    return [row[0] for row in r["resultTable"]["rows"]]
+
+
+class TestTokenize:
+    def test_lowercase_alnum(self):
+        assert tokenize_text("Real-time STREAM, processing!") == \
+            ["real", "time", "stream", "processing"]
+
+
+class TestParseQuery:
+    def test_precedence(self):
+        # AND binds tighter than OR
+        assert parse_text_query("a b AND c") == \
+            ("or", [("term", "a"), ("and", [("term", "b"), ("term", "c")])])
+
+    def test_phrase_and_prefix(self):
+        assert parse_text_query('"exactly once" AND stream*') == \
+            ("and", [("phrase", "exactly once"), ("prefix", "stream")])
+
+    def test_bad_query_raises(self):
+        with pytest.raises(ValueError):
+            parse_text_query("")
+
+
+class TestTextMatch:
+    def test_single_term(self, engine):
+        assert ids(engine, "query") == [0, 1, 4]
+
+    def test_case_insensitive(self, engine):
+        assert ids(engine, "QUERY") == [0, 1, 4]
+
+    def test_and(self, engine):
+        assert ids(engine, "query AND processing") == [0, 4]
+
+    def test_or_explicit_and_default(self, engine):
+        assert ids(engine, "fox OR olap") == [1, 2]
+        assert ids(engine, "fox olap") == [1, 2]  # Lucene default op
+
+    def test_phrase(self, engine):
+        assert ids(engine, '"query processing"') == [0, 4]
+        assert ids(engine, '"processing query"') == []
+
+    def test_prefix_wildcard(self, engine):
+        assert ids(engine, "optim*") == [1]
+        assert ids(engine, "pro*") == [0, 3, 4]
+
+    def test_grouping(self, engine):
+        assert ids(engine, "(fox OR olap) AND query") == [1]
+
+    def test_no_match(self, engine):
+        assert ids(engine, "zebra") == []
+
+    def test_lowercase_and_is_a_term(self, engine):
+        # operators are case-sensitive like Lucene: 'and' is a search term
+        assert ids(engine, "planning and") == [1]  # doc 1 has both words
+        assert parse_text_query("rock and roll") == \
+            ("or", [("term", "rock"), ("term", "and"), ("term", "roll")])
+
+    def test_explain_operator(self, engine):
+        r = engine.execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM docs "
+            "WHERE TEXT_MATCH(body, 'query')")
+        ops = " ".join(row[0] for row in r["resultTable"]["rows"])
+        assert "FILTER_TEXT_INDEX" in ops or "FILTER_FULL_SCAN" in ops
+
+
+class TestTextIndexValidation:
+    def test_requires_string_column(self, tmp_path):
+        schema = Schema.build(name="t", dimensions=[("x", DataType.INT)])
+        cfg = TableConfig(table_name="t",
+                          indexing=IndexingConfig(text_index_columns=["x"]))
+        with pytest.raises(ValueError, match="text index"):
+            build_segment(schema, {"x": np.arange(3, dtype=np.int32)},
+                          str(tmp_path / "s"), cfg, "s0")
