@@ -78,6 +78,9 @@ class TableConfig:
     table_type: str = TableType.OFFLINE
     schema_name: Optional[str] = None
     replication: int = 1
+    # dimension table (isDimTable analog): small lookup table replicated to
+    # every server so LOOKUP() resolves locally during fact-table execution
+    is_dim_table: bool = False
     time_column: Optional[str] = None
     retention_days: Optional[int] = None
     indexing: IndexingConfig = dataclasses.field(default_factory=IndexingConfig)

@@ -235,9 +235,15 @@ class Controller:
             end_time=meta.end_time, crc=meta.crc,
             **_partition_record_fields(meta),
         )
-        instances = self.assigner.assign(cfg.replication)
+        instances = self.assigner.assign(self._table_replication(cfg))
         self.registry.add_segment(record, instances)
         return record
+
+    @staticmethod
+    def _table_replication(cfg: TableConfig) -> int:
+        # dim tables replicate everywhere (DimensionTableDataManager model);
+        # assign() caps at the live-server count
+        return 1_000_000 if cfg.is_dim_table else cfg.replication
 
     def delete_segment(self, table: str, name: str) -> None:
         table = self.resolve(table)
@@ -251,7 +257,7 @@ class Controller:
         cfg = self.registry.table_config(table)
         if cfg is None:
             raise KeyError(f"table {table!r} not found")
-        return self.assigner.rebalance(table, cfg.replication)
+        return self.assigner.rebalance(table, self._table_replication(cfg))
 
     # ---- minion task generation (PinotTaskManager analog) ----------------
     def run_task_generation(self, now_ms: Optional[int] = None) -> list:
@@ -303,6 +309,7 @@ class Controller:
         def loop():
             while not self._periodic_stop.wait(interval_s):
                 for step in (self.run_retention, self.run_realtime_repair,
+                             self.run_dim_table_replication,
                              self.run_task_generation, self.run_task_repair):
                     try:
                         step()
@@ -319,6 +326,23 @@ class Controller:
             self._periodic_stop.set()
             self._periodic_thread.join(5)
             self._periodic_thread = None
+
+    def run_dim_table_replication(self) -> list:
+        """Keep dimension tables replicated to EVERY live server as
+        membership changes (the reference re-assigns dim tables on server
+        join; without this, LOOKUP fails on fact segments placed on a
+        server that joined after the dim upload)."""
+        live = {i.instance_id for i in self.assigner._live_servers()}
+        fixed = []
+        for table in self.registry.tables():
+            cfg = self.registry.table_config(table)
+            if cfg is None or not cfg.is_dim_table:
+                continue
+            assignment = self.registry.assignment(table)
+            if any(set(insts) != live for insts in assignment.values()):
+                self.assigner.rebalance(table, self._table_replication(cfg))
+                fixed.append(table)
+        return fixed
 
     # ---- periodic maintenance (RetentionManager analog) ------------------
     def run_retention(self, now_ms: Optional[int] = None) -> list:

@@ -104,12 +104,17 @@ class TableDataManager:
         self._lock = threading.Lock()
         self.on_unload = None  # callback(segment) after last ref drops
         self.host_name = host_name  # stamps $hostName on hosted segments
+        self.generation = 0  # bumped on add/remove; dim-lookup cache key
+        # None = unknown (embedded engines allow LOOKUP on any local table);
+        # the server layer sets True/False from the registry's TableConfig
+        self.is_dim_table = None
 
     def add_segment(self, seg: ImmutableSegment) -> None:
         if self.host_name is not None and getattr(seg, "host_name", None) is None:
             seg.host_name = self.host_name
         with self._lock:
             self.segments[seg.name] = seg
+            self.generation += 1
             self._doomed.pop(seg.name, None)  # re-add wins over unload
 
     def remove_segment(self, name: str) -> None:
@@ -117,6 +122,7 @@ class TableDataManager:
             seg = self.segments.pop(name, None)
             if seg is None:
                 return
+            self.generation += 1
             if self._refs.get(name, 0) > 0:
                 self._doomed[name] = seg  # teardown deferred to release()
                 return
@@ -167,6 +173,8 @@ class QueryEngine:
 
             device_executor = DeviceExecutor()
         self.device = device_executor  # None → host-only
+        self._dim_cache: dict = {}  # (table, pk, val) -> (generation, map)
+        self.host.lookup_resolver = self.dim_table_lookup
 
     # ---- table management -----------------------------------------------
     def table(self, name: str) -> TableDataManager:
@@ -299,6 +307,48 @@ class QueryEngine:
             if id(s) not in executed_ids:
                 merged.stats.total_docs += s.n_docs
         return merged
+
+    # ---- dimension-table lookup (DimensionTableDataManager analog) -------
+    def dim_table_lookup(self, dim_table: str, value_col: str, pk_col: str):
+        """(pk value → value_col value, miss default) over all hosted
+        segments of the dimension table; cached until the table's segment
+        set changes (LookupTransformFunction resolves against this map).
+        The miss default comes from the value column's TYPE, not a sample
+        row, so empty dim tables keep numeric semantics."""
+        tdm = self.tables.get(dim_table) or self.tables.get(f"{dim_table}_OFFLINE")
+        if tdm is None:
+            raise KeyError(f"dimension table {dim_table!r} not hosted here")
+        if getattr(tdm, "is_dim_table", None) is False:
+            # cluster mode: a regular table's segments are spread across
+            # servers, so a local pk map would be silently incomplete — the
+            # reference's LookupTransformFunction rejects these the same way
+            raise ValueError(f"LOOKUP target {dim_table!r} is not a "
+                             f"dimension table (is_dim_table=false)")
+        key = (tdm.name, pk_col, value_col)
+        cached = self._dim_cache.get(key)
+        if cached is not None and cached[0] == tdm.generation:
+            return cached[1], cached[2]
+        import numpy as np
+
+        gen = tdm.generation
+        mapping: dict = {}
+        default = ""
+        segs = tdm.acquire()
+        try:
+            if not segs:
+                raise KeyError(f"dimension table {dim_table!r} has no "
+                               f"segments loaded here")
+            dt = segs[0].column_metadata(value_col).data_type
+            default = "" if dt.is_string_like else dt.np_dtype.type(0).item()
+            for seg in segs:
+                pks = np.asarray(seg.values(pk_col))
+                vals = np.asarray(seg.values(value_col))
+                for k, v in zip(pks.tolist(), vals.tolist()):
+                    mapping[k] = v
+        finally:
+            tdm.release(segs)
+        self._dim_cache[key] = (gen, mapping, default)
+        return mapping, default
 
     # ---- helpers ---------------------------------------------------------
     @staticmethod

@@ -64,8 +64,12 @@ def filter_operator_for(seg, p: Predicate) -> str:
     if p.type is PredicateType.TEXT_MATCH:
         return "TEXT_INDEX" if getattr(meta, "has_text_index", False) \
             else "FULL_SCAN"
-    if meta.encoding != Encoding.DICT or not meta.single_value or \
-            p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+    if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+        return "FULL_SCAN"
+    if meta.encoding != Encoding.DICT or not meta.single_value:
+        if meta.encoding == Encoding.RAW and meta.single_value and \
+                meta.has_range and p.type in (PredicateType.EQ, PredicateType.RANGE):
+            return "RANGE_INDEX"
         return "FULL_SCAN"
     if meta.is_sorted and p.type in (
         PredicateType.EQ, PredicateType.IN, PredicateType.RANGE
@@ -81,12 +85,13 @@ def filter_operator_for(seg, p: Predicate) -> str:
 class SegmentEvaluator:
     """Evaluates expressions / filters over one segment in value space."""
 
-    def __init__(self, segment: ImmutableSegment):
+    def __init__(self, segment: ImmutableSegment, lookup_resolver=None):
         self.seg = segment
         # snapshot the doc count ONCE: mutable (consuming) segments grow
         # concurrently under a single-writer/multi-reader contract
         # (MutableSegmentImpl volatile counter analog)
         self.n = segment.n_docs
+        self.lookup_resolver = lookup_resolver
         self._cache: dict = {}
         # entries actually read while filtering: index-served predicates add
         # 0, scans add n (reference: numEntriesScannedInFilter is 0 when the
@@ -122,12 +127,38 @@ class SegmentEvaluator:
             if expr.name.startswith("$"):
                 return self._virtual_column(expr.name)
             return np.asarray(self.seg.values(expr.name))[: self.n]
+        if expr.name == "lookup":
+            return self._lookup(expr)
         fn = get_function(expr.name)
         if expr.name == "cast":
             arg = self._eval_all(expr.args[0])
             return fn.np_fn(arg, expr.args[1].value)
         args = [self._eval_all(a) for a in expr.args]
         return fn.np_fn(*args)
+
+    def _lookup(self, expr: Expression) -> np.ndarray:
+        """LOOKUP('dimTable', 'valueCol', 'pkCol', keyExpr) — per-row join
+        against a replicated dimension table (LookupTransformFunction
+        analog; misses yield the value column's type default)."""
+        if len(expr.args) != 4:
+            raise ValueError(
+                "LOOKUP takes (dimTable, valueColumn, pkColumn, keyExpr)")
+        if self.lookup_resolver is None:
+            raise ValueError("LOOKUP needs an engine with dimension tables")
+        names = []
+        for a in expr.args[:3]:
+            if not (a.is_literal and isinstance(a.value, str)):
+                raise ValueError("LOOKUP's first three args are string literals")
+            names.append(a.value)
+        dim_table, value_col, pk_col = names
+        mapping, default = self.lookup_resolver(dim_table, value_col, pk_col)
+        keys = np.asarray(self.eval(expr.args[3]))
+        if keys.ndim == 0:
+            # literal key: scalar result, broadcast downstream like other
+            # literal expressions
+            return np.asarray(mapping.get(keys.item(), default))
+        out = [mapping.get(k, default) for k in keys.tolist()]
+        return np.asarray(out)
 
     def _virtual_column(self, name: str) -> np.ndarray:
         """Built-in virtual columns (segment/virtualcolumn/ analog:
@@ -246,6 +277,11 @@ class SegmentEvaluator:
                 self.entries_scanned_in_filter += self.n
                 fwd = np.asarray(self.seg.forward(lhs.name))[: self.n]
                 return lut[fwd]
+        if lhs.is_identifier and lhs.name in self.seg.metadata.columns \
+                and filter_operator_for(self.seg, p) == "RANGE_INDEX":
+            m = self._range_index_mask(lhs.name, p)
+            if m is not None:
+                return m
         if p.type in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
             # null-vector semantics (NullValueVectorReader): the forward
             # index stores default values for nulls; nullness lives in the
@@ -335,6 +371,29 @@ class SegmentEvaluator:
             return mask
         return None
 
+    def _range_index_mask(self, col: str, p: Predicate):
+        """RAW-column range/EQ via the sorted-projection range index: two
+        binary searches on the sorted values, then a doc-id slice — or None
+        when the segment lacks the index files (caller scans)."""
+        idx = self.seg.range_index(col) if hasattr(self.seg, "range_index") \
+            else None
+        if idx is None:
+            return None
+        docs, vals = idx
+        if p.type is PredicateType.EQ:
+            lo = np.searchsorted(vals, p.value, "left")
+            hi = np.searchsorted(vals, p.value, "right")
+        else:
+            lo = 0 if p.lower is None else np.searchsorted(
+                vals, p.lower, "left" if p.lower_inclusive else "right")
+            hi = len(vals) if p.upper is None else np.searchsorted(
+                vals, p.upper, "right" if p.upper_inclusive else "left")
+        mask = np.zeros(self.n, dtype=bool)
+        if hi > lo:
+            sel = np.asarray(docs[lo:hi])
+            mask[sel[sel < self.n]] = True
+        return mask
+
     def _predicate_over_values(self, p: Predicate, v: np.ndarray) -> np.ndarray:
         t = p.type
         if t is PredicateType.EQ:
@@ -422,9 +481,10 @@ class HostExecutor:
 
     def __init__(self, num_groups_limit: int = DEFAULT_NUM_GROUPS_LIMIT):
         self.num_groups_limit = num_groups_limit
+        self.lookup_resolver = None  # set by QueryEngine (dim tables)
 
     def execute_segment(self, q: QueryContext, seg: ImmutableSegment) -> IntermediateResult:
-        ev = SegmentEvaluator(seg)
+        ev = SegmentEvaluator(seg, lookup_resolver=self.lookup_resolver)
         stats = ExecutionStats(
             num_segments_processed=1, num_segments_queried=1, total_docs=ev.n
         )

@@ -287,6 +287,16 @@ _reg("json_extract_scalar", _json_extract_scalar, min_args=3, max_args=4)
 _reg("jsonextractscalar", _json_extract_scalar, min_args=3, max_args=4)
 
 
+# ---- lookup join (host-only; evaluated by SegmentEvaluator._lookup with
+# engine dim-table state — the np_fn here is never called directly) ---------
+
+def _lookup_stub(*a):
+    raise ValueError("LOOKUP needs an engine with dimension tables")
+
+
+_reg("lookup", _lookup_stub, min_args=4, max_args=4)
+
+
 # ---- datetime (host-only) -------------------------------------------------
 
 _reg("year", lambda a: _dtfield(a, "year"))

@@ -342,6 +342,10 @@ class ServerInstance:
         for table, names in assigned.items():
             records = self.registry.segments(table)
             tdm = self.engine.table(table)
+            if tdm.is_dim_table is None:
+                cfg = self.registry.table_config(table)
+                if cfg is not None:
+                    tdm.is_dim_table = cfg.is_dim_table
             if tdm.on_unload is None:
                 tdm.on_unload = (
                     lambda seg, _tdm=tdm: self._on_segment_unload(_tdm, seg))

@@ -253,10 +253,17 @@ class SegmentCreator:
             has_text_index = True
 
         # Range acceleration: DICT columns get it for free — the sorted
-        # dictionary maps a value range to a dict-id interval. RAW columns
-        # fall back to scan until the bit-sliced range index lands, so the
-        # flag is only advertised where a reader can actually serve it.
+        # dictionary maps a value range to a dict-id interval. RAW SV
+        # columns get a sorted-projection range index (RangeIndexCreator /
+        # BitSlicedRangeIndexReader analog): doc ids in value order plus the
+        # sorted values, so a range is two binary searches + a doc-id slice.
         has_range = name in idx_cfg.range_index_columns and encoding == Encoding.DICT
+        if name in idx_cfg.range_index_columns and encoding == Encoding.RAW \
+                and spec.single_value:
+            order = np.argsort(raw, kind="stable").astype(np.int32)
+            np.save(p(f"{name}.range.docs.npy"), order, allow_pickle=False)
+            np.save(p(f"{name}.range.vals.npy"), raw[order], allow_pickle=False)
+            has_range = True
 
         part_fn = part_n = parts = None
         pmap = self.table_config.partition.column_partition_map

@@ -224,6 +224,20 @@ class ImmutableSegment:
             return None
         return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
 
+    def range_index(self, col: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """(doc_ids_in_value_order, sorted_values) for a RAW range-indexed
+        column (RangeIndexReaderImpl analog), or None."""
+        meta = self.column_metadata(col)
+        if not meta.has_range or meta.encoding == Encoding.DICT:
+            return None
+        docs_path = self._path(f"{col}.range.docs.npy")
+        if not os.path.isfile(docs_path):
+            return None
+        docs = np.load(docs_path, mmap_mode="r", allow_pickle=False)
+        vals = np.load(self._path(f"{col}.range.vals.npy"), mmap_mode="r",
+                       allow_pickle=False)
+        return docs, vals
+
     def json_index(self, col: str):
         """JSON index reader (ImmutableJsonIndexReader analog), or None."""
         if col not in self._json_cache:

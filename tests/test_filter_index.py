@@ -117,6 +117,73 @@ class TestIndexEqualsScan:
         assert r["numEntriesScannedInFilter"] == s_unsorted.n_docs
 
 
+class TestRawRangeIndex:
+    """Sorted-projection range index on RAW (no-dictionary) columns
+    (RangeIndexCreator / BitSlicedRangeIndexReader analog)."""
+
+    @pytest.fixture(scope="class")
+    def rseg(self, tmp_path_factory):
+        base = tmp_path_factory.mktemp("ridx")
+        schema = Schema.build(
+            name="r",
+            dimensions=[("k", DataType.INT)],
+            metrics=[("price", DataType.DOUBLE), ("qty", DataType.INT)],
+        )
+        cfg = TableConfig(
+            table_name="r",
+            indexing=IndexingConfig(range_index_columns=["price"],
+                                    no_dictionary_columns=["price"]),
+        )
+        rng = np.random.default_rng(3)
+        cols = {
+            "k": rng.integers(0, 50, 10_000).astype(np.int32),
+            "price": np.round(rng.uniform(0, 1000, 10_000), 2),
+            "qty": rng.integers(0, 9, 10_000).astype(np.int32),
+        }
+        build_segment(schema, cols, str(base / "seg"), cfg, "seg")
+        return ImmutableSegment(str(base / "seg")), cols
+
+    @staticmethod
+    def _rengine(seg):
+        eng = QueryEngine(device_executor=None)
+        eng.add_segment("r", seg)
+        return eng
+
+    def test_operator_choice_and_files(self, rseg):
+        seg, _ = rseg
+        assert seg.column_metadata("price").has_range
+        assert seg.range_index("price") is not None
+        from pinot_tpu.query.context import Expression, Predicate, PredicateType
+
+        p = Predicate(PredicateType.RANGE, Expression.identifier("price"),
+                      lower=10.0, upper=20.0, lower_inclusive=True,
+                      upper_inclusive=True)
+        assert filter_operator_for(seg, p) == "RANGE_INDEX"
+
+    @pytest.mark.parametrize("where,mask_fn", [
+        ("price > 900", lambda c: c["price"] > 900),
+        ("price BETWEEN 100 AND 101.5",
+         lambda c: (c["price"] >= 100) & (c["price"] <= 101.5)),
+        ("price <= 0.5", lambda c: c["price"] <= 0.5),
+        ("price = 500.0", lambda c: c["price"] == 500.0),
+        ("price >= 999 AND qty > 3",
+         lambda c: (c["price"] >= 999) & (c["qty"] > 3)),
+    ])
+    def test_matches_scan(self, rseg, where, mask_fn):
+        seg, cols = rseg
+        r = self._rengine(seg).execute(f"SELECT COUNT(*), SUM(qty) FROM r WHERE {where}")
+        assert not r.get("exceptions"), r
+        mask = mask_fn(cols)
+        assert r["resultTable"]["rows"][0][0] == int(mask.sum()), where
+        if mask.any():
+            assert r["resultTable"]["rows"][0][1] == int(cols["qty"][mask].sum())
+
+    def test_zero_entries_scanned(self, rseg):
+        seg, _ = rseg
+        r = self._rengine(seg).execute("SELECT COUNT(*) FROM r WHERE price > 990")
+        assert r["numEntriesScannedInFilter"] == 0
+
+
 def _numpy_mask(cols, where):
     k, v = cols["k"], cols["v"]
     masks = {

@@ -236,8 +236,11 @@ class TestAdminCli:
                          "--spec", str(spec_path),
                          "--deep-store", str(tmp_path / "ds")]) == 0
             reg = FileRegistry(reg_path)
+            # FileRegistry polling + server sync can be slow under a loaded
+            # full-suite run: give the view extra headroom
             assert wait_until(
-                lambda: len(reg.external_view("towns_OFFLINE")) == 1)
+                lambda: len(reg.external_view("towns_OFFLINE")) == 1,
+                timeout=40)
             rc = main(["query", "--registry", reg_path,
                        "--sql", "SELECT SUM(pop) FROM towns"])
             out = capsys.readouterr().out

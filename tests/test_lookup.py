@@ -1,0 +1,216 @@
+"""Dimension tables + LOOKUP transform.
+
+Reference analogs: DimensionTableDataManager (in-memory pk->row map on
+every server), LookupTransformFunction, isDimTable replication.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+
+def _dim_schema():
+    return Schema.build(
+        name="teams",
+        dimensions=[("teamID", DataType.STRING), ("teamName", DataType.STRING),
+                    ("founded", DataType.INT)],
+        primary_key_columns=["teamID"],
+    )
+
+
+def _fact_schema():
+    return Schema.build(
+        name="games",
+        dimensions=[("team", DataType.STRING)],
+        metrics=[("score", DataType.INT)],
+    )
+
+
+DIM = {
+    "teamID": np.asarray(["t1", "t2", "t3"], dtype=np.str_),
+    "teamName": np.asarray(["Tigers", "Bears", "Hawks"], dtype=np.str_),
+    "founded": np.asarray([1901, 1950, 1988], dtype=np.int32),
+}
+FACT = {
+    "team": np.asarray(["t1", "t2", "t1", "t9"], dtype=np.str_),
+    "score": np.asarray([3, 5, 7, 2], dtype=np.int32),
+}
+
+
+class TestEmbeddedLookup:
+    @pytest.fixture()
+    def engine(self, tmp_path):
+        eng = QueryEngine(device_executor=None)
+        dim = build_segment(_dim_schema(), DIM, str(tmp_path / "dim"),
+                            TableConfig(table_name="teams", is_dim_table=True), "d0")
+        fact = build_segment(_fact_schema(), FACT, str(tmp_path / "fact"),
+                             TableConfig(table_name="games"), "f0")
+        eng.add_segment("teams", dim)
+        eng.add_segment("games", fact)
+        return eng
+
+    def test_lookup_select(self, engine):
+        r = engine.execute(
+            "SELECT team, LOOKUP('teams', 'teamName', 'teamID', team), score "
+            "FROM games ORDER BY score")
+        assert r["resultTable"]["rows"] == [
+            ["t9", "", 2], ["t1", "Tigers", 3], ["t2", "Bears", 5],
+            ["t1", "Tigers", 7]]
+
+    def test_lookup_group_by(self, engine):
+        r = engine.execute(
+            "SELECT LOOKUP('teams', 'teamName', 'teamID', team), SUM(score) "
+            "FROM games WHERE team <> 't9' "
+            "GROUP BY LOOKUP('teams', 'teamName', 'teamID', team) "
+            "ORDER BY LOOKUP('teams', 'teamName', 'teamID', team)")
+        assert r["resultTable"]["rows"] == [["Bears", 5], ["Tigers", 10]]
+
+    def test_lookup_numeric_value_and_filter(self, engine):
+        # misses yield the value column's type default (0), matching the
+        # framework-wide defaults-flow-through null convention — so the t9
+        # row (default 0 < 1950) matches alongside the two t1 rows
+        r = engine.execute(
+            "SELECT COUNT(*) FROM games "
+            "WHERE LOOKUP('teams', 'founded', 'teamID', team) < 1950")
+        assert r["resultTable"]["rows"][0][0] == 3
+        r = engine.execute(
+            "SELECT COUNT(*) FROM games WHERE "
+            "LOOKUP('teams', 'founded', 'teamID', team) < 1950 AND team <> 't9'")
+        assert r["resultTable"]["rows"][0][0] == 2
+
+    def test_cache_invalidated_on_new_segment(self, engine, tmp_path):
+        assert engine.execute(
+            "SELECT LOOKUP('teams', 'teamName', 'teamID', team) FROM games "
+            "WHERE team = 't9'")["resultTable"]["rows"] == [[""]]
+        extra = build_segment(
+            _dim_schema(),
+            {"teamID": np.asarray(["t9"], dtype=np.str_),
+             "teamName": np.asarray(["Lions"], dtype=np.str_),
+             "founded": np.asarray([2020], dtype=np.int32)},
+            str(tmp_path / "dim2"),
+            TableConfig(table_name="teams", is_dim_table=True), "d1")
+        engine.add_segment("teams", extra)
+        assert engine.execute(
+            "SELECT LOOKUP('teams', 'teamName', 'teamID', team) FROM games "
+            "WHERE team = 't9'")["resultTable"]["rows"] == [["Lions"]]
+
+    def test_missing_dim_table_errors(self, engine):
+        r = engine.execute(
+            "SELECT LOOKUP('nope', 'a', 'b', team) FROM games")
+        assert r["exceptions"]
+
+    def test_literal_key(self, engine):
+        # scalar keys broadcast, not iterate character-wise (r3 review)
+        r = engine.execute(
+            "SELECT LOOKUP('teams', 'teamName', 'teamID', 't1'), score "
+            "FROM games ORDER BY score LIMIT 2")
+        assert r["resultTable"]["rows"] == [["Tigers", 2], ["Tigers", 3]]
+
+    def test_empty_dim_table_numeric_default(self, tmp_path):
+        # empty dim table keeps the value column's numeric type default
+        # instead of '' (r3 review)
+        eng = QueryEngine(device_executor=None)
+        empty = build_segment(
+            _dim_schema(),
+            {"teamID": np.asarray([], dtype=np.str_),
+             "teamName": np.asarray([], dtype=np.str_),
+             "founded": np.asarray([], dtype=np.int32)},
+            str(tmp_path / "dim"),
+            TableConfig(table_name="teams", is_dim_table=True), "d0")
+        fact = build_segment(_fact_schema(), FACT, str(tmp_path / "fact"),
+                             TableConfig(table_name="games"), "f0")
+        eng.add_segment("teams", empty)
+        eng.add_segment("games", fact)
+        r = eng.execute(
+            "SELECT SUM(LOOKUP('teams', 'founded', 'teamID', team)) FROM games")
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"][0][0] == 0
+
+    def test_non_dim_table_rejected_when_flagged(self, engine):
+        engine.tables["teams"].is_dim_table = False
+        try:
+            r = engine.execute(
+                "SELECT LOOKUP('teams', 'teamName', 'teamID', team) FROM games")
+            assert r["exceptions"]
+            assert "not a dimension table" in r["exceptions"][0]["message"]
+        finally:
+            engine.tables["teams"].is_dim_table = None
+
+
+def wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestClusterDimTable:
+    def test_dim_table_replicates_to_all_servers(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        servers = [
+            ServerInstance(f"server_{i}", registry, str(tmp_path / f"s{i}"),
+                           device_executor=None)
+            for i in range(2)
+        ]
+        for s in servers:
+            s.start()
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            dim_cfg = TableConfig(table_name="teams", is_dim_table=True)
+            controller.add_table(dim_cfg, _dim_schema())
+            build_segment(_dim_schema(), DIM, str(tmp_path / "dup"), dim_cfg, "d0")
+            controller.upload_segment("teams", str(tmp_path / "dup"))
+
+            fact_cfg = TableConfig(table_name="games")
+            controller.add_table(fact_cfg, _fact_schema())
+            build_segment(_fact_schema(), FACT, str(tmp_path / "fup"),
+                          fact_cfg, "f0")
+            controller.upload_segment("games", str(tmp_path / "fup"))
+
+            # dim segment assigned to BOTH servers despite replication=1
+            assert wait_until(
+                lambda: len(registry.assignment("teams_OFFLINE").get("d0", [])) == 2)
+            assert wait_until(
+                lambda: len(registry.external_view("games_OFFLINE")) == 1)
+            assert wait_until(lambda: all(
+                "teams_OFFLINE" in s.engine.tables
+                and s.engine.tables["teams_OFFLINE"].segments
+                for s in servers))
+
+            r = broker.execute(
+                "SELECT LOOKUP('teams', 'teamName', 'teamID', team), SUM(score) "
+                "FROM games GROUP BY LOOKUP('teams', 'teamName', 'teamID', team) "
+                "ORDER BY SUM(score) DESC")
+            assert not r.get("exceptions"), r
+            assert r["resultTable"]["rows"][0] == ["Tigers", 10]
+
+            # a server joining AFTER the dim upload gets the dim segments
+            # via the controller's periodic replication repair (r3 review)
+            late = ServerInstance("server_late", registry,
+                                  str(tmp_path / "slate"), device_executor=None)
+            late.start()
+            servers.append(late)
+            assert controller.run_dim_table_replication() == ["teams_OFFLINE"]
+            assert wait_until(
+                lambda: len(registry.assignment("teams_OFFLINE").get("d0", [])) == 3)
+            assert wait_until(
+                lambda: "teams_OFFLINE" in late.engine.tables
+                and late.engine.tables["teams_OFFLINE"].segments)
+        finally:
+            broker.close()
+            for s in servers:
+                s.stop()

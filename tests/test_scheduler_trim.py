@@ -237,6 +237,11 @@ class TestSegmentRefcounts:
             pattern = os.path.join(str(tmp_path / "s0"), "segments",
                                    "sales_OFFLINE", "seg0*")
             assert wait_until(lambda: glob.glob(pattern), timeout=30)
+            # the local copy lands before the external-view publish at the
+            # end of the same sync tick: wait for routability too
+            assert wait_until(
+                lambda: len(registry.external_view("sales_OFFLINE")) == 1,
+                timeout=30)
             r = broker.execute("SELECT SUM(v) FROM sales")
             assert r["resultTable"]["rows"] == [[3]]
             # delete: registry entry goes, server unloads, local copy removed
