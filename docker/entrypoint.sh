@@ -1,0 +1,35 @@
+#!/bin/sh
+# Role dispatcher (pinot-admin.sh Start<Role>Command analog).
+set -e
+
+ADMIN="python -m pinot_tpu.tools.admin"
+ID_FLAG=""
+[ -n "$PINOT_ID" ] && ID_FLAG="--id $PINOT_ID"
+# advertised/bind host: the container hostname resolves to the container
+# IP for peers (compose service name / pod DNS); brokers bind 0.0.0.0 so
+# published ports work from outside
+HOST="${PINOT_HOST:-$(hostname)}"
+# per-instance data dirs on the shared volume: two servers must never
+# share a segment directory
+DATA_DIR="$PINOT_DATA_DIR/${PINOT_ID:-default}"
+
+case "$ROLE" in
+  controller)
+    exec $ADMIN start-controller --registry "$PINOT_REGISTRY" \
+        --deep-store "$PINOT_DEEP_STORE" $ID_FLAG "$@" ;;
+  server)
+    exec $ADMIN start-server --registry "$PINOT_REGISTRY" \
+        --data-dir "$DATA_DIR" --host "$HOST" $ID_FLAG "$@" ;;
+  broker)
+    exec $ADMIN start-broker --registry "$PINOT_REGISTRY" \
+        --host "${PINOT_HOST:-0.0.0.0}" $ID_FLAG "$@" ;;
+  minion)
+    exec $ADMIN start-minion --registry "$PINOT_REGISTRY" \
+        --deep-store "$PINOT_DEEP_STORE" \
+        --work-dir "/var/pinot/minionwork/${PINOT_ID:-default}" $ID_FLAG "$@" ;;
+  quickstart)
+    exec $ADMIN quickstart "$@" ;;
+  *)
+    echo "unknown ROLE '$ROLE' (controller|server|broker|minion|quickstart)" >&2
+    exit 2 ;;
+esac

@@ -43,6 +43,10 @@ class IndexingConfig:
     # analog, native codec in pinot_tpu/native): 4-32x smaller on disk,
     # decoded to int32 at load time instead of mmap'd
     enable_bit_packing: bool = False
+    # chunk-compress RAW (no-dictionary) SV forward indexes with zlib
+    # (io/compression analog: per-chunk LZ4/Snappy/zstd in the reference);
+    # decoded by the native codec at load time
+    compressed_columns: list[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass

@@ -55,3 +55,42 @@ void unpack_bits(const uint8_t* in, int64_t n, int bits, int32_t* out) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Chunked zlib decompression for compressed raw forward indexes — the
+// reference's chunk-decompressor role (segment/local/io/compression/,
+// e.g. ZstandardCompressor/LZ4Compressor behind VarByteChunkSVForwardIndex).
+// zlib keeps the format readable by the pure-Python fallback (stdlib zlib).
+//
+// Compiled out with -DPINOT_NO_ZLIB on hosts without zlib dev headers, so
+// the bit-packing codec keeps its native path there; Python's stdlib zlib
+// serves decompression instead (same bytes, slower).
+// ---------------------------------------------------------------------------
+
+#ifndef PINOT_NO_ZLIB
+#include <zlib.h>
+
+extern "C" {
+
+// src: concatenated compressed chunks; offsets[n_chunks+1]: byte offsets of
+// each chunk in src; dst_offsets[n_chunks+1]: uncompressed byte offsets.
+// Returns 0 on success, the zlib error code of the first failing chunk
+// otherwise.
+int inflate_chunks(const uint8_t* src, const int64_t* offsets,
+                   int64_t n_chunks, uint8_t* dst,
+                   const int64_t* dst_offsets) {
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        uLongf dst_len = static_cast<uLongf>(dst_offsets[c + 1] - dst_offsets[c]);
+        const uLong src_len = static_cast<uLong>(offsets[c + 1] - offsets[c]);
+        int rc = uncompress(dst + dst_offsets[c], &dst_len,
+                            src + offsets[c], src_len);
+        if (rc != Z_OK ||
+            dst_len != static_cast<uLongf>(dst_offsets[c + 1] - dst_offsets[c])) {
+            return rc != Z_OK ? rc : Z_DATA_ERROR;
+        }
+    }
+    return 0;
+}
+
+}  // extern "C"
+#endif  // PINOT_NO_ZLIB

@@ -189,12 +189,14 @@ class SegmentCreator:
             if packed_bits is None:
                 np.save(p(f"{name}.fwd.npy"), ids, allow_pickle=False)
             # a rebuild into the same dir with packing toggled must not
-            # leave the other format behind (stale file skews the CRC and
-            # rides every download)
-            stale = p(f"{name}.fwd.npy") if packed_bits is not None \
-                else p(f"{name}.fwdpacked.bin")
-            if os.path.exists(stale):
-                os.unlink(stale)
+            # leave another format behind (stale files skew the CRC and
+            # ride every download)
+            stale = [p(f"{name}.fwdz.bin"), p(f"{name}.fwdz.off.npy")]
+            stale.append(p(f"{name}.fwd.npy") if packed_bits is not None
+                         else p(f"{name}.fwdpacked.bin"))
+            for path in stale:
+                if os.path.exists(path):
+                    os.unlink(path)
             dictionary.save(p(f"{name}.dict.npy"))
             cardinality = dictionary.cardinality
             if cardinality:
@@ -202,14 +204,30 @@ class SegmentCreator:
             else:
                 minv = maxv = None
             encoding = Encoding.DICT
+            compression = None
             fwd_for_inv = ids
             dict_values = dictionary.values
         else:
             dict_values = None
             packed_bits = None
-            np.save(p(f"{name}.fwd.npy"), raw, allow_pickle=False)
-            if os.path.exists(p(f"{name}.fwdpacked.bin")):
-                os.unlink(p(f"{name}.fwdpacked.bin"))  # stale from a rebuild
+            if name in idx_cfg.compressed_columns and spec.single_value:
+                from pinot_tpu import native
+
+                blob, offs = native.compress_chunks(raw)
+                blob.tofile(p(f"{name}.fwdz.bin"))
+                np.save(p(f"{name}.fwdz.off.npy"), offs, allow_pickle=False)
+                compression = "zlib"
+            else:
+                np.save(p(f"{name}.fwd.npy"), raw, allow_pickle=False)
+                compression = None
+            # rebuilds with a different encoding config must not leave the
+            # other format behind (stale files skew the CRC)
+            stale = [p(f"{name}.fwdpacked.bin")]
+            stale += [p(f"{name}.fwd.npy")] if compression else \
+                [p(f"{name}.fwdz.bin"), p(f"{name}.fwdz.off.npy")]
+            for path in stale:
+                if os.path.exists(path):
+                    os.unlink(path)
             cardinality = int(len(np.unique(raw)))
             minv, maxv = (raw.min(), raw.max()) if len(raw) else (None, None)
             encoding = Encoding.RAW
@@ -290,6 +308,7 @@ class SegmentCreator:
             has_json_index=has_json_index,
             has_text_index=has_text_index,
             packed_bits=packed_bits,
+            compression=compression,
             total_number_of_entries=int(total_entries),
             partition_function=part_fn,
             num_partitions=part_n,

@@ -70,6 +70,7 @@ class ColumnMetadata:
     has_text_index: bool = False
     has_null_vector: bool = False
     packed_bits: Optional[int] = None  # bit-packed fwd index width, else None
+    compression: Optional[str] = None  # raw fwd chunk codec ("zlib"), else None
     total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
     partition_function: Optional[str] = None
     num_partitions: Optional[int] = None
@@ -183,7 +184,19 @@ class ImmutableSegment:
         array; plain columns stay mmap'd."""
         if col not in self._fwd_cache:
             meta = self.column_metadata(col)
-            if meta.packed_bits is not None:
+            if meta.compression is not None:
+                from pinot_tpu import native
+
+                blob = np.fromfile(self._path(f"{col}.fwdz.bin"),
+                                   dtype=np.uint8)
+                offs = np.load(self._path(f"{col}.fwdz.off.npy"),
+                               allow_pickle=False)
+                n = (self.n_docs if meta.single_value
+                     else meta.total_number_of_entries)
+                dtype = np.dtype(meta.data_type.np_dtype)
+                raw = native.decompress_chunks(blob, offs, n * dtype.itemsize)
+                self._fwd_cache[col] = raw.view(dtype)
+            elif meta.packed_bits is not None:
                 from pinot_tpu import native
 
                 buf = np.fromfile(self._path(f"{col}.fwdpacked.bin"),

@@ -69,7 +69,7 @@ def cmd_start_server(args) -> int:
     from pinot_tpu.server.server import ServerInstance
 
     server = ServerInstance(args.id, _registry(args.registry), args.data_dir,
-                            port=args.port)
+                            host=args.host, port=args.port)
     server.start()
     print(f"server {args.id} running on gRPC port {server.transport.port}")
     _block()
@@ -85,7 +85,7 @@ def cmd_start_broker(args) -> int:
     # compile (~20-40s) before the template cache warms up
     broker = Broker(_registry(args.registry), broker_id=args.id,
                     timeout_s=args.timeout_s)
-    http = BrokerHttpServer(broker, port=args.port)
+    http = BrokerHttpServer(broker, host=args.host, port=args.port)
     http.start()
     print(f"broker {args.id} serving {http.url}/query/sql")
     _block()
@@ -180,12 +180,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--registry", required=True)
     sp.add_argument("--data-dir", default="./serverdata")
     sp.add_argument("--id", default="server_0")
+    sp.add_argument("--host", default="127.0.0.1",
+                    help="bind + advertised gRPC host (container/pod "
+                         "hostname or IP in multi-host deployments)")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_start_server)
 
     sp = sub.add_parser("start-broker")
     sp.add_argument("--registry", required=True)
     sp.add_argument("--id", default="broker_0")
+    sp.add_argument("--host", default="127.0.0.1",
+                    help="HTTP bind host (0.0.0.0 in containers)")
     sp.add_argument("--port", type=int, default=8099)
     sp.add_argument("--timeout-s", type=float, default=60.0)
     sp.set_defaults(fn=cmd_start_broker)

@@ -1,0 +1,67 @@
+"""Compatibility verifier (CompatibilityOpsRunner / compCheck.sh analog)."""
+
+import textwrap
+
+from pinot_tpu.tools.compat import load_suite, main, run_suite_file
+
+
+class TestCompatRunner:
+    def test_sample_suite_passes(self):
+        results = run_suite_file("compat/sample-suite.yaml", timeout_s=30.0)
+        assert results, "suite executed no ops"
+        failures = [r for r in results if r[2] != "PASS"]
+        assert not failures, failures
+
+    def test_cli_exit_codes(self, tmp_path, capsys):
+        good = tmp_path / "good.yaml"
+        good.write_text(textwrap.dedent("""
+            operations:
+              - type: tableOp
+                op: CREATE
+                schema:
+                  name: t1
+                  dimensions: [[k, STRING]]
+                  metrics: [[v, LONG]]
+                tableConfig: {table_name: t1}
+              - type: segmentOp
+                op: UPLOAD
+                table: t1
+                segmentName: s0
+                rows: [{k: a, v: 1}]
+              - type: queryOp
+                sql: SELECT SUM(v) FROM t1
+                expectedRows: [[1]]
+        """))
+        assert main(["--suite", str(good)]) == 0
+        assert "3/3 ops passed" in capsys.readouterr().out
+
+        bad = tmp_path / "bad.yaml"
+        bad.write_text(textwrap.dedent("""
+            operations:
+              - type: tableOp
+                op: CREATE
+                schema:
+                  name: t2
+                  dimensions: [[k, STRING]]
+                  metrics: [[v, LONG]]
+                tableConfig: {table_name: t2}
+              - type: segmentOp
+                op: UPLOAD
+                table: t2
+                segmentName: s0
+                rows: [{k: a, v: 1}]
+              - type: queryOp
+                sql: SELECT SUM(v) FROM t2
+                expectedRows: [[999]]
+        """))
+        assert main(["--suite", str(bad), "--timeout", "3"]) == 1
+        out = capsys.readouterr().out
+        assert "FAIL" in out and "2/3 ops passed" in out
+
+    def test_yaml_and_json_suites(self, tmp_path):
+        y = tmp_path / "s.yaml"
+        y.write_text("operations:\n  - {type: queryOp, sql: 'SELECT 1'}\n")
+        assert load_suite(str(y))["operations"][0]["type"] == "queryOp"
+        j = tmp_path / "s.json"
+        j.write_text('{"operations": [{"type": "queryOp", "sql": "SELECT 1"}]}')
+        assert load_suite(str(j))["operations"][0]["type"] == "queryOp"
