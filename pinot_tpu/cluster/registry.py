@@ -23,6 +23,7 @@ pushed, which replaces Helix messages with level-triggered reconciliation.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import fcntl
 import json
@@ -622,32 +623,210 @@ class ClusterRegistry:
         return self._tx(fn)
 
 
+_SECTIONS = (
+    "instances", "tables", "schemas", "segments", "assignment",
+    "external_view", "partition_assignment", "segment_completion",
+    "tasks", "task_metadata", "segment_lineage",
+)
+
+
+def _section_to_json(name: str, data: dict):
+    # vars() over dataclasses.asdict: fields are flat scalars/lists and
+    # asdict's recursive deep-copy dominates section-write cost at
+    # thousands of segments
+    if name == "instances":
+        return {k: dict(vars(v)) for k, v in data.items()}
+    if name == "segments":
+        return {t: {n: dict(vars(r)) for n, r in segs.items()}
+                for t, segs in data.items()}
+    return data
+
+
+def _section_from_json(name: str, d):
+    d = d or {}
+    if name == "instances":
+        return {k: InstanceInfo(**v) for k, v in d.items()}
+    if name == "segments":
+        return {t: {n: SegmentRecord(**r) for n, r in segs.items()}
+                for t, segs in d.items()}
+    return d
+
+
+class _LazyState:
+    """Dict-like view over the registry's section files: sections load on
+    first access within a transaction, and only ACCESSED sections are
+    written back — a heartbeat touches instances.json alone instead of
+    rewriting (and re-parsing) the whole cluster state."""
+
+    def __init__(self, reg: "FileRegistry"):
+        self._reg = reg
+        self.accessed: set = set()
+
+    def _section(self, key: str) -> dict:
+        if key not in _SECTIONS:
+            raise KeyError(key)
+        self.accessed.add(key)
+        return self._reg._load_section(key)
+
+    def __getitem__(self, key: str) -> dict:
+        return self._section(key)
+
+    def get(self, key: str, default=None):
+        return self._section(key)
+
+    def setdefault(self, key: str, default=None):
+        return self._section(key)
+
+    def __contains__(self, key: str) -> bool:
+        return key in _SECTIONS
+
+
 class FileRegistry(ClusterRegistry):
-    """JSON-file-backed registry with advisory locking: the durable cluster
-    state for multi-process single-host clusters (the role ZK plays)."""
+    """File-backed registry with advisory locking: the durable cluster
+    state for multi-process single-host clusters (the role ZK plays).
+
+    Layout: ``<path>.d/<section>.json`` — one file per state section plus a
+    monotonically-bumped ``version`` stamp. Transactions hold one flock,
+    load only the sections they touch, and rewrite only those (atomic
+    tmp+rename). A version-validated in-process cache makes the poll paths
+    (server sync, broker routing) parse nothing but the tiny version file
+    while the cluster is quiescent — the FileRegistry equivalent of ZK
+    watches."""
 
     def __init__(self, path: str):
         super().__init__()
         self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        if not os.path.exists(path):
-            with open(path, "w") as f:
-                json.dump(_to_json(self._state), f)
+        self.dir = path + ".d"
+        os.makedirs(self.dir, exist_ok=True)
+        self._version_path = os.path.join(self.dir, "version")
+        self._lock_path = os.path.join(self.dir, ".lock")
+        self._cache: dict = {}      # section -> parsed state
+        self._raw: dict = {}        # section -> serialized text (dirty check)
+        self._sig: dict = {}        # section -> file stat signature
+        self._migrate_legacy()
 
-    def _tx(self, fn, write: bool = True):
-        with self._lock:
-            with open(self.path, "r+") as f:
-                fcntl.flock(f, fcntl.LOCK_EX if write else fcntl.LOCK_SH)
+    def _migrate_legacy(self) -> None:
+        """One-time split of a pre-section single-JSON state file."""
+        with self._locked(write=True):
+            if os.path.exists(self._version_path):
+                return
+            legacy = {}
+            if os.path.isfile(self.path):
                 try:
-                    try:
-                        state = _from_json(json.load(f))
-                    except json.JSONDecodeError:
-                        state = _from_json({})
-                    out = fn(state)
-                    if write:
-                        f.seek(0)
-                        f.truncate()
-                        json.dump(_to_json(state), f)
-                    return out
+                    with open(self.path) as f:
+                        legacy = _from_json(json.load(f))
+                except (json.JSONDecodeError, OSError):
+                    legacy = {}
+            for name in _SECTIONS:
+                self._write_section(name, legacy.get(name, {}))
+            self._bump_version()
+
+    # ---- file plumbing ---------------------------------------------------
+    @contextlib.contextmanager
+    def _locked(self, write: bool):
+        with self._lock:
+            with open(self._lock_path, "a+") as lf:
+                fcntl.flock(lf, fcntl.LOCK_EX if write else fcntl.LOCK_SH)
+                try:
+                    yield
                 finally:
-                    fcntl.flock(f, fcntl.LOCK_UN)
+                    fcntl.flock(lf, fcntl.LOCK_UN)
+
+    def _read_versions(self) -> dict:
+        """Per-section change counters — one tiny file read per tx; a
+        heartbeat bump invalidates peers' cached instances section only,
+        not their (large) segments/assignment caches."""
+        try:
+            with open(self._version_path) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            return {}
+
+    def _bump_version(self, sections=None) -> dict:
+        v = self._read_versions()
+        for name in (sections if sections is not None else _SECTIONS):
+            v[name] = v.get(name, 0) + 1
+        tmp = f"{self._version_path}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(v, f)
+        os.replace(tmp, self._version_path)
+        return v
+
+    def _section_path(self, name: str) -> str:
+        return os.path.join(self.dir, f"{name}.json")
+
+    def _file_sig(self, name: str):
+        try:
+            st = os.stat(self._section_path(name))
+            return (st.st_ino, st.st_mtime_ns, st.st_size)
+        except OSError:
+            return None
+
+    def _load_section(self, name: str) -> dict:
+        if name in self._cache:
+            return self._cache[name]
+        try:
+            with open(self._section_path(name)) as f:
+                text = f.read()
+            data = _section_from_json(name, json.loads(text))
+        except (OSError, json.JSONDecodeError):
+            text, data = "", _section_from_json(name, {})
+        self._cache[name] = data
+        self._raw[name] = text
+        self._sig[name] = self._file_sig(name)
+        return data
+
+    def _write_section(self, name: str, data: dict) -> bool:
+        """Serialize and persist ONE section; returns False (and skips the
+        disk write) when the content is byte-identical to what's on disk —
+        read-shaped write txs (empty claim_task polls, no-op heartbeats)
+        must not churn files or invalidate peer caches."""
+        # dumps-then-write hits the C encoder; json.dump's streaming
+        # iterencode is ~10x slower on large sections
+        text = json.dumps(_section_to_json(name, data))
+        if text == self._raw.get(name):
+            return False
+        tmp = f"{self._section_path(name)}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, self._section_path(name))
+        self._raw[name] = text
+        self._sig[name] = self._file_sig(name)
+        return True
+
+    def _drop_cache(self) -> None:
+        self._cache.clear()
+        self._raw.clear()
+        self._sig.clear()
+
+    # ---- transactions ----------------------------------------------------
+    def _tx(self, fn, write: bool = True):
+        with self._locked(write):
+            # stat-signature validation: survives a peer crashing between
+            # its section writes and version bump (the file itself is the
+            # truth, not the counter)
+            for name in list(self._cache):
+                if self._file_sig(name) != self._sig.get(name):
+                    del self._cache[name]
+                    self._raw.pop(name, None)
+                    self._sig.pop(name, None)
+            state = _LazyState(self)
+            try:
+                out = fn(state)
+                if write and state.accessed:
+                    changed = [name for name in state.accessed
+                               if self._write_section(name, self._cache[name])]
+                    if changed:
+                        self._bump_version(changed)
+            except Exception:
+                # fn (or a failed write-back) may have left cached sections
+                # diverged from disk: never serve them again
+                self._drop_cache()
+                raise
+            return out
+
+    def state_version(self) -> int:
+        """Cheap change token: pollers can skip work while it holds still
+        (the ZK-watch analog for file-backed clusters)."""
+        with self._locked(write=False):
+            return sum(self._read_versions().values())
