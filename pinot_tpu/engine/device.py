@@ -50,6 +50,11 @@ DEVICE_AGGS = {
 
 MAX_DENSE_GROUPS = 1 << 22        # ARRAY_BASED regime guard (~4M groups)
 MAX_PRESENCE_CELLS = 1 << 24      # distinctcount (G, C) presence guard
+# sort-based high-cardinality regime (MAP_BASED analog): hard ceiling on
+# the per-launch group table (the effective cap is
+# min(num_groups_limit, this)); overflow falls back to the host path
+MAX_SORTED_GROUPS = 1 << 17
+SORTED_AGGS = ("count", "sum", "avg", "min", "max", "minmaxrange")
 
 
 def segment_device_eligible(seg) -> bool:
@@ -251,7 +256,7 @@ def build_pipeline(template, mm_mode: str = "auto"):
     (ops/groupby_mm.py) on TPU, scatter elsewhere; "interpret" forces the
     kernel in Pallas interpret mode (CPU tests); "off" forces scatter.
     """
-    shape, filter_tpl, group_cols, group_cards, aggs = template
+    shape, filter_tpl, group_cols, group_cards, aggs, sorted_k = template
     mm_mode = _resolve_mm_mode(mm_mode)
     num_groups = 1
     for c in group_cards:
@@ -264,6 +269,69 @@ def build_pipeline(template, mm_mode: str = "auto"):
         mask = _eval_filter(filter_tpl, cols, params, sl) & valid
         seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)  # (S,) for stats
         outs = {"doc_count": jnp.sum(seg_matched), "seg_matched": seg_matched}
+
+        if shape == "groupby_sorted":
+            # SORT-BASED high-cardinality regime: dense accumulators would
+            # blow HBM past MAX_DENSE_GROUPS, so sort the combined int64
+            # keys (payload values ride along), derive group boundaries,
+            # and scatter into a numGroupsLimit-capped table — the
+            # MAP_BASED regime of DictionaryBasedGroupKeyGenerator, done
+            # the XLA way (one lax.sort, static shapes throughout).
+            # K comes from the engine's num_groups_limit (template-encoded);
+            # overflow is detected host-side and falls back to the host
+            # path so device truncation policy never leaks into results.
+            K = sorted_k
+            per_col = [cols[c] for c in group_cols]
+            key = agg_ops.combine_keys_int64(per_col, group_cards, mask)
+            flat_key = key.reshape(-1)
+            # dedup payloads by argument template: MIN(x)+MAX(x)+AVG(x)
+            # must sort ONE copy of x, not three
+            payloads, payload_of = [], {}
+            int_payload = {}
+            for i, (name, argt, extra) in enumerate(aggs):
+                if name == "count":
+                    continue
+                if argt not in payload_of:
+                    v = _eval_expr(argt, cols, params)
+                    # integer args accumulate exactly in int64 (the host /
+                    # dense paths are exact; per-doc f64 adds would round)
+                    as_int = jnp.issubdtype(v.dtype, jnp.integer)
+                    int_payload[argt] = as_int
+                    dt = jnp.int64 if as_int else jnp.float64
+                    payload_of[argt] = len(payloads)
+                    payloads.append(v.astype(dt).reshape(-1))
+            sorted_ops = jax.lax.sort([flat_key] + payloads, num_keys=1)
+            sk = sorted_ops[0]
+            is_start = jnp.concatenate(
+                [jnp.ones(1, dtype=bool), sk[1:] != sk[:-1]])
+            real = sk != agg_ops.INT64_SENTINEL
+            sid = jnp.cumsum(is_start) - 1
+            outs["n_groups_total"] = jnp.sum(is_start & real)
+            sid_c = jnp.where(real & (sid < K), sid, K)
+            outs["skeys"] = jnp.full(
+                K + 1, agg_ops.INT64_SENTINEL, dtype=jnp.int64
+            ).at[sid_c].min(sk)[:K]
+            outs["gcount"] = jnp.zeros(
+                K + 1, dtype=jnp.int64).at[sid_c].add(1)[:K]
+            for i, (name, argt, extra) in enumerate(aggs):
+                k = f"a{i}"
+                if name == "count":
+                    continue
+                v = sorted_ops[1 + payload_of[argt]]
+                is_int = int_payload[argt]
+                acc_dt = jnp.int64 if is_int else jnp.float64
+                lo_fill = jnp.iinfo(jnp.int64).max if is_int else jnp.inf
+                hi_fill = jnp.iinfo(jnp.int64).min if is_int else -jnp.inf
+                if name in ("sum", "avg"):
+                    outs[f"{k}_sum"] = jnp.zeros(
+                        K + 1, dtype=acc_dt).at[sid_c].add(v)[:K]
+                if name in ("min", "minmaxrange"):
+                    outs[f"{k}_min"] = jnp.full(
+                        K + 1, lo_fill, dtype=acc_dt).at[sid_c].min(v)[:K]
+                if name in ("max", "minmaxrange"):
+                    outs[f"{k}_max"] = jnp.full(
+                        K + 1, hi_fill, dtype=acc_dt).at[sid_c].max(v)[:K]
+            return outs
 
         if shape == "groupby":
             # columns are already global ids: the group key IS the column
@@ -355,12 +423,16 @@ class DeviceExecutor:
     # check runs after each execution too (engine/device.py _execute)
     MAX_CACHED_BYTES = int(os.environ.get("PINOT_TPU_BATCH_CACHE_BYTES", 6 << 30))
 
-    def __init__(self, mesh=None, mm_mode: str = "auto"):
+    def __init__(self, mesh=None, mm_mode: str = "auto",
+                 num_groups_limit: int = 100_000):
         """``mesh``: optional jax Mesh — shard the segment axis over it with
         psum-combined accumulators (parallel/mesh.py) instead of a
-        single-device batched launch. ``mm_mode``: see build_pipeline."""
+        single-device batched launch. ``mm_mode``: see build_pipeline.
+        ``num_groups_limit``: the sorted high-card regime's group-table
+        cap, matching the engine's numGroupsLimit."""
         self.mesh = mesh
         self.mm_mode = mm_mode
+        self.num_groups_limit = max(1, num_groups_limit)
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
         self._pipelines: dict = {}   # (template, mm_mode) -> jitted/sharded fn
 
@@ -475,22 +547,37 @@ class DeviceExecutor:
             total = 1
             for c in group_cards:
                 total *= c
-            if total > MAX_DENSE_GROUPS:
-                raise DeviceUnsupported(f"dense group space too large ({total})")
 
         agg_tpls = tuple(
             self._agg_template(i, a, ctx, params, counter) for i, a in enumerate(aggs)
         )
-        for name, argt, extra in agg_tpls:
-            if group_cols and name in ("distinctcount", "distinctcounthll"):
-                total = extra if name == "distinctcount" else (1 << extra)
-                for c in group_cards:
-                    total *= c
-                if total > MAX_PRESENCE_CELLS:
-                    raise DeviceUnsupported(f"{name} per-group state too large ({total})")
-
         shape = "groupby" if group_cols else "agg"
-        template = (shape, filter_tpl, group_cols, group_cards, agg_tpls)
+        if group_cols and total > MAX_DENSE_GROUPS:
+            # sort-based high-cardinality regime (MAP_BASED analog): no
+            # dense accumulators, so only the additive/extremal aggs fit
+            if total >= (1 << 62):
+                raise DeviceUnsupported(
+                    f"combined group key overflows int64 ({total})")
+            if self.mesh is not None:
+                # shard-local sorts produce unaligned group tables that a
+                # psum cannot merge; multi-chip high-card stays on host
+                raise DeviceUnsupported("sorted group-by not mesh-combinable")
+            for a in aggs:
+                if a.name not in SORTED_AGGS:
+                    raise DeviceUnsupported(
+                        f"agg {a.name} not on the sorted group-by path")
+            shape = "groupby_sorted"
+        for name, argt, extra in agg_tpls:
+            if shape == "groupby" and name in ("distinctcount", "distinctcounthll"):
+                cells = extra if name == "distinctcount" else (1 << extra)
+                for c in group_cards:
+                    cells *= c
+                if cells > MAX_PRESENCE_CELLS:
+                    raise DeviceUnsupported(f"{name} per-group state too large ({cells})")
+        sorted_k = min(self.num_groups_limit, MAX_SORTED_GROUPS) \
+            if shape == "groupby_sorted" else 0
+        template = (shape, filter_tpl, group_cols, group_cards, agg_tpls,
+                    sorted_k)
 
         pipeline = self._pipelines.get((template, self.mm_mode))
         if pipeline is None:
@@ -563,7 +650,7 @@ class DeviceExecutor:
 
     # ---- device outputs → canonical IntermediateResult -------------------
     def _to_intermediate(self, q, ctx: BatchContext, template, outs, aggs):
-        shape, _, group_cols, group_cards, agg_tpls = template
+        shape, _, group_cols, group_cards, agg_tpls, sorted_k = template
         doc_count = int(outs["doc_count"])
         # mirror the host executor's stats accounting so responses are
         # backend-independent (host.py execute_segment)
@@ -589,11 +676,23 @@ class DeviceExecutor:
             ]
             return IntermediateResult("aggregation", agg_partials=partials, stats=stats)
 
+        if shape == "groupby_sorted" and \
+                int(outs["n_groups_total"]) > sorted_k:
+            # the capped table dropped groups: re-run on the host so device
+            # truncation policy never shapes results (host applies its own
+            # numGroupsLimit semantics)
+            raise DeviceUnsupported(
+                f"sorted group table overflow "
+                f"({int(outs['n_groups_total'])} > {sorted_k})")
         gcount = outs["gcount"]
         present = np.nonzero(gcount > 0)[0]
-        # decode dense gid → per-column global ids → values
+        # decode the combined key (dense: the gid itself; sorted: the int64
+        # key recorded per table slot) → per-column global ids → values
+        if shape == "groupby_sorted":
+            rem = outs["skeys"][present].astype(np.int64)
+        else:
+            rem = present.copy()
         keys = []
-        rem = present.copy()
         for card in reversed(group_cards[1:]):
             keys.append(rem % card)
             rem = rem // card

@@ -171,7 +171,7 @@ class QueryEngine:
         if device_executor == "auto":
             from pinot_tpu.engine.device import DeviceExecutor
 
-            device_executor = DeviceExecutor()
+            device_executor = DeviceExecutor(num_groups_limit=num_groups_limit)
         self.device = device_executor  # None → host-only
         self._dim_cache: dict = {}  # (table, pk, val) -> (generation, map)
         self.host.lookup_resolver = self.dim_table_lookup

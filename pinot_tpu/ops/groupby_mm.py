@@ -116,10 +116,14 @@ def _launch(ids_lane, ch_operand, ch_spec, *, a_real, hpad, nsuper,
         rho_mode=rho_mode,
     )
     # acc scratch + out block are each a_real*hpad*128 f32; the out block is
-    # double-buffered by the pipeline. Default scoped-vmem limit is 16MB —
-    # raise it for large-G accumulators (v5e has 128MB VMEM).
+    # double-buffered by the pipeline and Mosaic stacks further transient
+    # copies. Default scoped-vmem limit is 16MB — raise it for large-G
+    # accumulators (v5e has 128MB VMEM). Empirically the compiler's stack
+    # peak reaches ~8x the accumulator at 400k groups (measured: 40.2MB at
+    # acc=4.8MB), so budget 8x + headroom; MAX_ACC_CELLS keeps the result
+    # under the 110MB ceiling.
     acc_bytes = a_real * hpad * 128 * 4
-    vmem_limit = max(16 * 2**20, min(110 * 2**20, 4 * acc_bytes + 8 * 2**20))
+    vmem_limit = max(16 * 2**20, min(110 * 2**20, 8 * acc_bytes + 16 * 2**20))
     out = pl.pallas_call(
         kern,
         grid=(nsuper, NINNER),

@@ -178,3 +178,116 @@ def test_matmul_groupby_parity(mm_engine, engines, sql):
     assert len(rows_d) == len(rows_h)
     for a, b in zip(rows_d, rows_h):
         assert all(_close(x, y) for x, y in zip(a, b)), (a, b)
+
+
+class TestSortedHighCardGroupBy:
+    """Sort-based high-cardinality device regime (MAP_BASED analog): the
+    cartesian dict-id product exceeds MAX_DENSE_GROUPS, so the combined
+    int64 keys are lax.sort-ed and aggregated into a capped table."""
+
+    @pytest.fixture(scope="class")
+    def hc(self, tmp_path_factory):
+        rng = np.random.default_rng(23)
+        n = 30_000
+        # 5000 users x 4096 items >> 4M dense cap; ~25k distinct pairs
+        cols = {
+            "user": np.array([f"u{i:04d}" for i in range(5000)])[
+                rng.integers(0, 5000, n)],
+            "item": np.array([f"i{i:04d}" for i in range(4096)])[
+                rng.integers(0, 4096, n)],
+            "spend": rng.integers(1, 500, n).astype(np.int64),
+        }
+        schema = Schema.build(
+            name="hc",
+            dimensions=[("user", DataType.STRING), ("item", DataType.STRING)],
+            metrics=[("spend", DataType.LONG)],
+        )
+        cfg = TableConfig(table_name="hc")
+        base = tmp_path_factory.mktemp("hcseg")
+        dev = QueryEngine()
+        host = QueryEngine(device_executor=None)
+        half = n // 2
+        for i, sl in enumerate([slice(0, half), slice(half, n)]):
+            part = {k: v[sl] for k, v in cols.items()}
+            build_segment(schema, part, str(base / f"s{i}"), cfg, f"s{i}")
+            seg = ImmutableSegment(str(base / f"s{i}"))
+            dev.add_segment("hc", seg)
+            host.add_segment("hc", seg)
+        return dev, host, cols
+
+    @pytest.mark.parametrize("sql", [
+        "SELECT user, item, SUM(spend), COUNT(*) FROM hc "
+        "GROUP BY user, item ORDER BY SUM(spend) DESC, user, item LIMIT 25",
+        "SELECT user, item, MIN(spend), MAX(spend), AVG(spend) FROM hc "
+        "WHERE spend > 100 GROUP BY user, item "
+        "ORDER BY MAX(spend) DESC, user, item LIMIT 40",
+        "SELECT user, MINMAXRANGE(spend) FROM hc GROUP BY user "
+        "ORDER BY user LIMIT 30",
+    ])
+    def test_parity_with_host(self, hc, sql):
+        dev, host, _ = hc
+        rd, rh = dev.execute(sql), host.execute(sql)
+        assert not rd.get("exceptions"), rd
+        assert not rh.get("exceptions"), rh
+        assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+
+    def test_sorted_template_used(self, hc):
+        dev, _, _ = hc
+        dev.execute("SELECT user, item, SUM(spend) FROM hc GROUP BY user, item")
+        shapes = {t[0] for (t, _m) in dev.device._pipelines}
+        assert "groupby_sorted" in shapes
+
+    def test_unsupported_agg_falls_back_to_host(self, hc):
+        dev, host, _ = hc
+        sql = ("SELECT user, item, DISTINCTCOUNT(item) FROM hc "
+               "GROUP BY user, item ORDER BY user, item LIMIT 10")
+        rd, rh = dev.execute(sql), host.execute(sql)
+        assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+    def test_group_table_overflow_falls_back_to_host(self, hc):
+        """More distinct groups than the cap: the device result would be
+        key-order-truncated, so it must defer to the host path (r3
+        review)."""
+        dev_small = QueryEngine(num_groups_limit=1000)
+        host_small = QueryEngine(device_executor=None, num_groups_limit=1000)
+        src, _, _ = hc
+        for seg in src.tables["hc"].segments.values():
+            dev_small.add_segment("hc", seg)
+            host_small.add_segment("hc", seg)
+        sql = ("SELECT user, item, SUM(spend) FROM hc GROUP BY user, item "
+               "ORDER BY user, item LIMIT 20")
+        rd, rh = dev_small.execute(sql), host_small.execute(sql)
+        assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+    def test_large_int_sums_exact(self, tmp_path):
+        """Integer payloads accumulate in int64 on the sorted path — per-doc
+        f64 adds would round past 2^53 (r3 review)."""
+        rng = np.random.default_rng(4)
+        n = 20_000
+        big = (rng.integers(1, 1 << 40, n) << 14).astype(np.int64)
+        cols = {
+            # every row a distinct b: global cards 300 x 20000 = 6M > dense
+            # cap, while the ~20k real groups fit the sorted table
+            "a": np.array([f"a{i:03d}" for i in range(300)])[
+                rng.integers(0, 300, n)],
+            "b": np.array([f"b{i:05d}" for i in range(n)]),
+            "v": big,
+        }
+        schema = Schema.build(
+            name="bigs",
+            dimensions=[("a", DataType.STRING), ("b", DataType.STRING)],
+            metrics=[("v", DataType.LONG)],
+        )
+        build_segment(schema, cols, str(tmp_path / "s0"),
+                      TableConfig(table_name="bigs"), "s0")
+        seg = ImmutableSegment(str(tmp_path / "s0"))
+        dev = QueryEngine()
+        host = QueryEngine(device_executor=None)
+        dev.add_segment("bigs", seg)
+        host.add_segment("bigs", seg)
+        sql = ("SELECT a, b, SUM(v) FROM bigs GROUP BY a, b "
+               "ORDER BY SUM(v) DESC, a, b LIMIT 50")
+        rd, rh = dev.execute(sql), host.execute(sql)
+        shapes = {t[0] for (t, _m) in dev.device._pipelines}
+        assert "groupby_sorted" in shapes
+        assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
