@@ -32,6 +32,53 @@ from pinot_tpu.transport.grpc_transport import QueryRouterChannel, make_instance
 log = logging.getLogger("pinot_tpu.broker")
 
 
+class QueryQuotaManager:
+    """Per-table QPS token bucket
+    (queryquota/HelixExternalViewBasedQueryQuotaManager analog). Rates come
+    from TableConfig.quota.max_queries_per_second; the bucket holds up to
+    one second of burst. Enforced per broker — the reference divides the
+    table quota by the live-broker count, which a deployment can mirror by
+    setting the per-table rate accordingly."""
+
+    def __init__(self, registry):
+        self.registry = registry
+        self._buckets: dict = {}  # raw table -> [tokens, last_ts, rate]
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _base_name(table: str) -> str:
+        # one bucket per logical table: 'tbl', 'tbl_OFFLINE' and
+        # 'tbl_REALTIME' must draw from the SAME quota
+        for suffix in ("_OFFLINE", "_REALTIME"):
+            if table.endswith(suffix):
+                return table[: -len(suffix)]
+        return table
+
+    def _rate(self, base: str) -> Optional[float]:
+        for key in (base, f"{base}_OFFLINE", f"{base}_REALTIME"):
+            cfg = self.registry.table_config(key)
+            if cfg is not None and \
+                    cfg.quota.max_queries_per_second is not None:
+                return float(cfg.quota.max_queries_per_second)
+        return None
+
+    def acquire(self, table: str) -> bool:
+        """True = admit; False = over quota (HTTP 429-shaped rejection)."""
+        base = self._base_name(table)
+        rate = self._rate(base)
+        if rate is None:
+            return True
+        now = time.time()
+        with self._lock:
+            tokens, last, _ = self._buckets.get(base, (rate, now, rate))
+            tokens = min(rate, tokens + (now - last) * rate)
+            if tokens < 1.0:
+                self._buckets[base] = [tokens, now, rate]
+                return False
+            self._buckets[base] = [tokens - 1.0, now, rate]
+            return True
+
+
 class FailureDetector:
     """Connection-level failure detector with exponential backoff retry
     (pinot-broker/.../failuredetector/BaseExponentialBackoffRetryFailureDetector)."""
@@ -112,6 +159,7 @@ class Broker:
         from pinot_tpu.common.metrics import get_metrics
 
         self.metrics = get_metrics("broker")
+        self.quota = QueryQuotaManager(registry)
         self.failures = FailureDetector()
         self.routing = RoutingManager(registry, self.failures)
         self._channels: dict[str, QueryRouterChannel] = {}
@@ -161,6 +209,14 @@ class Broker:
                     tables: dict = {}
 
                 return explain_plan(_NoDevice(), q)
+            if not self.quota.acquire(q.table_name):
+                # quota rejection before any fan-out
+                # (BaseBrokerRequestHandler's quota check placement)
+                self.metrics.count("queriesQuotaExceeded")
+                return {"exceptions": [{
+                    "errorCode": 429,
+                    "message": f"query quota exceeded for table "
+                               f"{q.table_name!r}"}]}
             if dict(q.options).get("trace"):
                 tracer = trace.start_trace()
             resp = self._scatter_gather(q, sql)

@@ -58,6 +58,14 @@ class SegmentPartitionConfig:
 
 
 @dataclasses.dataclass
+class QuotaConfig:
+    """Per-table query quota (spi/config/table/QuotaConfig analog):
+    max queries per second enforced broker-side."""
+
+    max_queries_per_second: Optional[float] = None
+
+
+@dataclasses.dataclass
 class UpsertConfig:
     mode: str = "NONE"  # NONE | FULL | PARTIAL
     comparison_column: Optional[str] = None
@@ -90,6 +98,7 @@ class TableConfig:
     indexing: IndexingConfig = dataclasses.field(default_factory=IndexingConfig)
     partition: SegmentPartitionConfig = dataclasses.field(default_factory=SegmentPartitionConfig)
     upsert: UpsertConfig = dataclasses.field(default_factory=UpsertConfig)
+    quota: QuotaConfig = dataclasses.field(default_factory=QuotaConfig)
     stream: Optional[StreamConfig] = None
     # Minion task configs keyed by task type (TableTaskConfig analog), e.g.
     # {"MergeRollupTask": {"max_docs_per_segment": 1_000_000}}
@@ -103,6 +112,11 @@ class TableConfig:
                 "star_tree_configs are not supported on upsert tables "
                 "(pre-aggregated partials ignore validDocIds)"
             )
+        mqps = self.quota.max_queries_per_second
+        if mqps is not None and mqps <= 0:
+            raise ValueError(
+                "quota.max_queries_per_second must be positive "
+                "(omit it for unlimited)")
 
     @property
     def table_name_with_type(self) -> str:
@@ -132,6 +146,8 @@ class TableConfig:
             obj["partition"] = SegmentPartitionConfig(**p)
         if "upsert" in obj and isinstance(obj["upsert"], dict):
             obj["upsert"] = UpsertConfig(**obj["upsert"])
+        if "quota" in obj and isinstance(obj["quota"], dict):
+            obj["quota"] = QuotaConfig(**obj["quota"])
         if obj.get("stream") is not None and isinstance(obj["stream"], dict):
             obj["stream"] = StreamConfig(**obj["stream"])
         return cls(**obj)

@@ -12,6 +12,8 @@ remote server) because partials are canonical (engine/aggspec.py).
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from pinot_tpu.engine import aggspec
@@ -248,6 +250,79 @@ def _group_env(q, merged, specs):
     return env
 
 
+def _gapfill_options(q) -> Optional[dict]:
+    """SET-driven gapfill config (GapfillProcessor analog, option-shaped:
+    SET gapfillBucketMs = 3600000; [gapfillStart/gapfillEnd/gapfillFill]).
+    Returns None when gapfill is off."""
+    opts = {str(k).lower(): v for k, v in q.options_dict().items()}
+    bucket = opts.get("gapfillbucketms")
+    if bucket is None:
+        return None
+    if len(q.group_by) != 1:
+        raise ValueError("gapfill needs exactly one GROUP BY time bucket")
+    return {
+        "bucket": int(bucket),
+        "start": opts.get("gapfillstart"),
+        "end": opts.get("gapfillend"),
+        "fill": str(opts.get("gapfillfill", "zero")).lower(),
+    }
+
+
+def _apply_gapfill(q, env, n, cfg, specs):
+    """Insert missing time buckets into the group env: COUNT-like aggs get
+    the fill value (zero/null/previous); group keys become the full bucket
+    range [start, end) at bucket intervals."""
+    key_expr = q.group_by[0]
+    keys = np.asarray(env[key_expr], dtype=np.int64)
+    bucket = cfg["bucket"]
+    if bucket <= 0:
+        raise ValueError("gapfillBucketMs must be positive")
+    start = int(cfg["start"]) if cfg["start"] is not None else \
+        (int(keys.min()) if n else 0)
+    end = int(cfg["end"]) if cfg["end"] is not None else \
+        (int(keys.max()) + bucket if n else 0)
+    if end <= start:
+        return env, n
+    n_buckets = (end - start + bucket - 1) // bucket
+    if n_buckets > 1_000_000:
+        raise ValueError(f"gapfill range too large ({n_buckets} buckets)")
+    in_range = (keys >= start) & (keys < end)
+    if n and np.any((keys[in_range] - start) % bucket != 0):
+        # off-grid group keys would otherwise be silently replaced by fill
+        # values — reject like the reference rejects misaligned buckets
+        raise ValueError(
+            "gapfill group keys are not aligned to gapfillBucketMs from "
+            "gapfillStart; bucket the GROUP BY expression accordingly")
+    full = start + np.arange(n_buckets, dtype=np.int64) * bucket
+    pos = np.searchsorted(full, keys)
+    hit = np.zeros(n_buckets, dtype=bool)
+    src = np.zeros(n_buckets, dtype=np.int64)
+    hit[pos[in_range]] = True
+    src[pos[in_range]] = np.nonzero(in_range)[0]
+    fill = cfg["fill"]
+    out = {key_expr: full}
+    for a, s in zip(q.aggregations(), specs):
+        vals = np.asarray(env[a])
+        # zero-fill preserves integer aggregate types (COUNT stays LONG);
+        # null/previous fills need NaN, so they widen to float
+        if fill == "zero" and vals.dtype.kind in ("i", "u"):
+            filled = np.zeros(n_buckets, dtype=np.int64)
+        else:
+            filled = np.zeros(n_buckets, dtype=np.float64)
+            if fill == "null":
+                filled[:] = np.nan
+        if n:
+            filled[hit] = vals[src[hit]].astype(filled.dtype)
+        if fill == "previous" and n_buckets:
+            # carry the last seen value forward (reference FILL(...,
+            # 'FILL_PREVIOUS_VALUE')); leading gaps stay null
+            idx = np.where(hit, np.arange(n_buckets), -1)
+            idx = np.maximum.accumulate(idx)
+            filled = np.where(idx >= 0, filled[np.maximum(idx, 0)], np.nan)
+        out[a] = filled
+    return out, n_buckets
+
+
 def _finalize_group_by(q, merged) -> ResultTable:
     specs = [aggspec.make_spec(a) for a in q.aggregations()]
     env = _group_env(q, merged, specs)
@@ -257,6 +332,10 @@ def _finalize_group_by(q, merged) -> ResultTable:
         mask = _having_mask(q.having, env, n)
         env = {k: np.asarray(v)[mask] if np.asarray(v).ndim else v for k, v in env.items()}
         n = int(mask.sum())
+
+    gf = _gapfill_options(q)
+    if gf is not None:
+        env, n = _apply_gapfill(q, env, n, gf, specs)
 
     if q.order_by and n > 0:
         order = _order_indices(
@@ -274,6 +353,10 @@ def _finalize_group_by(q, merged) -> ResultTable:
         types.append(_np_type_name(v))
         out_cols.append(v)
     rows = [tuple(py_value(c[i]) for c in out_cols) for i in range(len(out_cols[0]) if out_cols else 0)]
+    if gf is not None:
+        # null-filled buckets surface as SQL NULLs, not NaN
+        rows = [tuple(None if isinstance(x, float) and np.isnan(x) else x
+                      for x in r) for r in rows]
     return ResultTable(names, types, rows)
 
 

@@ -1,0 +1,124 @@
+"""Geospatial scalar functions (host-side).
+
+Equivalent of the reference's geospatial package
+(pinot-core/.../geospatial/transform/function/: StPointFunction,
+StDistanceFunction, StContainsFunction, StAsTextFunction,
+StGeogFromTextFunction...). The reference delegates geometry to JTS and
+H3 (JNI); here geography stays WKT-string-encoded (POINT/POLYGON) with
+numpy haversine math — SURVEY §7 keeps geo host-side permanently.
+
+Coordinates are (longitude, latitude) in degrees, like the reference's
+geography type; distances are meters on the WGS84 mean sphere.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+EARTH_RADIUS_M = 6_371_008.8
+
+_POINT_RE = re.compile(
+    r"\s*POINT\s*\(\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)\s+"
+    r"(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)\s*\)\s*", re.IGNORECASE)
+_POLY_RE = re.compile(r"\s*POLYGON\s*\(\((.*?)\)\)\s*", re.IGNORECASE | re.DOTALL)
+
+
+def _as_str_array(a) -> np.ndarray:
+    return np.atleast_1d(np.asarray(a)).astype(str)
+
+
+def parse_points(arr) -> tuple:
+    """(lon, lat) float64 arrays from WKT POINT strings; malformed -> NaN."""
+    s = _as_str_array(arr)
+    lon = np.full(len(s), np.nan)
+    lat = np.full(len(s), np.nan)
+    for i, w in enumerate(s):
+        m = _POINT_RE.fullmatch(w)
+        if m:
+            lon[i] = float(m.group(1))
+            lat[i] = float(m.group(2))
+    return lon, lat
+
+
+def parse_polygon(wkt: str) -> np.ndarray:
+    """(n, 2) lon/lat ring from a WKT POLYGON's outer ring."""
+    m = _POLY_RE.fullmatch(str(wkt))
+    if not m:
+        raise ValueError(f"not a WKT POLYGON: {wkt!r}")
+    pts = []
+    for pair in m.group(1).split(","):
+        x, y = pair.split()
+        pts.append((float(x), float(y)))
+    return np.asarray(pts, dtype=np.float64)
+
+
+def st_point(lon, lat) -> np.ndarray:
+    lon = np.atleast_1d(np.asarray(lon, dtype=np.float64))
+    lat = np.atleast_1d(np.asarray(lat, dtype=np.float64))
+    lon, lat = np.broadcast_arrays(lon, lat)
+    return np.asarray([f"POINT ({x:.10g} {y:.10g})" for x, y in zip(lon, lat)])
+
+
+def st_geog_from_text(wkt) -> np.ndarray:
+    return _as_str_array(wkt)
+
+
+def st_as_text(geo) -> np.ndarray:
+    return _as_str_array(geo)
+
+
+def haversine_m(lon1, lat1, lon2, lat2) -> np.ndarray:
+    p1, p2 = np.radians(lat1), np.radians(lat2)
+    dp = p2 - p1
+    dl = np.radians(lon2) - np.radians(lon1)
+    a = np.sin(dp / 2) ** 2 + np.cos(p1) * np.cos(p2) * np.sin(dl / 2) ** 2
+    return 2 * EARTH_RADIUS_M * np.arcsin(np.sqrt(np.clip(a, 0, 1)))
+
+
+def st_distance(a, b) -> np.ndarray:
+    """Sphere distance in meters between two POINT columns/literals
+    (StDistanceFunction geography semantics)."""
+    lon1, lat1 = parse_points(a)
+    lon2, lat2 = parse_points(b)
+    lon1, lon2 = np.broadcast_arrays(lon1, lon2)
+    lat1, lat2 = np.broadcast_arrays(lat1, lat2)
+    return haversine_m(lon1, lat1, lon2, lat2)
+
+
+def _points_in_ring(ring: np.ndarray, lon: np.ndarray, lat: np.ndarray) -> np.ndarray:
+    """Vectorized even-odd ray cast (planar lon/lat, like JTS contains on
+    geometries): True where (lon, lat) falls inside the ring."""
+    inside = np.zeros(len(lon), dtype=bool)
+    x0, y0 = ring[-1]
+    for x1, y1 in ring:
+        crosses = ((y1 > lat) != (y0 > lat))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xint = (x0 - x1) * (lat - y1) / (y0 - y1) + x1
+        inside ^= crosses & (lon < xint)
+        x0, y0 = x1, y1
+    return inside
+
+
+def st_contains(poly_wkt, points) -> np.ndarray:
+    """Polygon contains point — polygon is a (usually literal) WKT POLYGON,
+    points a POINT column (StContainsFunction arg order). Either side may
+    be scalar; both broadcast like any binary transform."""
+    polys = _as_str_array(poly_wkt)
+    lon, lat = parse_points(points)
+    if len(polys) == 1:
+        ring = parse_polygon(polys[0])
+        out = _points_in_ring(ring, lon, lat)
+        return out & ~np.isnan(lon)
+    polys, lon, lat = np.broadcast_arrays(polys, lon, lat)
+    out = np.zeros(len(lon), dtype=bool)
+    for i, p in enumerate(polys):
+        out[i] = bool(_points_in_ring(parse_polygon(p),
+                                      lon[i: i + 1], lat[i: i + 1])[0])
+    return out & ~np.isnan(lon)
+
+
+def st_within(points, poly_wkt) -> np.ndarray:
+    """Point within polygon — flipped argument order (StWithinFunction)."""
+    return st_contains(poly_wkt, points)

@@ -287,6 +287,27 @@ _reg("json_extract_scalar", _json_extract_scalar, min_args=3, max_args=4)
 _reg("jsonextractscalar", _json_extract_scalar, min_args=3, max_args=4)
 
 
+# ---- geospatial (host-only; ops/geo.py — ST_* function analogs) -----------
+
+def _geo(name):
+    from pinot_tpu.ops import geo
+
+    return getattr(geo, name)
+
+
+_reg("st_point", lambda lon, lat: _geo("st_point")(lon, lat), min_args=2,
+     max_args=2)
+_reg("st_distance", lambda a, b: _geo("st_distance")(a, b), min_args=2,
+     max_args=2)
+_reg("st_contains", lambda p, pt: _geo("st_contains")(p, pt), min_args=2,
+     max_args=2, returns_bool=True)
+_reg("st_within", lambda pt, p: _geo("st_within")(pt, p), min_args=2,
+     max_args=2, returns_bool=True)
+_reg("st_geogfromtext", lambda w: _geo("st_geog_from_text")(w), min_args=1)
+_reg("st_geomfromtext", lambda w: _geo("st_geog_from_text")(w), min_args=1)
+_reg("st_astext", lambda g: _geo("st_as_text")(g), min_args=1)
+
+
 # ---- lookup join (host-only; evaluated by SegmentEvaluator._lookup with
 # engine dim-table state — the np_fn here is never called directly) ---------
 

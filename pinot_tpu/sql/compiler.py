@@ -175,6 +175,15 @@ def _to_filter(e: Expression) -> FilterNode:
     if name == "is_not_null":
         return FilterNode.pred(Predicate(PredicateType.IS_NOT_NULL, e.args[0]))
 
+    # boolean-valued transform functions (ST_CONTAINS, STARTSWITH, ...)
+    # filter as `expr = true` — the reference wraps these the same way
+    # (RequestContextUtils' EQ-true predicate over a boolean transform)
+    from pinot_tpu.ops.transform import REGISTRY
+
+    fd = REGISTRY.get(name)
+    if fd is not None and fd.returns_bool:
+        return FilterNode.pred(Predicate(PredicateType.EQ, e, value=True))
+
     raise SqlParseError(f"cannot use {name}() as a filter")
 
 

@@ -1,0 +1,106 @@
+"""Gapfill in broker reduce (GapfillProcessor analog, SET-option surface).
+
+SET gapfillBucketMs = N enables filling of missing time buckets in a
+single-bucket GROUP BY; gapfillStart/gapfillEnd bound the range and
+gapfillFill picks zero | null | previous.
+"""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+
+HOUR = 3_600_000
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("gap")
+    schema = Schema.build(
+        name="metrics",
+        datetimes=[("ts", DataType.LONG)],
+        metrics=[("v", DataType.LONG)],
+    )
+    # buckets 0,1,4,5 present; 2,3 missing
+    ts = np.array([0, 0, HOUR, 4 * HOUR, 5 * HOUR, 5 * HOUR], dtype=np.int64)
+    v = np.array([1, 2, 10, 40, 50, 5], dtype=np.int64)
+    eng = QueryEngine(device_executor=None)
+    seg = build_segment(schema, {"ts": ts, "v": v}, str(tmp / "s"),
+                        TableConfig(table_name="metrics"), "s0")
+    eng.add_segment("metrics", seg)
+    return eng
+
+
+def q(eng, sql):
+    r = eng.execute(sql)
+    assert not r.get("exceptions"), r
+    return r["resultTable"]["rows"]
+
+
+class TestGapfill:
+    def test_zero_fill(self, engine):
+        rows = q(engine,
+                 f"SET gapfillBucketMs = {HOUR}; "
+                 "SELECT ts - ts % 3600000, SUM(v) FROM metrics "
+                 "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        assert rows == [[0, 3], [HOUR, 10], [2 * HOUR, 0], [3 * HOUR, 0],
+                        [4 * HOUR, 40], [5 * HOUR, 55]]
+
+    def test_null_fill(self, engine):
+        rows = q(engine,
+                 f"SET gapfillBucketMs = {HOUR}; SET gapfillFill = 'null'; "
+                 "SELECT ts - ts % 3600000, SUM(v) FROM metrics "
+                 "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        assert rows[2] == [2 * HOUR, None]
+        assert rows[4] == [4 * HOUR, 40]
+
+    def test_previous_fill(self, engine):
+        rows = q(engine,
+                 f"SET gapfillBucketMs = {HOUR}; "
+                 "SET gapfillFill = 'previous'; "
+                 "SELECT ts - ts % 3600000, COUNT(*) FROM metrics "
+                 "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        # buckets 2,3 carry bucket 1's count
+        assert [r[1] for r in rows] == [2, 1, 1, 1, 1, 2]
+
+    def test_explicit_range(self, engine):
+        rows = q(engine,
+                 f"SET gapfillBucketMs = {HOUR}; "
+                 f"SET gapfillStart = 0; SET gapfillEnd = {8 * HOUR}; "
+                 "SELECT ts - ts % 3600000, SUM(v) FROM metrics "
+                 "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        assert len(rows) == 8
+        assert rows[-1] == [7 * HOUR, 0]
+
+    def test_requires_single_group_by(self, engine):
+        r = engine.execute(
+            f"SET gapfillBucketMs = {HOUR}; "
+            "SELECT ts, v, COUNT(*) FROM metrics GROUP BY ts, v")
+        assert r["exceptions"]
+
+    def test_misaligned_keys_error_not_silent_zeroes(self, engine):
+        # off-grid keys must raise, not replace real data with fill (r3)
+        r = engine.execute(
+            f"SET gapfillBucketMs = {HOUR}; SET gapfillStart = 1800000; "
+            "SELECT ts - ts % 3600000, SUM(v) FROM metrics "
+            "GROUP BY ts - ts % 3600000")
+        assert r["exceptions"]
+        assert "aligned" in r["exceptions"][0]["message"]
+
+    def test_zero_fill_keeps_count_integer(self, engine):
+        r = engine.execute(
+            f"SET gapfillBucketMs = {HOUR}; "
+            "SELECT ts - ts % 3600000, COUNT(*) FROM metrics "
+            "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        assert r["resultTable"]["dataSchema"]["columnDataTypes"][1] == "LONG"
+        assert all(isinstance(row[1], int) for row in r["resultTable"]["rows"])
+
+    def test_off_without_option(self, engine):
+        rows = q(engine,
+                 "SELECT ts - ts % 3600000, SUM(v) FROM metrics "
+                 "GROUP BY ts - ts % 3600000 ORDER BY ts - ts % 3600000")
+        assert len(rows) == 4  # only present buckets

@@ -1,0 +1,110 @@
+"""Geospatial functions (geospatial/transform/function/ analogs)."""
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.ops.geo import haversine_m, parse_polygon, st_contains, st_point
+from pinot_tpu.storage.creator import build_segment
+
+CITIES = {
+    "sf": (-122.4194, 37.7749),
+    "oak": (-122.2712, 37.8044),
+    "la": (-118.2437, 34.0522),
+    "nyc": (-74.0060, 40.7128),
+}
+
+
+class TestGeoPrimitives:
+    def test_haversine_known_distance(self):
+        # SF -> LA ~ 559 km
+        d = haversine_m(*CITIES["sf"][::-1][::-1], *CITIES["la"])
+        d = haversine_m(CITIES["sf"][0], CITIES["sf"][1],
+                        CITIES["la"][0], CITIES["la"][1])
+        assert 545_000 < float(d) < 575_000
+
+    def test_point_roundtrip(self):
+        w = st_point(np.array([-122.4194]), np.array([37.7749]))
+        from pinot_tpu.ops.geo import parse_points
+
+        lon, lat = parse_points(w)
+        assert abs(lon[0] + 122.4194) < 1e-6 and abs(lat[0] - 37.7749) < 1e-6
+
+    def test_polygon_contains(self):
+        bay = "POLYGON ((-123 37, -121.5 37, -121.5 38.5, -123 38.5, -123 37))"
+        pts = st_point(np.array([CITIES["sf"][0], CITIES["la"][0]]),
+                       np.array([CITIES["sf"][1], CITIES["la"][1]]))
+        inside = st_contains(bay, pts)
+        assert inside.tolist() == [True, False]
+
+    def test_bad_polygon_raises(self):
+        with pytest.raises(ValueError):
+            parse_polygon("LINESTRING (0 0, 1 1)")
+
+    def test_polygon_column_scalar_point_broadcast(self):
+        # multi-row polygon column against one point must broadcast (r3)
+        sq = "POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"
+        far = "POLYGON ((5 5, 6 5, 6 6, 5 6, 5 5))"
+        out = st_contains(np.array([sq, far]), "POINT (0.5 0.5)")
+        assert out.tolist() == [True, False]
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("geo")
+    names = list(CITIES)
+    lons = np.asarray([CITIES[c][0] for c in names])
+    lats = np.asarray([CITIES[c][1] for c in names])
+    schema = Schema.build(
+        name="places",
+        dimensions=[("city", DataType.STRING)],
+        metrics=[("lon", DataType.DOUBLE), ("lat", DataType.DOUBLE)],
+    )
+    eng = QueryEngine(device_executor=None)
+    seg = build_segment(schema, {"city": np.asarray(names), "lon": lons,
+                                 "lat": lats},
+                        str(tmp / "s"), TableConfig(table_name="places"), "s0")
+    eng.add_segment("places", seg)
+    return eng
+
+
+class TestGeoQueries:
+    def test_distance_filter(self, engine):
+        # within 50km of SF: sf itself and oakland
+        r = engine.execute(
+            "SELECT city FROM places WHERE "
+            "ST_DISTANCE(ST_POINT(lon, lat), "
+            "ST_GEOGFROMTEXT('POINT (-122.4194 37.7749)')) < 50000 "
+            "ORDER BY city")
+        assert [x[0] for x in r["resultTable"]["rows"]] == ["oak", "sf"]
+
+    def test_contains_filter(self, engine):
+        r = engine.execute(
+            "SELECT city FROM places WHERE "
+            "ST_CONTAINS(ST_GEOGFROMTEXT('POLYGON ((-123 37, -121.5 37, "
+            "-121.5 38.5, -123 38.5, -123 37))'), ST_POINT(lon, lat)) "
+            "ORDER BY city")
+        assert [x[0] for x in r["resultTable"]["rows"]] == ["oak", "sf"]
+
+    def test_distance_in_select(self, engine):
+        r = engine.execute(
+            "SELECT city, ST_DISTANCE(ST_POINT(lon, lat), "
+            "ST_GEOGFROMTEXT('POINT (-74.0060 40.7128)')) FROM places "
+            "ORDER BY ST_DISTANCE(ST_POINT(lon, lat), "
+            "ST_GEOGFROMTEXT('POINT (-74.0060 40.7128)')) LIMIT 1")
+        assert r["resultTable"]["rows"][0][0] == "nyc"
+        assert r["resultTable"]["rows"][0][1] < 1.0
+
+    def test_st_within_and_astext(self, engine):
+        r = engine.execute(
+            "SELECT COUNT(*) FROM places WHERE "
+            "ST_WITHIN(ST_POINT(lon, lat), "
+            "ST_GEOGFROMTEXT('POLYGON ((-80 35, -70 35, -70 45, -80 45, -80 35))'))")
+        assert r["resultTable"]["rows"][0][0] == 1  # nyc
+        r = engine.execute(
+            "SELECT ST_ASTEXT(ST_POINT(lon, lat)) FROM places "
+            "WHERE city = 'nyc'")
+        assert r["resultTable"]["rows"][0][0].startswith("POINT (")
