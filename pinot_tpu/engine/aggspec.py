@@ -256,6 +256,23 @@ class DistinctCountHLLSpec(AggSpec):
         return "LONG"
 
 
+class RawHLLSpec(DistinctCountHLLSpec):
+    """DISTINCTCOUNTRAWHLL: serialized registers (base64) instead of the
+    estimate, like the reference's serialized HyperLogLog blob."""
+
+    name = "distinctcountrawhll"
+
+    def finalize(self, part):
+        import base64
+
+        return np.asarray(
+            [base64.b64encode(np.asarray(r, dtype=np.int8).tobytes())
+             .decode("ascii") for r in part["regs"]], dtype=object)
+
+    def result_type(self):
+        return "STRING"
+
+
 class PercentileSpec(AggSpec):
     """Percentile over a mergeable t-digest (merging variant,
     ops/quantile_digest.py) instead of the reference PERCENTILE's raw
@@ -275,7 +292,12 @@ class PercentileSpec(AggSpec):
             raise ValueError(f"{expr.name}(column, p) requires a literal p")
         self.p = float(expr.args[1].value)
         if len(expr.args) >= 3 and expr.args[2].is_literal:
-            self.compression = float(expr.args[2].value)
+            try:
+                self.compression = float(expr.args[2].value)
+            except (TypeError, ValueError):
+                # a parameters STRING third arg (PERCENTILESMARTTDIGEST's
+                # 'threshold=...') is accepted and ignored, not a crash
+                pass
         self.args = expr.args[:1]
 
     def host_groups(self, arg_values, group_idx, n):
@@ -515,6 +537,215 @@ class DistinctCountMVSpec(_MVEntrySpec, DistinctCountSpec):
     sv_base = DistinctCountSpec
 
 
+class SumPrecisionSpec(AggSpec):
+    """SUMPRECISION: exact arbitrary-precision sum
+    (SumPrecisionAggregationFunction / BigDecimal analog) — Python ints and
+    Decimals in object arrays, result as a string like the reference's
+    BigDecimal rendering."""
+
+    name = "sumprecision"
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr)
+        self.args = expr.args[:1]
+
+    @staticmethod
+    def _exact(v):
+        import decimal
+
+        if isinstance(v, int):
+            return v  # already exact: never round-trip through float
+        f = float(v)
+        if f.is_integer():
+            return int(f)
+        return decimal.Decimal(repr(f))
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        sums = _obj_array(n, int)
+        for g, x in zip(group_idx, v.tolist()):
+            sums[g] = sums[g] + self._exact(x)
+        return {"psum": sums}
+
+    def empty(self, n):
+        return {"psum": _obj_array(n, int)}
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            acc["psum"][g] = acc["psum"][g] + part["psum"][i]
+
+    def finalize(self, part):
+        return np.asarray([str(x) for x in part["psum"]], dtype=object)
+
+    def result_type(self):
+        return "STRING"
+
+
+class IdSetSpec(DistinctCountSpec):
+    """IDSET: serialized set of ids (IdSetAggregationFunction analog) —
+    base64(gzip(json(sorted values))) instead of a RoaringBitmap blob.
+    Shares DistinctCountSpec's set-union state algebra; only the final
+    rendering differs."""
+
+    name = "idset"
+
+    def finalize(self, part):
+        import base64
+        import gzip
+        import json
+
+        out = np.empty(len(part["sets"]), dtype=object)
+        for i, s in enumerate(part["sets"]):
+            blob = gzip.compress(
+                json.dumps(sorted(s, key=str)).encode("utf-8"))
+            out[i] = base64.b64encode(blob).decode("ascii")
+        return out
+
+    def result_type(self):
+        return "STRING"
+
+
+class SmartHLLSpec(AggSpec):
+    """DISTINCTCOUNTSMARTHLL: exact set up to a threshold, HLL beyond
+    (DistinctCountSmartHLLAggregationFunction) — the memory-bounding
+    auto-switch, per group. State: ('set', set) or ('hll', registers)."""
+
+    name = "distinctcountsmarthll"
+    DEFAULT_THRESHOLD = 100_000
+
+    def __init__(self, expr: Expression, log2m: int = hll_ops.DEFAULT_LOG2M):
+        super().__init__(expr)
+        self.threshold = self.DEFAULT_THRESHOLD
+        if len(expr.args) > 1 and expr.args[1].is_literal:
+            # reference takes a parameters string; accept a numeric
+            # threshold literal
+            try:
+                self.threshold = int(expr.args[1].value)
+            except (TypeError, ValueError):
+                pass
+        self.log2m = log2m
+        self.args = expr.args[:1]
+
+    def _to_hll(self, s: set) -> np.ndarray:
+        v = np.asarray(list(s))
+        return hll_ops.registers_np(v, np.zeros(len(v), dtype=np.int64),
+                                    1, self.log2m)[0]
+
+    def _shrink(self, state):
+        kind, payload = state
+        if kind == "set" and len(payload) > self.threshold:
+            return ("hll", self._to_hll(payload))
+        return state
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        states = _obj_array(n, lambda: ("set", set()))
+        for g, x in zip(group_idx, v.tolist()):
+            kind, payload = states[g]
+            if kind == "set":
+                payload.add(x)
+        for i in range(n):
+            states[i] = self._shrink(states[i])
+        return {"smart": states}
+
+    def empty(self, n):
+        return {"smart": _obj_array(n, lambda: ("set", set()))}
+
+    def scatter_merge(self, acc, idx, part):
+        for i, g in enumerate(idx):
+            ak, ap = acc["smart"][g]
+            pk, pp = part["smart"][i]
+            if ak == "set" and pk == "set":
+                acc["smart"][g] = self._shrink(("set", ap | pp))
+            elif ak == "hll" and pk == "hll":
+                acc["smart"][g] = ("hll", np.maximum(ap, pp))
+            else:
+                regs = ap if ak == "hll" else pp
+                s = pp if ak == "hll" else ap
+                if s:
+                    regs = np.maximum(regs, self._to_hll(s))
+                acc["smart"][g] = ("hll", regs)
+
+    def finalize(self, part):
+        out = np.zeros(len(part["smart"]), dtype=np.int64)
+        for i, (kind, payload) in enumerate(part["smart"]):
+            out[i] = len(payload) if kind == "set" \
+                else hll_ops.estimate(payload)
+        return out
+
+    def result_type(self):
+        return "LONG"
+
+
+class STUnionSpec(DistinctCountSpec):
+    """ST_UNION over POINT geographies: MULTIPOINT of the distinct points
+    (STUnionAggregationFunction's role; JTS union collapses to the same
+    for point inputs). Set-union state algebra inherited from
+    DistinctCountSpec; only the final rendering differs."""
+
+    name = "stunion"
+
+    def finalize(self, part):
+        from pinot_tpu.ops.geo import parse_points
+
+        out = np.empty(len(part["sets"]), dtype=object)
+        for i, s in enumerate(part["sets"]):
+            lon, lat = parse_points(sorted(str(w) for w in s))
+            pts = ", ".join(f"{x:.10g} {y:.10g}"
+                            for x, y in zip(lon, lat) if not np.isnan(x))
+            out[i] = f"MULTIPOINT ({pts})" if pts else "MULTIPOINT EMPTY"
+        return out
+
+    def result_type(self):
+        return "STRING"
+
+
+class RawDigestPercentileSpec(PercentileTDigestSpec):
+    """PERCENTILERAWTDIGEST/PERCENTILERAWEST: return the serialized digest
+    instead of the quantile (base64 json of (means, weights) — the role of
+    the reference's serialized TDigest/QuantileDigest blobs). Inherits the
+    tdigest family's compression (100)."""
+
+    def finalize(self, part):
+        import base64
+        import json
+
+        out = np.empty(len(part["means"]), dtype=object)
+        for i, (m, w) in enumerate(zip(part["means"], part["weights"])):
+            blob = json.dumps({"means": list(m), "weights": list(w),
+                               "compression": self.compression})
+            out[i] = base64.b64encode(blob.encode("utf-8")).decode("ascii")
+        return out
+
+    def result_type(self):
+        return "STRING"
+
+
+class MinMaxRangeMVSpec(_MVEntrySpec, MinMaxRangeSpec):
+    name = "minmaxrangemv"
+    sv_base = MinMaxRangeSpec
+
+
+class DistinctCountHLLMVSpec(_MVEntrySpec, DistinctCountHLLSpec):
+    name = "distinctcounthllmv"
+    sv_base = DistinctCountHLLSpec
+
+
+class PercentileMVSpec(_MVEntrySpec, PercentileSpec):
+    name = "percentilemv"
+    sv_base = PercentileSpec
+
+
+class PercentileTDigestMVSpec(_MVEntrySpec, PercentileTDigestSpec):
+    name = "percentiletdigestmv"
+    sv_base = PercentileTDigestSpec
+
+
+class RawHLLMVSpec(_MVEntrySpec, RawHLLSpec):
+    name = "distinctcountrawhllmv"
+    sv_base = RawHLLSpec
+
+
 class CountMVSpec(AggSpec):
     """COUNTMV: total MV entries per group (not docs)."""
 
@@ -560,13 +791,30 @@ _SPECS = {
     "percentile": PercentileSpec,
     "percentileest": PercentileSpec,
     "percentiletdigest": PercentileTDigestSpec,
+    "percentilesmarttdigest": PercentileTDigestSpec,
+    "percentilerawest": RawDigestPercentileSpec,
+    "percentilerawtdigest": RawDigestPercentileSpec,
     "mode": ModeSpec,
+    "sumprecision": SumPrecisionSpec,
+    "idset": IdSetSpec,
+    "distinctcountsmarthll": SmartHLLSpec,
+    "fasthll": DistinctCountHLLSpec,  # deprecated legacy alias upstream
+    "distinctcountrawhll": RawHLLSpec,
+    "stunion": STUnionSpec,
+    "st_union": STUnionSpec,
     "summv": SumMVSpec,
     "minmv": MinMVSpec,
     "maxmv": MaxMVSpec,
     "avgmv": AvgMVSpec,
     "countmv": CountMVSpec,
     "distinctcountmv": DistinctCountMVSpec,
+    "distinctcountbitmapmv": DistinctCountMVSpec,  # same exact semantics
+    "minmaxrangemv": MinMaxRangeMVSpec,
+    "distinctcounthllmv": DistinctCountHLLMVSpec,
+    "distinctcountrawhllmv": RawHLLMVSpec,
+    "percentilemv": PercentileMVSpec,
+    "percentileestmv": PercentileMVSpec,
+    "percentiletdigestmv": PercentileTDigestMVSpec,
 }
 
 

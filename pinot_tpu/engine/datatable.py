@@ -87,11 +87,72 @@ def _flatten_obj(name: str, arr: np.ndarray, arrays: dict, meta: dict) -> None:
         arrays[f"{name}__counts"] = np.asarray(counts, dtype=np.int64)
         arrays[f"{name}__offsets"] = offsets
         meta[name] = {"obj": "dict"}
+    elif isinstance(first, (int, _Decimal())):
+        # exact scalars (SUMPRECISION): arbitrary-precision ints/Decimals
+        # ride as decimal strings
+        arrays[f"{name}__values"] = np.asarray([str(x) for x in arr],
+                                               dtype=np.str_)
+        meta[name] = {"obj": "exact_scalar"}
+    elif isinstance(first, tuple) and len(first) == 2 and \
+            first[0] in ("set", "hll"):
+        # SmartHLL tagged union: flag per group + set entries or registers
+        flags = np.zeros(len(arr), dtype=np.int8)
+        offsets = np.zeros(len(arr) + 1, dtype=np.int64)
+        chunks = []
+        m = 0
+        for kind, payload in arr:
+            if kind == "hll":
+                m = max(m, len(payload))
+        regs = np.zeros((len(arr), m), dtype=np.int32)
+        for i, (kind, payload) in enumerate(arr):
+            if kind == "set":
+                vals = sorted(payload, key=repr)
+                chunks.append(np.asarray(vals) if vals else np.empty(0))
+                offsets[i + 1] = offsets[i] + len(vals)
+            else:
+                flags[i] = 1
+                offsets[i + 1] = offsets[i]
+                regs[i, : len(payload)] = payload
+        concat = (np.concatenate([c for c in chunks if len(c)])
+                  if offsets[-1] > 0 else np.empty(0))
+        arrays[f"{name}__values"] = concat
+        arrays[f"{name}__offsets"] = offsets
+        arrays[f"{name}__flags"] = flags
+        arrays[f"{name}__regs"] = regs
+        meta[name] = {"obj": "smart_hll"}
     else:
         raise TypeError(f"unsupported object state in partial: {type(first)}")
 
 
+def _Decimal():
+    import decimal
+
+    return decimal.Decimal
+
+
 def _unflatten_obj(name: str, spec: dict, arrays: dict) -> np.ndarray:
+    if spec["obj"] == "exact_scalar":
+        import decimal
+
+        vals = arrays[f"{name}__values"]
+        out = np.empty(len(vals), dtype=object)
+        for i, s in enumerate(vals.tolist()):
+            out[i] = int(s) if "." not in s and "E" not in s.upper() \
+                else decimal.Decimal(s)
+        return out
+    if spec["obj"] == "smart_hll":
+        offsets = arrays[f"{name}__offsets"]
+        flags = arrays[f"{name}__flags"]
+        regs = arrays[f"{name}__regs"]
+        vals = arrays[f"{name}__values"]
+        n = len(flags)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if flags[i]:
+                out[i] = ("hll", np.asarray(regs[i], dtype=np.int32))
+            else:
+                out[i] = ("set", set(vals[offsets[i]: offsets[i + 1]].tolist()))
+        return out
     offsets = arrays[f"{name}__offsets"]
     n = len(offsets) - 1
     out = np.empty(n, dtype=object)

@@ -105,14 +105,22 @@ AGGREGATION_FUNCTIONS = frozenset(
         "distinctcounthll",
         "distinctcountthetasketch",
         "distinctcountrawthetasketch",
-        "distinctcountsmart",
+        "distinctcountsmarthll",
+        "distinctcountrawhll",
+        "fasthll",
         "segmentpartitioneddistinctcount",
         "percentile",
         "percentileest",
+        "percentilerawest",
         "percentiletdigest",
+        "percentilerawtdigest",
+        "percentilesmarttdigest",
         "mode",
         "firstwithtime",
         "lastwithtime",
+        "idset",
+        "stunion",
+        "st_union",
         # MV variants
         "countmv",
         "summv",
@@ -121,8 +129,12 @@ AGGREGATION_FUNCTIONS = frozenset(
         "avgmv",
         "minmaxrangemv",
         "distinctcountmv",
+        "distinctcountbitmapmv",
         "distinctcounthllmv",
+        "distinctcountrawhllmv",
         "percentilemv",
+        "percentileestmv",
+        "percentiletdigestmv",
     }
 )
 

@@ -1,0 +1,144 @@
+"""Extended aggregation functions: SUMPRECISION, IDSET, smart/raw HLL,
+raw digests, ST_UNION, MV variants (AggregationFunctionType parity)."""
+
+import base64
+import gzip
+import json
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("aggx")
+    schema = Schema.build(
+        name="t",
+        dimensions=[("g", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("lon", DataType.DOUBLE),
+                 ("lat", DataType.DOUBLE)],
+        multi_value_dimensions=[("tags", DataType.STRING),
+                                ("scores", DataType.INT)],
+    )
+    rng = np.random.default_rng(6)
+    n = 3000
+    cols = {
+        "g": np.array(["a", "b"])[rng.integers(0, 2, n)],
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+        "lon": rng.uniform(-10, 10, n).round(3),
+        "lat": rng.uniform(-10, 10, n).round(3),
+        "tags": [list(np.array(["x", "y", "z"])[
+            rng.integers(0, 3, rng.integers(0, 4))]) for _ in range(n)],
+        "scores": [list(rng.integers(0, 50, rng.integers(1, 5)))
+                   for _ in range(n)],
+    }
+    eng = QueryEngine(device_executor=None)
+    # two segments: exercises the merge algebra of every new spec
+    half = n // 2
+    for i, sl in enumerate([slice(0, half), slice(half, n)]):
+        part = {k: (v[sl] if isinstance(v, np.ndarray) else v[sl])
+                for k, v in cols.items()}
+        seg = build_segment(schema, part, str(tmp / f"s{i}"),
+                            TableConfig(table_name="t"), f"s{i}")
+        eng.add_segment("t", seg)
+    return eng, cols
+
+
+def rows(eng, sql):
+    r = eng.execute(sql)
+    assert not r.get("exceptions"), r
+    return r["resultTable"]["rows"]
+
+
+class TestExtendedAggs:
+    def test_sumprecision_exact(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT g, SUMPRECISION(v) FROM t GROUP BY g ORDER BY g")
+        for g, s in got:
+            assert int(s) == int(cols["v"][cols["g"] == g].sum())
+
+    def test_idset_roundtrip(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT IDSET(g) FROM t")
+        decoded = json.loads(gzip.decompress(base64.b64decode(got[0][0])))
+        assert decoded == ["a", "b"]
+
+    def test_smart_hll_exact_below_threshold(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT DISTINCTCOUNTSMARTHLL(v) FROM t")
+        assert got[0][0] == len(np.unique(cols["v"]))  # exact below 100k
+
+    def test_smart_hll_switches_above_threshold(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT DISTINCTCOUNTSMARTHLL(v, 100) FROM t")
+        true = len(np.unique(cols["v"]))
+        assert abs(got[0][0] - true) / true < 0.1  # HLL estimate
+
+    def test_raw_hll_blob(self, engine):
+        eng, _ = engine
+        got = rows(eng, "SELECT DISTINCTCOUNTRAWHLL(g) FROM t")
+        regs = np.frombuffer(base64.b64decode(got[0][0]), dtype=np.int8)
+        assert len(regs) == 1 << 10  # default log2m=10 registers
+
+    def test_raw_tdigest_blob(self, engine):
+        eng, _ = engine
+        got = rows(eng, "SELECT PERCENTILERAWTDIGEST(v, 90) FROM t")
+        d = json.loads(base64.b64decode(got[0][0]))
+        assert d["means"] and d["weights"]
+
+    def test_st_union_multipoint(self, engine):
+        eng, _ = engine
+        got = rows(eng, "SELECT STUNION(ST_POINT(lon, lat)) FROM t "
+                        "WHERE lon < -9.9")
+        assert got[0][0].startswith("MULTIPOINT (")
+
+    def test_mv_variants(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT MINMAXRANGEMV(scores), "
+                        "DISTINCTCOUNTHLLMV(tags) FROM t")
+        flat = np.concatenate([np.asarray(r) for r in cols["scores"] if r])
+        assert got[0][0] == float(flat.max() - flat.min())
+        assert abs(got[0][1] - 3) <= 1  # 3 distinct tags, HLL estimate
+        got = rows(eng, "SELECT g, PERCENTILEMV(scores, 50) FROM t "
+                        "GROUP BY g ORDER BY g")
+        for g, p in got:
+            gf = np.concatenate([np.asarray(r) for r, gg in
+                                 zip(cols["scores"], cols["g"])
+                                 if gg == g and len(r)])
+            assert abs(p - np.percentile(gf, 50)) <= 3
+
+    def test_sumprecision_past_float53(self, tmp_path):
+        """2^53+1 scale values must not round-trip through float (r3)."""
+        schema = Schema.build(name="p", dimensions=[("k", DataType.STRING)],
+                              metrics=[("v", DataType.LONG)])
+        big = np.array([2**53 + 1, 2**53 + 1], dtype=np.int64)
+        eng = QueryEngine(device_executor=None)
+        eng.add_segment("p", build_segment(
+            schema, {"k": np.array(["a", "a"]), "v": big},
+            str(tmp_path / "s"), TableConfig(table_name="p"), "s0"))
+        got = rows(eng, "SELECT SUMPRECISION(v) FROM p")
+        assert int(got[0][0]) == 2 * (2**53 + 1)
+
+    def test_raw_hll_mv_returns_blob(self, engine):
+        eng, _ = engine
+        got = rows(eng, "SELECT DISTINCTCOUNTRAWHLLMV(tags) FROM t")
+        regs = np.frombuffer(base64.b64decode(got[0][0]), dtype=np.int8)
+        assert len(regs) == 1 << 10
+
+    def test_smart_tdigest_parameters_string(self, engine):
+        eng, cols = engine
+        got = rows(eng, "SELECT PERCENTILESMARTTDIGEST(v, 50, "
+                        "'threshold=100') FROM t")
+        assert abs(got[0][0] - np.percentile(cols["v"], 50)) < 30
+
+    def test_fasthll_alias(self, engine):
+        eng, cols = engine
+        a = rows(eng, "SELECT FASTHLL(v) FROM t")[0][0]
+        b = rows(eng, "SELECT DISTINCTCOUNTHLL(v) FROM t")[0][0]
+        assert a == b
