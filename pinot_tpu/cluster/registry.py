@@ -205,6 +205,18 @@ class ClusterRegistry:
 
         self._tx(fn)
 
+    def update_schema(self, table: str, schema: Schema) -> None:
+        """Schema evolution: replace a registered table's schema (the
+        reference's Schema REST update; validation happens at the
+        controller)."""
+
+        def fn(s):
+            if table not in s["schemas"]:
+                raise KeyError(f"table {table!r} not found")
+            s["schemas"][table] = schema.to_json()
+
+        self._tx(fn)
+
     def table_config(self, table: str) -> Optional[TableConfig]:
         d = self._tx_read(lambda s: s["tables"].get(table))
         return None if d is None else TableConfig.from_json(d)

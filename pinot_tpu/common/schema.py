@@ -31,6 +31,13 @@ class FieldSpec:
     def null_value(self):
         if self.default_null is not None:
             return self.default_null
+        # FieldSpec defaults: metrics null to ZERO (additive identity),
+        # dimensions/datetimes to the type's sentinel
+        # (DEFAULT_METRIC_NULL_VALUE_OF_* vs DEFAULT_DIMENSION_*)
+        if self.role is FieldRole.METRIC and \
+                self.data_type.np_dtype is not None and \
+                not self.data_type.is_string_like:
+            return self.data_type.np_dtype.type(0).item()
         return self.data_type.default_null
 
     def to_json(self) -> dict:

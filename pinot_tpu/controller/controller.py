@@ -136,6 +136,35 @@ class Controller:
     def drop_table(self, table: str) -> None:
         self.registry.drop_table(table)
 
+    def update_schema(self, table: str, schema: Schema) -> None:
+        """Additive schema evolution (SchemaUtils.validate backward-compat
+        rules): new columns may be added; existing columns must keep their
+        type and single/multi-value shape. Servers pick up the new schema
+        on their next sync tick and synthesize default values for columns
+        absent from old segments."""
+        # hybrid tables evolve BOTH physical variants in step — a stale
+        # realtime schema would serve KeyErrors for the new columns
+        keys = [k for k in (table, f"{table}_OFFLINE", f"{table}_REALTIME")
+                if self.registry.table_schema(k) is not None]
+        if not keys:
+            raise KeyError(f"table {table!r} not found")
+        for key in keys:
+            old = self.registry.table_schema(key)
+            for name in old.column_names():
+                new_field = schema.fields.get(name)
+                if new_field is None:
+                    raise ValueError(
+                        f"schema evolution cannot drop column {name!r}")
+                old_field = old.field(name)
+                if new_field.data_type is not old_field.data_type or \
+                        new_field.single_value != old_field.single_value or \
+                        new_field.role is not old_field.role:
+                    raise ValueError(
+                        f"schema evolution cannot change column {name!r} "
+                        f"(type/shape/role must stay fixed)")
+        for key in keys:
+            self.registry.update_schema(key, schema)
+
     def _realtime_replication(self, config: TableConfig) -> int:
         """Replica consumers per partition. Upsert tables pin to 1: each
         replica maintains independent validDocIds state, and adopted

@@ -126,6 +126,10 @@ class SegmentEvaluator:
         if expr.is_identifier:
             if expr.name.startswith("$"):
                 return self._virtual_column(expr.name)
+            if expr.name not in self.seg.metadata.columns:
+                evolved = self._evolved_default(expr.name)
+                if evolved is not None:
+                    return evolved
             return np.asarray(self.seg.values(expr.name))[: self.n]
         if expr.name == "lookup":
             return self._lookup(expr)
@@ -159,6 +163,39 @@ class SegmentEvaluator:
             return np.asarray(mapping.get(keys.item(), default))
         out = [mapping.get(k, default) for k in keys.tolist()]
         return np.asarray(out)
+
+    def _evolved_spec(self, name: str):
+        """FieldSpec for a schema-evolved column this segment predates
+        (present in the attached table schema, absent from the segment),
+        or None. Cheap membership check — no allocation."""
+        if name in self.seg.metadata.columns:
+            return None
+        schema = getattr(self.seg, "table_schema", None)
+        if schema is None:
+            return None
+        return getattr(schema, "fields", {}).get(name)
+
+    def _evolved_default(self, name: str):
+        """Default-filled column for a schema-evolved column (the reference
+        synthesizes default null values for columns added after a segment
+        was built, post reload), or None."""
+        spec = self._evolved_spec(name)
+        if spec is None:
+            return None
+        if not spec.single_value:
+            out = np.empty(self.n, dtype=object)
+            for i in range(self.n):
+                out[i] = np.empty(0, dtype=spec.data_type.np_dtype)
+            return out
+        return np.full(self.n, spec.null_value())
+
+    def is_mv_column(self, name: str) -> bool:
+        """MV-ness of a column, consulting the evolved schema for columns
+        the segment predates."""
+        if name in self.seg.metadata.columns:
+            return not self.seg.column_metadata(name).single_value
+        spec = self._evolved_spec(name)
+        return spec is not None and not spec.single_value
 
     def _virtual_column(self, name: str) -> np.ndarray:
         """Built-in virtual columns (segment/virtualcolumn/ analog:
@@ -206,6 +243,11 @@ class SegmentEvaluator:
         ``flat`` is dict ids when a dictionary exists, else raw values.
         The vectorized MV read path (FixedBitMVForwardIndexReader analog)."""
         seg = self.seg
+        spec = self._evolved_spec(col)
+        if spec is not None:
+            # schema-evolved MV column: every doc has zero entries
+            return (np.empty(0, dtype=spec.data_type.np_dtype),
+                    np.zeros(self.n, dtype=np.int64), None)
         meta = seg.column_metadata(col)
         if hasattr(seg, "mv_offsets") and not getattr(seg, "is_mutable", False):
             off = np.asarray(seg.mv_offsets(col))[: self.n + 1]
@@ -261,6 +303,11 @@ class SegmentEvaluator:
             return self._json_match_mask(p)
         if p.type is PredicateType.TEXT_MATCH:
             return self._text_match_mask(p)
+        if lhs.is_identifier and lhs.name not in self.seg.metadata.columns \
+                and self.is_mv_column(lhs.name) and \
+                p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
+            # evolved MV column: zero entries per doc, match-any matches none
+            return np.zeros(self.n, dtype=bool)
         # dictionary-space fast path
         if lhs.is_identifier and lhs.name in self.seg.metadata.columns:
             meta = self.seg.column_metadata(lhs.name)
@@ -288,7 +335,13 @@ class SegmentEvaluator:
             # per-column bitmap. Expressions over columns are never null
             # (defaults flow through), matching basic null handling.
             null_mask = np.zeros(self.n, dtype=bool)
-            if lhs.is_identifier and hasattr(self.seg, "null_vector"):
+            if lhs.is_identifier and lhs.name not in self.seg.metadata.columns:
+                if self._evolved_spec(lhs.name) is None:
+                    # unknown column: an error, not a silent all/none match
+                    raise KeyError(f"column {lhs.name!r} not found")
+                # schema-evolved column this segment predates: all null
+                null_mask[:] = True
+            elif lhs.is_identifier and hasattr(self.seg, "null_vector"):
                 nv = self.seg.null_vector(lhs.name)
                 if nv is not None:
                     nv = np.asarray(nv)[: self.n]
@@ -549,8 +602,7 @@ class HostExecutor:
         rep = np.arange(len(doc_idx))
         mv_vals: dict = {}
         for gi, g in enumerate(group_exprs):
-            if not (g.is_identifier and g.name in ev.seg.metadata.columns
-                    and not ev.seg.column_metadata(g.name).single_value):
+            if not (g.is_identifier and ev.is_mv_column(g.name)):
                 continue
             flat, lens, d = ev.mv_parts(g.name)
             off = np.zeros(len(lens) + 1, dtype=np.int64)
@@ -569,9 +621,7 @@ class HostExecutor:
 
     def _group_by(self, q, ev, doc_idx, stats, aggs) -> IntermediateResult:
         has_mv = any(
-            g.is_identifier and g.name in ev.seg.metadata.columns
-            and not ev.seg.column_metadata(g.name).single_value
-            for g in q.group_by
+            g.is_identifier and ev.is_mv_column(g.name) for g in q.group_by
         )
         if has_mv:
             rep, mv_vals = self._expand_mv_groups(ev, q.group_by, doc_idx)

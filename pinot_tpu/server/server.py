@@ -346,6 +346,7 @@ class ServerInstance:
                 cfg = self.registry.table_config(table)
                 if cfg is not None:
                     tdm.is_dim_table = cfg.is_dim_table
+            table_schema = self.registry.table_schema(table)
             if tdm.on_unload is None:
                 tdm.on_unload = (
                     lambda seg, _tdm=tdm: self._on_segment_unload(_tdm, seg))
@@ -371,12 +372,23 @@ class ServerInstance:
                     else:
                         continue
                 try:
-                    tdm.add_segment(
-                        ImmutableSegment(self._download_segment(table, rec))
-                    )
+                    seg = ImmutableSegment(self._download_segment(table, rec))
+                    if table_schema is not None:
+                        seg.table_schema = table_schema
+                    tdm.add_segment(seg)
                 except Exception:
                     log.exception("failed to load segment %s from %s",
                                   name, rec.location)
+        # schema evolution: EVERY hosted segment — offline downloads,
+        # sealed realtime, and consuming mutables — carries the CURRENT
+        # table schema so queries over columns added after a segment was
+        # built synthesize default values (reference: segment reload after
+        # a Schema REST update)
+        for table, tdm in list(self.engine.tables.items()):
+            table_schema = self.registry.table_schema(table)
+            if table_schema is not None:
+                for seg in list(tdm.segments.values()):
+                    seg.table_schema = table_schema
         # unload segments no longer assigned (ONLINE→OFFLINE/DROPPED);
         # consuming (mutable) segments belong to the realtime managers
         for table, tdm in list(self.engine.tables.items()):

@@ -57,7 +57,9 @@ class TestNullVectors:
         assert seg.null_vector("f") is None
         # forward index stores substituted defaults
         assert seg.values("k")[1] == DataType.STRING.default_null
-        assert int(seg.values("v")[2]) == DataType.LONG.default_null
+        # metric null defaults are ZERO (reference
+        # DEFAULT_METRIC_NULL_VALUE_OF_LONG), dimensions use the sentinel
+        assert int(seg.values("v")[2]) == 0
 
     def test_is_null_predicates(self, tmp_path):
         engine = _engine_with(self._seg(tmp_path))

@@ -1,0 +1,174 @@
+"""Additive schema evolution: new columns over pre-existing segments.
+
+Reference analogs: Schema REST update + SchemaUtils backward-compat
+validation + segment reload synthesizing default null values for columns
+a segment predates.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+
+def wait_until(cond, timeout=15.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _v1_schema():
+    return Schema.build(name="emps",
+                        dimensions=[("name", DataType.STRING)],
+                        metrics=[("salary", DataType.LONG)])
+
+
+def _v2_schema():
+    return Schema.build(name="emps",
+                        dimensions=[("name", DataType.STRING),
+                                    ("region", DataType.STRING)],
+                        metrics=[("salary", DataType.LONG),
+                                 ("bonus", DataType.LONG)])
+
+
+class TestSchemaEvolution:
+    def test_add_columns_defaults_over_old_segments(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                                device_executor=None)
+        server.start()
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            cfg = TableConfig(table_name="emps")
+            controller.add_table(cfg, _v1_schema())
+            build_segment(_v1_schema(),
+                          {"name": np.array(["ann", "bob"]),
+                           "salary": np.array([100, 200], dtype=np.int64)},
+                          str(tmp_path / "u0"), cfg, "old0")
+            controller.upload_segment("emps", str(tmp_path / "u0"))
+            assert wait_until(
+                lambda: len(registry.external_view("emps_OFFLINE")) == 1)
+
+            controller.update_schema("emps", _v2_schema())
+            # a new segment built WITH the evolved columns
+            build_segment(_v2_schema(),
+                          {"name": np.array(["cat"]),
+                           "region": np.array(["emea"]),
+                           "salary": np.array([300], dtype=np.int64),
+                           "bonus": np.array([30], dtype=np.int64)},
+                          str(tmp_path / "u1"), cfg, "new0")
+            controller.upload_segment("emps", str(tmp_path / "u1"))
+            assert wait_until(
+                lambda: len(registry.external_view("emps_OFFLINE")) == 2)
+            # old segment must have picked up the evolved schema
+            assert wait_until(lambda: all(
+                getattr(s, "table_schema", None) is not None
+                and "bonus" in s.table_schema.fields
+                for s in server.engine.tables["emps_OFFLINE"].segments.values()))
+
+            r = broker.execute(
+                "SELECT name, region, salary, bonus FROM emps ORDER BY name")
+            assert not r.get("exceptions"), r
+            # dimension default null is "null", metric default is 0 (reference
+            # FieldSpec defaults)
+            assert r["resultTable"]["rows"] == [
+                ["ann", "null", 100, 0], ["bob", "null", 200, 0],
+                ["cat", "emea", 300, 30]]
+
+            r = broker.execute("SELECT SUM(bonus), COUNT(*) FROM emps")
+            assert r["resultTable"]["rows"] == [[30, 3]]
+
+            # old-segment rows are NULL for the evolved column
+            r = broker.execute(
+                "SELECT COUNT(*) FROM emps WHERE region IS NULL")
+            assert r["resultTable"]["rows"][0][0] == 2
+            r = broker.execute(
+                "SELECT region, SUM(salary) FROM emps GROUP BY region "
+                "ORDER BY region")
+            assert r["resultTable"]["rows"] == [["emea", 300], ["null", 300]]
+        finally:
+            broker.close()
+            server.stop()
+
+    def test_evolved_mv_column_and_unknown_column(self, tmp_path):
+        """Evolved MV columns have zero entries per doc (predicates match
+        nothing, MV aggs see no entries); a column in NEITHER segment nor
+        schema errors instead of silently matching (r3 review)."""
+        from pinot_tpu.engine.engine import QueryEngine
+
+        eng = QueryEngine(device_executor=None)
+        seg = build_segment(_v1_schema(),
+                            {"name": np.array(["ann"]),
+                             "salary": np.array([1], dtype=np.int64)},
+                            str(tmp_path / "s"), TableConfig(table_name="emps"),
+                            "s0")
+        seg.table_schema = Schema.build(
+            name="emps",
+            dimensions=[("name", DataType.STRING)],
+            metrics=[("salary", DataType.LONG)],
+            multi_value_dimensions=[("tags", DataType.STRING)])
+        eng.add_segment("emps", seg)
+        r = eng.execute("SELECT COUNT(*) FROM emps WHERE tags = 'x'")
+        assert r["resultTable"]["rows"] == [[0]]
+        r = eng.execute("SELECT COUNTMV(tags) FROM emps")
+        assert r["resultTable"]["rows"] == [[0]]
+        r = eng.execute("SELECT COUNT(*) FROM emps WHERE tags IS NULL")
+        assert r["resultTable"]["rows"] == [[1]]
+        # unknown everywhere: error, not a silent zero/all match
+        r = eng.execute("SELECT COUNT(*) FROM emps WHERE nope IS NOT NULL")
+        assert r["exceptions"]
+
+    def test_hybrid_evolution_updates_both_variants(self, tmp_path):
+        from pinot_tpu.common.table_config import StreamConfig, TableType
+        from pinot_tpu.stream.memory_stream import TopicRegistry
+
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                                device_executor=None)
+        server.start()
+        try:
+            TopicRegistry.delete("emps_evo")
+            TopicRegistry.create("emps_evo", 1)
+            controller.add_table(TableConfig(table_name="emps"), _v1_schema())
+            controller.add_table(
+                TableConfig(table_name="emps", table_type=TableType.REALTIME,
+                            stream=StreamConfig(stream_type="memory",
+                                                topic="emps_evo",
+                                                decoder="json")),
+                _v1_schema())
+            controller.update_schema("emps", _v2_schema())
+            assert "bonus" in registry.table_schema("emps_OFFLINE").fields
+            assert "bonus" in registry.table_schema("emps_REALTIME").fields
+        finally:
+            server.stop()
+
+    def test_rejects_drops_and_type_changes(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        cfg = TableConfig(table_name="emps")
+        controller.add_table(cfg, _v1_schema())
+        with pytest.raises(ValueError, match="drop"):
+            controller.update_schema(
+                "emps", Schema.build(name="emps",
+                                     metrics=[("salary", DataType.LONG)]))
+        with pytest.raises(ValueError, match="type/shape"):
+            controller.update_schema(
+                "emps", Schema.build(name="emps",
+                                     dimensions=[("name", DataType.STRING)],
+                                     metrics=[("salary", DataType.DOUBLE)]))
+        with pytest.raises(KeyError):
+            controller.update_schema("nope", _v1_schema())
