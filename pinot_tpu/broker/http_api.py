@@ -4,18 +4,26 @@ Equivalent of pinot-broker/.../api/resources/PinotClientRequest.java (the
 jersey resource brokering HTTP to BaseBrokerRequestHandler) — stdlib
 ThreadingHTTPServer; each request body is {"sql": "..."} and the response is
 the BrokerResponse JSON. /health mirrors the reference's health resource.
+
+Auth (BasicAuthAccessControlFactory analog): pass ``users`` as
+{username: password} to require HTTP Basic credentials on the query
+endpoints; /health stays open like the reference's health resource.
 """
 
 from __future__ import annotations
 
+import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 
 class BrokerHttpServer:
-    def __init__(self, broker, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, broker, host: str = "127.0.0.1", port: int = 0,
+                 users: Optional[dict] = None):
         self.broker = broker
+        self._users = dict(users) if users else None
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -33,7 +41,13 @@ class BrokerHttpServer:
             def do_GET(self):
                 if self.path == "/health":
                     self._send(200, {"status": "OK"})
-                elif self.path == "/metrics":
+                    return
+                # everything beyond /health requires credentials when auth
+                # is enabled (metrics leak query/table statistics)
+                if not self._authorized():
+                    self._reject_unauthorized()
+                    return
+                if self.path == "/metrics":
                     from pinot_tpu.common.metrics import all_snapshots
 
                     self._send(200, all_snapshots())
@@ -50,9 +64,39 @@ class BrokerHttpServer:
                 else:
                     self._send(404, {"error": "not found"})
 
+            def _authorized(self) -> bool:
+                if outer._users is None:
+                    return True
+                header = self.headers.get("Authorization", "")
+                if header.startswith("Basic "):
+                    try:
+                        raw = base64.b64decode(header[6:]).decode("utf-8")
+                        user, _, pw = raw.partition(":")
+                    except Exception:  # noqa: BLE001 — malformed header
+                        return False
+                    import hmac
+
+                    # bytes-compare (str compare_digest rejects non-ASCII)
+                    # against a dummy for unknown users so timing doesn't
+                    # enumerate usernames
+                    expected = outer._users.get(user)
+                    known = expected is not None
+                    ref = (expected if known else "\x00dummy").encode("utf-8")
+                    return hmac.compare_digest(pw.encode("utf-8"), ref) and known
+                return False
+
+            def _reject_unauthorized(self) -> None:
+                self.send_response(401)
+                self.send_header("WWW-Authenticate", 'Basic realm="pinot-tpu"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
             def do_POST(self):
                 if self.path not in ("/query/sql", "/query"):
                     self._send(404, {"error": "not found"})
+                    return
+                if not self._authorized():
+                    self._reject_unauthorized()
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))

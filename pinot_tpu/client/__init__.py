@@ -18,6 +18,7 @@ on the cursor. Read-only by design — DML raises, like the reference.
 from __future__ import annotations
 
 import json
+import urllib.error
 import urllib.request
 from typing import Optional
 
@@ -152,11 +153,20 @@ def _quote(v) -> str:
 
 class Connection:
     def __init__(self, broker_url: Optional[str] = None, broker=None,
-                 registry=None, timeout_s: float = 30.0):
+                 registry=None, timeout_s: float = 30.0, auth=None):
+        """``auth``: optional (username, password) for brokers running
+        with HTTP Basic auth."""
         if broker_url is None and broker is None and registry is None:
             raise ProgrammingError(
                 "connect() needs a broker_url, a Broker, or a registry")
         self._url = broker_url.rstrip("/") if broker_url else None
+        self._auth_header = None
+        if auth is not None:
+            import base64
+
+            cred = base64.b64encode(
+                f"{auth[0]}:{auth[1]}".encode("utf-8")).decode("ascii")
+            self._auth_header = f"Basic {cred}"
         self._broker = broker
         self._owns_broker = False
         if self._broker is None and registry is not None:
@@ -172,16 +182,25 @@ class Connection:
             raise ProgrammingError("connection is closed")
         if self._broker is not None:
             return self._broker.execute(sql)
+        headers = {"Content-Type": "application/json"}
+        if self._auth_header:
+            headers["Authorization"] = self._auth_header
         req = urllib.request.Request(
             self._url + "/query/sql",
             data=json.dumps({"sql": sql}).encode("utf-8"),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
                 return json.loads(resp.read())
         except Error:
             raise
+        except urllib.error.HTTPError as e:
+            if e.code == 401:
+                raise DatabaseError(
+                    "authentication failed (HTTP 401): check the "
+                    "connection's auth=(user, password)") from e
+            raise DatabaseError(f"broker returned HTTP {e.code}") from e
         except Exception as e:  # noqa: BLE001 — transport failure
             raise DatabaseError(f"broker unreachable: {e}") from e
 

@@ -439,7 +439,10 @@ class DeviceExecutor:
     # cheap static check (EXPLAIN backend display)
     def supports(self, q: QueryContext) -> bool:
         aggs = q.aggregations()
-        if q.distinct or not aggs:
+        if q.distinct:
+            return not aggs and all(e.is_identifier
+                                    for e in q.select_expressions)
+        if not aggs:
             return False
         return all(a.name in DEVICE_AGGS for a in aggs)
 
@@ -517,8 +520,16 @@ class DeviceExecutor:
 
     def _execute(self, q: QueryContext, segments) -> IntermediateResult:
         aggs = q.aggregations()
-        if q.distinct or not aggs:
-            raise DeviceUnsupported("selection/distinct on host path")
+        if q.distinct:
+            # DISTINCT == group-by over the select columns with no aggs:
+            # the dense/sorted group machinery yields the distinct combos
+            # (the reference's DistinctAggregationFunction is the same
+            # group-keys-only special case)
+            if aggs:
+                raise DeviceUnsupported("DISTINCT over aggregations")
+            aggs = []
+        elif not aggs:
+            raise DeviceUnsupported("selection on host path")
         for a in aggs:
             if a.name not in DEVICE_AGGS:
                 raise DeviceUnsupported(f"agg {a.name}")
@@ -535,10 +546,11 @@ class DeviceExecutor:
         )
 
         group_cols, group_cards = (), ()
-        if q.group_by:
+        group_exprs = q.select_expressions if q.distinct else q.group_by
+        if group_exprs:
             gcols = []
             gcards = []
-            for g in q.group_by:
+            for g in group_exprs:
                 if not g.is_identifier or ctx.encoding(g.name) != Encoding.DICT:
                     raise DeviceUnsupported("group-by must be dict columns on device")
                 gcols.append(g.name)
@@ -547,6 +559,8 @@ class DeviceExecutor:
             total = 1
             for c in group_cards:
                 total *= c
+        elif q.distinct:
+            raise DeviceUnsupported("DISTINCT needs dict columns on device")
 
         agg_tpls = tuple(
             self._agg_template(i, a, ctx, params, counter) for i, a in enumerate(aggs)
@@ -701,6 +715,9 @@ class DeviceExecutor:
         key_values = tuple(
             ctx.global_dict(col).take(k) for col, k in zip(group_cols, keys)
         )
+        if q.distinct:
+            return IntermediateResult(
+                "distinct", group_keys=key_values, stats=stats)
         partials = [
             self._group_partial(i, t, outs, ctx, present) for i, t in enumerate(agg_tpls)
         ]

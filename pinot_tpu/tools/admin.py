@@ -85,7 +85,18 @@ def cmd_start_broker(args) -> int:
     # compile (~20-40s) before the template cache warms up
     broker = Broker(_registry(args.registry), broker_id=args.id,
                     timeout_s=args.timeout_s)
-    http = BrokerHttpServer(broker, host=args.host, port=args.port)
+    users = None
+    if args.auth:
+        users = {}
+        for a in args.auth:
+            if ":" not in a:
+                print(f"--auth expects user:password, got {a!r}",
+                      file=sys.stderr)
+                return 2
+            u, _, p = a.partition(":")
+            users[u] = p
+    http = BrokerHttpServer(broker, host=args.host, port=args.port,
+                            users=users)
     http.start()
     print(f"broker {args.id} serving {http.url}/query/sql")
     _block()
@@ -192,6 +203,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1",
                     help="HTTP bind host (0.0.0.0 in containers)")
     sp.add_argument("--port", type=int, default=8099)
+    sp.add_argument("--auth", action="append",
+                    help="user:password (repeatable); enables HTTP basic "
+                         "auth on the query endpoints")
     sp.add_argument("--timeout-s", type=float, default=60.0)
     sp.set_defaults(fn=cmd_start_broker)
 

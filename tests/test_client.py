@@ -147,3 +147,53 @@ class TestClient:
         conn.close()
         with pytest.raises(ProgrammingError, match="closed"):
             conn.cursor()
+
+class TestBasicAuth:
+    """Broker HTTP basic auth (BasicAuthAccessControlFactory analog)."""
+
+    def test_auth_required_and_accepted(self, cluster, tmp_path):
+        registry, broker, _ = cluster
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+
+        http = BrokerHttpServer(broker, users={"admin": "s3cret"})
+        http.start()
+        try:
+            # no credentials: 401 surfaces as a DatabaseError
+            with connect(http.url) as conn:
+                with pytest.raises(DatabaseError):
+                    conn.cursor().execute("SELECT COUNT(*) FROM cities")
+            # wrong password: rejected
+            with connect(http.url, auth=("admin", "wrong")) as conn:
+                with pytest.raises(DatabaseError):
+                    conn.cursor().execute("SELECT COUNT(*) FROM cities")
+            # correct credentials: served
+            with connect(http.url, auth=("admin", "s3cret")) as conn:
+                cur = conn.cursor().execute("SELECT COUNT(*) FROM cities")
+                assert cur.fetchone() == (4,)
+            # /health stays open; /metrics is gated (r3 review)
+            import urllib.error
+            import urllib.request
+
+            with urllib.request.urlopen(http.url + "/health") as resp:
+                assert resp.status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(http.url + "/metrics")
+            assert ei.value.code == 401
+        finally:
+            http.stop()
+
+    def test_non_ascii_password(self, cluster):
+        registry, broker, _ = cluster
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+
+        http = BrokerHttpServer(broker, users={"admin": "päss"})
+        http.start()
+        try:
+            with connect(http.url, auth=("admin", "päss")) as conn:
+                assert conn.cursor().execute(
+                    "SELECT COUNT(*) FROM cities").fetchone() == (4,)
+            with connect(http.url, auth=("admin", "wrong")) as conn:
+                with pytest.raises(DatabaseError, match="authentication"):
+                    conn.cursor().execute("SELECT COUNT(*) FROM cities")
+        finally:
+            http.stop()

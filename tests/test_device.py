@@ -291,3 +291,27 @@ class TestSortedHighCardGroupBy:
         shapes = {t[0] for (t, _m) in dev.device._pipelines}
         assert "groupby_sorted" in shapes
         assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+
+class TestDeviceDistinct:
+    """SELECT DISTINCT executes as group-keys-only on the device
+    (DistinctAggregationFunction analog)."""
+
+    def test_distinct_parity_and_device_used(self, engines):
+        dev, host, _ = engines
+        for sql in (
+            "SELECT DISTINCT dim2 FROM t ORDER BY dim2",
+            "SELECT DISTINCT dim1, dim2 FROM t ORDER BY dim1, dim2 LIMIT 500",
+            "SELECT DISTINCT dim1 FROM t WHERE ivalue > 9000 ORDER BY dim1",
+        ):
+            rd, rh = dev.execute(sql), host.execute(sql)
+            assert not rd.get("exceptions"), rd
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+        shapes = {t[0] for (t, _m) in dev.device._pipelines}
+        assert "groupby" in shapes
+
+    def test_distinct_expression_falls_back(self, engines):
+        dev, host, _ = engines
+        sql = "SELECT DISTINCT ivalue + 1 FROM t ORDER BY ivalue + 1 LIMIT 5"
+        rd, rh = dev.execute(sql), host.execute(sql)
+        assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
