@@ -145,6 +145,8 @@ def create_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
     # built-ins register lazily so importing the SPI stays dependency-free
     if config.stream_type == "memory" and "memory" not in _FACTORIES:
         from pinot_tpu.stream import memory_stream  # noqa: F401  (registers)
+    if config.stream_type == "kafka" and "kafka" not in _FACTORIES:
+        from pinot_tpu.stream import kafka_stream  # noqa: F401  (registers)
     try:
         cls = _FACTORIES[config.stream_type]
     except KeyError:
