@@ -58,6 +58,28 @@ class SegmentPartitionConfig:
 
 
 @dataclasses.dataclass
+class TransformConfig:
+    """One ingest-time derived column (ingestion TransformConfig analog):
+    ``transform_function`` is a SQL expression over source record fields
+    (which need not be schema columns), evaluated by the engine's own
+    function registry instead of Groovy."""
+
+    column_name: str
+    transform_function: str
+
+
+@dataclasses.dataclass
+class IngestionConfig:
+    """Ingestion-time record shaping (spi config/table/ingestion analog):
+    transforms run first, then rows where ``filter_function`` evaluates
+    true are DROPPED (FilterConfig semantics)."""
+
+    transform_configs: list[TransformConfig] = dataclasses.field(
+        default_factory=list)
+    filter_function: Optional[str] = None
+
+
+@dataclasses.dataclass
 class QuotaConfig:
     """Per-table query quota (spi/config/table/QuotaConfig analog):
     max queries per second enforced broker-side."""
@@ -99,6 +121,8 @@ class TableConfig:
     partition: SegmentPartitionConfig = dataclasses.field(default_factory=SegmentPartitionConfig)
     upsert: UpsertConfig = dataclasses.field(default_factory=UpsertConfig)
     quota: QuotaConfig = dataclasses.field(default_factory=QuotaConfig)
+    ingestion: IngestionConfig = dataclasses.field(
+        default_factory=IngestionConfig)
     stream: Optional[StreamConfig] = None
     # Minion task configs keyed by task type (TableTaskConfig analog), e.g.
     # {"MergeRollupTask": {"max_docs_per_segment": 1_000_000}}
@@ -148,6 +172,12 @@ class TableConfig:
             obj["upsert"] = UpsertConfig(**obj["upsert"])
         if "quota" in obj and isinstance(obj["quota"], dict):
             obj["quota"] = QuotaConfig(**obj["quota"])
+        if "ingestion" in obj and isinstance(obj["ingestion"], dict):
+            ing = dict(obj["ingestion"])
+            ing["transform_configs"] = [
+                TransformConfig(**t) for t in ing.get("transform_configs", [])
+            ]
+            obj["ingestion"] = IngestionConfig(**ing)
         if obj.get("stream") is not None and isinstance(obj["stream"], dict):
             obj["stream"] = StreamConfig(**obj["stream"])
         return cls(**obj)

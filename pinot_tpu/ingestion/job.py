@@ -64,9 +64,30 @@ def run_ingestion_job(spec: IngestionJobSpec, controller) -> list:
     reader = create_record_reader(spec.format, **spec.reader_props)
     out_root = spec.output_dir or os.path.join(spec.input_dir, "_segments")
     prefix = spec.segment_name_prefix or table_cfg.table_name
+    from pinot_tpu.ingestion.readers import rows_to_columns
+    from pinot_tpu.ingestion.transform import RecordTransformer
+
+    transformer = RecordTransformer(table_cfg)
     built = []
     for seq, path in enumerate(files):
-        columns = reader.read_columns(path, schema)
+        if transformer.active:
+            try:
+                rows = reader.read_rows(path)
+            except NotImplementedError:
+                # column-only RecordReader plugins (the SPI's minimum
+                # surface): reconstruct rows from the schema columns —
+                # transforms then can't see source-only fields, which such
+                # a reader could never expose anyway
+                raw_cols = reader.read_columns(path, schema)
+                names = list(raw_cols)
+                rows = [dict(zip(names, vals))
+                        for vals in zip(*raw_cols.values())] if names else []
+            rows = transformer.apply_rows(rows)
+            columns = rows_to_columns(
+                rows, schema,
+                mv_delimiter=spec.reader_props.get("mv_delimiter", ";"))
+        else:
+            columns = reader.read_columns(path, schema)
         name = f"{prefix}_{seq}"
         seg_dir = os.path.join(out_root, name)
         build_segment(schema, columns, seg_dir, table_cfg, name)

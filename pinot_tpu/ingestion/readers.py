@@ -95,25 +95,37 @@ class JSONRecordReader(RecordReader):
 
 
 class ParquetRecordReader(RecordReader):
-    """Gated: needs pyarrow, which this build does not ship."""
+    """Columnar Parquet via pyarrow (pinot-parquet analog); gated on the
+    optional pyarrow dependency."""
 
     def read_rows(self, path: str) -> list:
         try:
-            import pyarrow.parquet as pq  # noqa: F401
+            import pyarrow.parquet as pq
         except ImportError as e:
             raise RuntimeError(
-                "parquet input requires pyarrow, which is not available in "
-                "this environment; convert to CSV/JSON or install pyarrow"
-            ) from e
-        import pyarrow.parquet as pq
-
+                "parquet input requires pyarrow; convert to CSV/JSON or "
+                "install pyarrow") from e
         return pq.read_table(path).to_pylist()
+
+
+class ORCRecordReader(RecordReader):
+    """ORC via pyarrow (pinot-orc analog); gated like Parquet."""
+
+    def read_rows(self, path: str) -> list:
+        try:
+            import pyarrow.orc as orc
+        except ImportError as e:
+            raise RuntimeError(
+                "orc input requires pyarrow; convert to CSV/JSON or "
+                "install pyarrow") from e
+        return orc.ORCFile(path).read().to_pylist()
 
 
 _READERS = {
     "csv": CSVRecordReader,
     "json": JSONRecordReader,
     "parquet": ParquetRecordReader,
+    "orc": ORCRecordReader,
 }
 
 

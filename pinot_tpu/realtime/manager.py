@@ -25,6 +25,7 @@ from typing import Callable, Optional
 
 from pinot_tpu.common.schema import Schema
 from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.ingestion.transform import TransformError
 from pinot_tpu.realtime import merger
 from pinot_tpu.realtime.upsert import PartitionUpsertMetadataManager
 from pinot_tpu.storage.mutable import MutableSegment
@@ -132,6 +133,9 @@ class RealtimePartitionManager:
         self.on_consuming_segment = on_consuming_segment
         self.on_committed_segment = on_committed_segment
         self.upsert = upsert_manager
+        from pinot_tpu.ingestion.transform import RecordTransformer
+
+        self.record_transformer = RecordTransformer(table_config)
         self.partial_merger = None
         if upsert_manager is not None and table_config.upsert.mode == "PARTIAL":
             self.partial_merger = merger.PartialUpsertMerger(
@@ -210,10 +214,15 @@ class RealtimePartitionManager:
                 for msg in batch.messages:
                     # poison messages must not wedge the partition: skip and
                     # count (the reference skips undecodable rows the same
-                    # way); the offset still advances past them
+                    # way); the offset still advances past them. Transform
+                    # failures are CONFIG bugs, not bad data — those kill
+                    # the partition loudly (ERROR state) instead of
+                    # silently draining the stream
                     try:
                         row = self.decoder(msg.payload)
                         self._index_row(row, msg)
+                    except TransformError:
+                        raise
                     except Exception as e:  # noqa: BLE001
                         self.index_errors += 1
                         if self.index_errors <= 10 or self.index_errors % 1000 == 0:
@@ -237,6 +246,10 @@ class RealtimePartitionManager:
             consumer.close()
 
     def _index_row(self, row: dict, msg) -> None:
+        if self.record_transformer.active:
+            row = self.record_transformer.apply_row(row)
+            if row is None:
+                return  # filter_function dropped the record
         if self.upsert is not None:
             key = tuple(row[k] for k in self.schema.primary_key_columns)
             cmp_col = self.upsert.comparison_column
