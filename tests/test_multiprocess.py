@@ -1,0 +1,141 @@
+"""True multi-PROCESS cluster: server and broker as separate OS processes
+coordinating through a FileRegistry, driven end-to-end over HTTP.
+
+Reference analog: the integration suites start all roles in one JVM
+(ClusterTest.java); the repo's other cluster tests do the same in-process.
+This tier proves the multi-process contract the admin CLI documents —
+separate interpreters, shared state only through the registry file and
+deep store, queries over the public HTTP endpoint.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(args, log_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [os.getcwd()] + env.get("PYTHONPATH", "").split(os.pathsep)
+        if p)
+    # the CPU test config must not leak a TPU platform requirement
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    with open(log_path, "w") as log:
+        return subprocess.Popen(
+            [sys.executable, "-m", "pinot_tpu.tools.admin", *args],
+            stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def _wait_http(url, timeout=60.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as r:
+                if r.status == 200:
+                    return True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.2)
+    return False
+
+
+def _query(url, sql, timeout=120.0):
+    req = urllib.request.Request(
+        url + "/query/sql",
+        data=json.dumps({"sql": sql}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.mark.slow
+def test_multiprocess_cluster_end_to_end(tmp_path):
+    reg = str(tmp_path / "cluster.json")
+    port = _free_port()  # stale-broker collisions would poison /health
+    schema = Schema.build(name="mp",
+                          dimensions=[("k", DataType.STRING)],
+                          metrics=[("v", DataType.LONG)])
+    schema.save(str(tmp_path / "schema.json"))
+    (tmp_path / "table.json").write_text(
+        json.dumps(TableConfig(table_name="mp").to_json()))
+    data = tmp_path / "files"
+    data.mkdir()
+    with open(data / "a.csv", "w") as f:
+        f.write("k,v\n")
+        for i in range(1000):
+            f.write(f"k{i % 7},{i}\n")
+    (tmp_path / "job.json").write_text(json.dumps({
+        "table_name": "mp", "input_dir": str(data)}))
+
+    procs = []
+    try:
+        procs.append(_spawn(
+            ["start-server", "--registry", reg,
+             "--data-dir", str(tmp_path / "sd"), "--id", "proc_server"],
+            str(tmp_path / "server.log")))
+        procs.append(_spawn(
+            ["start-broker", "--registry", reg, "--port", str(port),
+             "--timeout-s", "120"],
+            str(tmp_path / "broker.log")))
+        url = f"http://127.0.0.1:{port}"
+        assert _wait_http(url), "broker HTTP never came up"
+
+        # table + ingest from THIS process (a third participant)
+        assert subprocess.run(
+            [sys.executable, "-m", "pinot_tpu.tools.admin", "add-table",
+             "--registry", reg, "--schema", str(tmp_path / "schema.json"),
+             "--config", str(tmp_path / "table.json"),
+             "--deep-store", str(tmp_path / "ds")],
+            env={**os.environ, "PYTHONPATH": os.getcwd(),
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, timeout=60).returncode == 0
+        assert subprocess.run(
+            [sys.executable, "-m", "pinot_tpu.tools.admin", "ingest",
+             "--registry", reg, "--spec", str(tmp_path / "job.json"),
+             "--deep-store", str(tmp_path / "ds")],
+            env={**os.environ, "PYTHONPATH": os.getcwd(),
+                 "JAX_PLATFORMS": "cpu"},
+            capture_output=True, timeout=60).returncode == 0
+
+        deadline = time.time() + 90
+        rows = None
+        while time.time() < deadline:
+            try:
+                r = _query(url, "SELECT k, SUM(v), COUNT(*) FROM mp "
+                                "GROUP BY k ORDER BY k")
+                if not r.get("exceptions"):
+                    rows = r["resultTable"]["rows"]
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        assert rows is not None, "query never succeeded across processes"
+        v = np.arange(1000)
+        want = [[f"k{i}", int(v[v % 7 == i].sum()), int((v % 7 == i).sum())]
+                for i in range(7)]
+        assert rows == want
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
