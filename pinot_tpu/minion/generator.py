@@ -151,6 +151,92 @@ def generate_purge_tasks(registry, table: str, cfg: dict) -> list:
     })]
 
 
+def _index_mismatch(meta, idx_cfg) -> bool:
+    """True when a segment's on-disk indexes don't reflect the CURRENT
+    IndexingConfig (the reload-needed check the reference surfaces through
+    needReload/table reload status). Only indexes the BUILDER can actually
+    create count — an unachievable config entry (inverted on a RAW column,
+    range on a RAW MV column) must not flag forever, or generation would
+    rebuild-and-swap the same segment in an infinite loop."""
+    cols = meta.columns
+    for c in idx_cfg.inverted_index_columns:
+        if c in cols and cols[c].has_dictionary and not cols[c].has_inverted:
+            return True
+    for c in idx_cfg.bloom_filter_columns:
+        if c in cols and not cols[c].has_bloom:
+            return True
+    for c in getattr(idx_cfg, "json_index_columns", ()):
+        if c in cols and cols[c].single_value and \
+                cols[c].data_type.is_string_like and not cols[c].has_json_index:
+            return True
+    for c in getattr(idx_cfg, "text_index_columns", ()):
+        if c in cols and cols[c].single_value and \
+                cols[c].data_type.is_string_like and not cols[c].has_text_index:
+            return True
+    for c in idx_cfg.range_index_columns:
+        if c in cols and not cols[c].has_range and (
+            cols[c].encoding == "DICT"
+            or (cols[c].encoding == "RAW" and cols[c].single_value)
+        ):
+            return True
+    for c in getattr(idx_cfg, "compressed_columns", ()):
+        if c in cols and cols[c].encoding == "RAW" and \
+                cols[c].single_value and cols[c].compression is None:
+            return True
+    return False
+
+
+def generate_refresh_tasks(registry, table: str, cfg: dict) -> list:
+    """Segments whose index set lags the current IndexingConfig get a
+    rebuild task (the reference's segment reload, as a minion swap)."""
+    import json as _json
+    import os as _os
+
+    from pinot_tpu.storage.segment import METADATA_FILE, SegmentMetadata
+
+    table_cfg = registry.table_config(table)
+    if table_cfg is None:
+        return []
+    if table_cfg.upsert.mode != "NONE":
+        # validDocIds are server-local in-memory state: a rebuilt copy
+        # would resurrect superseded rows (same reason merge/purge skip)
+        return []
+    if _has_active_task(registry, table, "RealtimeToOfflineSegmentsTask"):
+        # an RTO task reads whichever ONLINE segments overlap its window
+        # at EXECUTION time; no swap may run concurrently with it
+        return []
+    busy = _busy_segments(registry, table)
+    # segments are immutable: once a segment checked clean under THIS
+    # indexing config, skip re-parsing its metadata on every cycle
+    fp = _json.dumps(table_cfg.indexing.__dict__, sort_keys=True, default=str)
+    meta_state = registry.task_metadata_get(table, "RefreshSegmentsTask")
+    clean = set(meta_state.get("clean", ())) \
+        if meta_state.get("config_fp") == fp else set()
+    stale = []
+    for r in registry.segments(table).values():
+        if r.state != "ONLINE" or not r.location or r.name in busy \
+                or r.name in clean:
+            continue
+        meta_path = _os.path.join(r.location, METADATA_FILE)
+        try:
+            with open(meta_path) as f:
+                meta = SegmentMetadata.from_json(_json.load(f))
+        except (OSError, ValueError, KeyError):
+            continue  # unreadable metadata: leave the segment alone
+        if _index_mismatch(meta, table_cfg.indexing):
+            stale.append(r.name)
+        else:
+            clean.add(r.name)
+    live = set(registry.segments(table))
+    registry.task_metadata_set(table, "RefreshSegmentsTask", {
+        "config_fp": fp, "clean": sorted(clean & live),
+    })
+    if not stale:
+        return []
+    return [registry.submit_task("RefreshSegmentsTask", table,
+                                 {"segments": sorted(stale)})]
+
+
 def generate_tasks(registry, now_ms=None) -> list:
     """Scan every table's task_configs and enqueue what is due."""
     now_ms = now_ms or int(time.time() * 1000)
@@ -170,6 +256,8 @@ def generate_tasks(registry, now_ms=None) -> list:
                 )
             elif task_type == "PurgeTask":
                 ids += generate_purge_tasks(registry, table, cfg)
+            elif task_type == "RefreshSegmentsTask":
+                ids += generate_refresh_tasks(registry, table, cfg)
             else:
                 log.warning("unknown task type %s on table %s", task_type, table)
     return ids

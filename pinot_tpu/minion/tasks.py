@@ -358,8 +358,52 @@ def execute_purge(ctx: TaskContext, task: dict) -> str:
     return "; ".join(out_msgs) if out_msgs else "nothing to purge"
 
 
+def execute_refresh_segments(ctx: TaskContext, task: dict) -> str:
+    """Rebuild segments under the CURRENT IndexingConfig — the reference's
+    segment reload (needReload -> reload) expressed as a lineage-atomic
+    minion swap: each input rebuilds 1:1 under a fresh name so queries
+    never see a half-indexed copy."""
+    table = task["table"]
+    cfg = task["config"]
+    schema = ctx.registry.table_schema(table)
+    table_cfg = ctx.registry.table_config(table)
+    records = ctx.registry.segments(table)
+    # requeued-attempt idempotency (same contract as merge): a COMPLETED
+    # lineage over an input means a prior attempt already swapped it —
+    # finish that attempt's cleanup (delete the leftover FROM copy) so the
+    # lineage entry can prune and the segment stops being busy forever
+    done_lineage = {
+        f for e in ctx.registry.lineage(table).values()
+        if e["state"] == "COMPLETED" for f in e["from"]
+    }
+    out_msgs = []
+    attempt = task.get("attempts", 1)
+    suffix = "_".join(task["id"].split("_")[-2:])
+    for name in cfg["segments"]:
+        rec = records.get(name)
+        if name in done_lineage:
+            if rec is not None:
+                ctx.controller.delete_segment(table, name)
+            ctx.registry.prune_lineage(table)
+            out_msgs.append(f"{name}: already swapped; cleaned up leftover")
+            continue
+        if rec is None:
+            out_msgs.append(f"{name}: gone")
+            continue
+        seg = ImmutableSegment(rec.location)
+        columns, null_masks = _read_columns([seg], schema)
+        new_name = f"refreshed_{name}_{suffix}_a{attempt}"
+        out_dir = os.path.join(ctx.scratch(task["id"]), new_name)
+        build_segment(schema, columns, out_dir, table_cfg, new_name,
+                      null_masks=null_masks)
+        _lineage_swap(ctx, table, [name], out_dir, new_name)
+        out_msgs.append(f"{name} -> {new_name}")
+    return "; ".join(out_msgs) or "nothing to refresh"
+
+
 TASK_EXECUTORS = {
     "MergeRollupTask": execute_merge_rollup,
     "RealtimeToOfflineSegmentsTask": execute_realtime_to_offline,
     "PurgeTask": execute_purge,
+    "RefreshSegmentsTask": execute_refresh_segments,
 }

@@ -335,3 +335,73 @@ class TestRealtimeToOffline:
         rows = _rows(broker,
                      "SELECT kind, COUNT(*) FROM events GROUP BY kind ORDER BY kind")
         assert [r[1] for r in rows] == [84, 83, 83]
+
+
+class TestRefreshSegments:
+    """RefreshSegmentsTask: segments rebuild under the CURRENT
+    IndexingConfig after a config change (the reference's reload story)."""
+
+    def test_index_config_change_triggers_rebuild(self, cluster, tmp_path):
+        from pinot_tpu.common.table_config import IndexingConfig
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        registry, controller, servers, broker, minion = cluster
+        schema, cfg = _sales_table(
+            tmp_path, controller, {"RefreshSegmentsTask": {}}, n_segments=2)
+        assert wait_until(
+            lambda: len(registry.external_view("sales_OFFLINE")) == 2)
+        before = _rows(broker, "SELECT region, SUM(amount) FROM sales "
+                               "GROUP BY region ORDER BY region")
+
+        # no mismatch yet: generation is a no-op
+        assert controller.run_task_generation() == []
+
+        # add an inverted index + bloom to the table config
+        cfg2 = TableConfig(
+            table_name="sales", replication=1,
+            task_configs={"RefreshSegmentsTask": {}},
+            indexing=IndexingConfig(inverted_index_columns=["region"],
+                                    bloom_filter_columns=["region"]))
+        controller.add_table(cfg2, schema)
+        ids = controller.run_task_generation()
+        assert len(ids) == 1
+        minion.start()
+        assert wait_until(lambda: all(
+            t["state"] == "DONE" for t in registry.tasks(table="sales_OFFLINE")
+            if t["type"] == "RefreshSegmentsTask"), timeout=30)
+
+        # swapped segments carry the new indexes; results unchanged
+        def refreshed():
+            recs = registry.segments("sales_OFFLINE")
+            return [r for r in recs.values() if r.name.startswith("refreshed_")]
+
+        assert wait_until(lambda: len(refreshed()) == 2, timeout=30)
+        for rec in refreshed():
+            seg = ImmutableSegment(rec.location)
+            assert seg.column_metadata("region").has_inverted
+            assert seg.column_metadata("region").has_bloom
+        assert wait_until(lambda: _rows(
+            broker, "SELECT region, SUM(amount) FROM sales "
+                    "GROUP BY region ORDER BY region") == before, timeout=30)
+
+        # steady state: no further refresh tasks get generated
+        registry.prune_terminal_tasks(ttl_ms=0)
+        assert wait_until(
+            lambda: controller.run_task_generation() == [], timeout=30)
+
+    def test_unachievable_index_config_does_not_loop(self, cluster, tmp_path):
+        """An index the builder can't create (inverted on a RAW no-dict
+        column) must not flag forever (r3 review: infinite rebuild loop)."""
+        from pinot_tpu.common.table_config import IndexingConfig
+
+        registry, controller, servers, broker, minion = cluster
+        schema, cfg = _sales_table(
+            tmp_path, controller, {"RefreshSegmentsTask": {}}, n_segments=1)
+        cfg2 = TableConfig(
+            table_name="sales", replication=1,
+            task_configs={"RefreshSegmentsTask": {}},
+            indexing=IndexingConfig(
+                no_dictionary_columns=["amount"],
+                inverted_index_columns=["amount"]))  # RAW: unbuildable
+        controller.add_table(cfg2, schema)
+        assert controller.run_task_generation() == []
