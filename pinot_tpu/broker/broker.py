@@ -217,7 +217,7 @@ class Broker:
                     "errorCode": 429,
                     "message": f"query quota exceeded for table "
                                f"{q.table_name!r}"}]}
-            if dict(q.options).get("trace"):
+            if q.options_ci().get("trace"):
                 tracer = trace.start_trace()
             resp = self._scatter_gather(q, sql)
             if tracer is not None:
@@ -306,6 +306,12 @@ class Broker:
 
         q = self._expand_star(q)
         request_id = next(self._request_id)
+        # per-query timeout override (SET timeoutMs = N — the reference's
+        # timeoutMs query option)
+        opts = q.options_ci()
+        timeout_s = self.timeout_s
+        if "timeoutms" in opts:
+            timeout_s = max(0.001, float(opts["timeoutms"]) / 1000.0)
 
         scatter = []  # (instance, physical table, segments, time_filter)
         n_servers = set()
@@ -344,10 +350,10 @@ class Broker:
         # either side. SET streaming = false forces the unary path.
         use_streaming = (
             not q.aggregations() and not q.distinct and not q.order_by
-            and dict(q.options).get("streaming") is not False
+            and opts.get("streaming") is not False
             # tracing rides the unary DataTable header; streaming blocks
             # don't carry spans, so a traced query takes the unary path
-            and not dict(q.options).get("trace")
+            and not opts.get("trace")
         )
         row_budget = q.offset + q.limit
         rows_seen = [0]
@@ -362,8 +368,8 @@ class Broker:
                 table=physical, time_filter=time_filter,
             )
             if not use_streaming:
-                return [decode(ch.submit(payload, self.timeout_s))]
-            stream = ch.submit_streaming(payload, self.timeout_s)
+                return [decode(ch.submit(payload, timeout_s))]
+            stream = ch.submit_streaming(payload, timeout_s)
             parts = []
             for block in stream:
                 r = decode(bytes(block))
@@ -390,7 +396,7 @@ class Broker:
         with span("broker.scatter_gather"):
             for fut, inst in futs.items():
                 try:
-                    for r in fut.result(timeout=self.timeout_s + 1):
+                    for r in fut.result(timeout=timeout_s + 1):
                         if r.trace is not None:
                             server_traces[inst] = r.trace
                         results.append(r)

@@ -87,6 +87,8 @@ class Cursor:
         return self
 
     def _require_rows(self) -> list:
+        if self._closed:
+            raise ProgrammingError("cursor is closed")
         if self._rows is None:
             raise ProgrammingError("fetch before execute")
         return self._rows
@@ -126,12 +128,18 @@ class Cursor:
 
 
 def _split_placeholders(sql: str) -> list:
-    parts, cur, in_str = [], [], False
+    """Split on ? placeholders, ignoring ?s inside single-quoted strings
+    AND double-quoted identifiers."""
+    parts, cur = [], []
+    in_sq = in_dq = False
     for ch in sql:
-        if ch == "'":
-            in_str = not in_str
+        if ch == "'" and not in_dq:
+            in_sq = not in_sq
             cur.append(ch)
-        elif ch == "?" and not in_str:
+        elif ch == '"' and not in_sq:
+            in_dq = not in_dq
+            cur.append(ch)
+        elif ch == "?" and not in_sq and not in_dq:
             parts.append("".join(cur))
             cur = []
         else:

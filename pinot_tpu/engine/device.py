@@ -703,6 +703,14 @@ class DeviceExecutor:
                 f"({int(outs['n_groups_total'])} > {sorted_k})")
         gcount = outs["gcount"]
         present = np.nonzero(gcount > 0)[0]
+        opts = q.options_ci()
+        if "numgroupslimit" in opts:
+            # per-query numGroupsLimit (SET option): excess groups drop —
+            # arbitrary-but-deterministic (gid order), like the reference's
+            # hash-order drops
+            limit = max(1, int(opts["numgroupslimit"]))
+            if len(present) > limit:
+                present = present[:limit]
         # decode the combined key (dense: the gid itself; sorted: the int64
         # key recorded per table slot) → per-column global ids → values
         if shape == "groupby_sorted":

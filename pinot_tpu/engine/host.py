@@ -643,11 +643,17 @@ class HostExecutor:
             )
         keys, ginv = factorize_multi(key_cols)
         n_groups = len(keys[0])
-        if n_groups > self.num_groups_limit:
-            # keep the first num_groups_limit groups *encountered*, by doc
-            # order (reference numGroupsLimit semantics: excess groups dropped)
+        # per-query override (SET numGroupsLimit = N, the reference's
+        # query option) over the engine default
+        limit = self.num_groups_limit
+        opts = q.options_ci()
+        if "numgroupslimit" in opts:
+            limit = max(1, int(opts["numgroupslimit"]))
+        if n_groups > limit:
+            # keep the first `limit` groups *encountered*, by doc order
+            # (reference numGroupsLimit semantics: excess groups dropped)
             _, first_idx = np.unique(ginv, return_index=True)
-            keep = np.argsort(first_idx)[: self.num_groups_limit]
+            keep = np.argsort(first_idx)[:limit]
             keep_mask = np.isin(ginv, keep)
             doc_idx = doc_idx[keep_mask]
             key_cols = [np.asarray(k)[keep_mask] for k in key_cols]

@@ -254,7 +254,7 @@ def _gapfill_options(q) -> Optional[dict]:
     """SET-driven gapfill config (GapfillProcessor analog, option-shaped:
     SET gapfillBucketMs = 3600000; [gapfillStart/gapfillEnd/gapfillFill]).
     Returns None when gapfill is off."""
-    opts = {str(k).lower(): v for k, v in q.options_dict().items()}
+    opts = q.options_ci()
     bucket = opts.get("gapfillbucketms")
     if bucket is None:
         return None

@@ -34,10 +34,14 @@ class QueryScheduler:
         self.num_rejected = 0
         self.num_executed = 0
 
-    def run(self, fn):
+    def run(self, fn, queue_timeout_s=None):
         """Execute ``fn`` under the concurrency cap; raises
         SchedulerSaturated when the wait queue is full or the slot wait
-        times out."""
+        times out. ``queue_timeout_s`` lets a per-query deadline (SET
+        timeoutMs) shrink the admission wait: a query whose budget elapsed
+        queueing must not start and burn a worker nobody reads."""
+        wait_s = self.queue_timeout_s if queue_timeout_s is None \
+            else min(self.queue_timeout_s, queue_timeout_s)
         with self._lock:
             if self._waiting >= self.max_queued:
                 self.num_rejected += 1
@@ -47,11 +51,11 @@ class QueryScheduler:
                 )
             self._waiting += 1
         try:
-            if not self._sem.acquire(timeout=self.queue_timeout_s):
+            if not self._sem.acquire(timeout=wait_s):
                 with self._lock:
                     self.num_rejected += 1
                 raise SchedulerSaturated(
-                    f"no execution slot within {self.queue_timeout_s}s"
+                    f"no execution slot within {wait_s}s"
                 )
         finally:
             with self._lock:

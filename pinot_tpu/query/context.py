@@ -350,6 +350,11 @@ class QueryContext:
     def options_dict(self) -> dict:
         return dict(self.options)
 
+    def options_ci(self) -> dict:
+        """SET options with case-insensitive keys (the reference treats
+        query-option names case-insensitively, QueryOptionsUtils)."""
+        return {str(k).lower(): v for k, v in self.options}
+
     def column_name(self, i: int) -> str:
         """Result column header for select position i (alias or expr string)."""
         if i < len(self.aliases) and self.aliases[i]:

@@ -132,13 +132,26 @@ class ServerInstance:
         self.registry.drop_instance(self.instance_id)
 
     # ---- query path ------------------------------------------------------
+    @staticmethod
+    def _request_timeout_s(sql: str):
+        """Per-query SET timeoutMs, read pre-compile so the scheduler's
+        ADMISSION wait honors it: a query whose budget elapsed queueing
+        must not start and burn a worker the broker already abandoned
+        (the server-side half of the reference's timeoutMs option)."""
+        import re as _re
+
+        m = _re.search(r"SET\s+timeoutMs\s*=\s*([0-9.]+)", sql, _re.IGNORECASE)
+        return max(0.001, float(m.group(1)) / 1000.0) if m else None
+
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)
         try:
             # NOTE: the latency timer lives inside _handle_submit_inner —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
-            return self.scheduler.run(lambda: self._handle_submit_inner(req))
+            return self.scheduler.run(
+                lambda: self._handle_submit_inner(req),
+                queue_timeout_s=self._request_timeout_s(req["sql"]))
         except SchedulerSaturated as e:
             # admission rejection is a query-level error: the server is
             # healthy (broker must not poison its failure detector)

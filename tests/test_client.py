@@ -102,6 +102,12 @@ class TestClient:
             cur.execute("SELECT pop FROM cities WHERE name <> '?' "
                         "AND pop < ?", [2000])
             assert cur.fetchall() == [(1000,)]
+            # ? inside a double-quoted identifier is not a placeholder (r3)
+            from pinot_tpu.client import _split_placeholders
+
+            assert _split_placeholders(
+                'SELECT "what?" FROM t WHERE x = ?') == \
+                ['SELECT "what?" FROM t WHERE x = ', '']
 
     def test_fetchmany_zero_returns_empty(self, cluster):
         registry, broker, http = cluster
@@ -144,6 +150,8 @@ class TestClient:
         cur.close()
         with pytest.raises(ProgrammingError, match="closed"):
             cur.execute("SELECT 1 FROM cities")
+        with pytest.raises(ProgrammingError, match="closed"):
+            cur.fetchall()  # use-after-close names the real bug (r3)
         conn.close()
         with pytest.raises(ProgrammingError, match="closed"):
             conn.cursor()

@@ -383,6 +383,23 @@ class TestMisc:
         assert resp["exceptions"]
 
 
+class TestQueryOptions:
+    """Per-query SET options (QueryOptionsUtils analog)."""
+
+    def test_num_groups_limit_option(self, setup):
+        engine, _ = setup
+        full = engine.execute(
+            "SELECT playerName, COUNT(*) FROM baseballStats "
+            "GROUP BY playerName LIMIT 1000")
+        assert len(full["resultTable"]["rows"]) == 150
+        capped = engine.execute(
+            "SET numGroupsLimit = 10; "
+            "SELECT playerName, COUNT(*) FROM baseballStats "
+            "GROUP BY playerName LIMIT 1000")
+        # per-segment cap of 10, merged across 2 segments: <= 20 groups
+        assert 10 <= len(capped["resultTable"]["rows"]) <= 20
+
+
 class TestVirtualColumns:
     """$docId / $segmentName / $hostName providers
     (segment/virtualcolumn/ analog)."""

@@ -97,6 +97,48 @@ def test_non_positive_quota_rejected_at_config():
                     quota=QuotaConfig(max_queries_per_second=0))
 
 
+def test_timeout_ms_query_option(tmp_path):
+    """SET timeoutMs overrides the broker's per-query fan-out timeout
+    (the reference's timeoutMs query option)."""
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                            device_executor=None)
+    server.start()
+    broker = Broker(registry, timeout_s=10.0)
+    try:
+        schema = Schema.build(name="t", dimensions=[("k", DataType.STRING)],
+                              metrics=[("v", DataType.LONG)])
+        cfg = TableConfig(table_name="t")
+        controller.add_table(cfg, schema)
+        build_segment(schema, {"k": np.array(["a"]), "v": np.array([1])},
+                      str(tmp_path / "up"), cfg, "s0seg")
+        controller.upload_segment("t", str(tmp_path / "up"))
+        assert wait_until(
+            lambda: len(registry.external_view("t_OFFLINE")) == 1)
+        from pinot_tpu.transport.grpc_transport import QueryRouterChannel
+
+        seen = []
+        real_submit = QueryRouterChannel.submit
+
+        def recording(self, payload, timeout):
+            seen.append(timeout)
+            return real_submit(self, payload, timeout)
+
+        QueryRouterChannel.submit = recording
+        try:
+            ok = broker.execute("SET timeoutMs = 2500; SELECT COUNT(*) FROM t")
+            assert not ok.get("exceptions"), ok
+            assert seen and abs(seen[-1] - 2.5) < 1e-9, seen
+            ok = broker.execute("SELECT COUNT(*) FROM t")
+            assert seen[-1] == 10.0  # broker default without the option
+        finally:
+            QueryRouterChannel.submit = real_submit
+    finally:
+        broker.close()
+        server.stop()
+
+
 def test_no_quota_config_unlimited(tmp_path):
     registry = ClusterRegistry()
     controller = Controller(registry, str(tmp_path / "ds"))
