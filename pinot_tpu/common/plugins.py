@@ -84,6 +84,9 @@ class PluginRegistry:
 
         self.register("fs", "file", _fs.LocalFS)
         self.register("fs", "", _fs.LocalFS)  # bare paths
+        from pinot_tpu.storage import s3fs as _s3fs
+
+        self.register("fs", "s3", _s3fs.S3FS)  # gated on boto3 at init
         for name, cls in _stream._FACTORIES.items():
             self.register("stream", name, cls)
         for name, fn in _stream._DECODERS.items():
