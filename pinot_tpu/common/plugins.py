@@ -84,9 +84,11 @@ class PluginRegistry:
 
         self.register("fs", "file", _fs.LocalFS)
         self.register("fs", "", _fs.LocalFS)  # bare paths
+        from pinot_tpu.storage import gcsfs as _gcsfs
         from pinot_tpu.storage import s3fs as _s3fs
 
         self.register("fs", "s3", _s3fs.S3FS)  # gated on boto3 at init
+        self.register("fs", "gs", _gcsfs.GcsFS)  # gated on google-cloud
         for name, cls in _stream._FACTORIES.items():
             self.register("stream", name, cls)
         for name, fn in _stream._DECODERS.items():

@@ -1,27 +1,20 @@
 """S3 deep-store filesystem (pinot-plugins/pinot-file-system/pinot-s3
 analog), gated on boto3.
 
-Maps the PinotFS surface onto S3 object operations the way S3PinotFS
-does: a "directory" is a key prefix, ``copy`` walks local files into
-objects (and back for downloads), ``delete`` removes the prefix. The
-segment lifecycle only ever copies whole segment directories, so the
-prefix model is sufficient.
+Segment-directory-over-prefix semantics come from the shared
+``PrefixObjectFS`` base (storage/fs.py) — this module supplies only the
+five boto3-backed primitive hooks. Registers lazily under the ``s3``
+scheme and raises a clear error at construction when boto3 is absent.
 
-The build image ships no AWS SDK, so the module registers lazily under
-the ``s3`` scheme and raises a clear error at construction when boto3 is
-absent — the registry itself never breaks (plugin-isolation contract).
-
-Config via environment (the reference reads pinot.controller.storage
-properties; here the standard AWS env/credentials chain applies, plus
-``PINOT_TPU_S3_ENDPOINT`` for S3-compatible stores).
+Config via environment: the standard AWS env/credentials chain applies,
+plus ``PINOT_TPU_S3_ENDPOINT`` for S3-compatible stores.
 """
 
 from __future__ import annotations
 
 import os
-from urllib.parse import urlparse
 
-from pinot_tpu.storage.fs import PinotFS
+from pinot_tpu.storage.fs import PrefixObjectFS
 
 
 def _boto3():
@@ -35,14 +28,9 @@ def _boto3():
             "file:// deep store") from e
 
 
-def _split(uri: str):
-    u = urlparse(uri)
-    if u.scheme != "s3" or not u.netloc:
-        raise ValueError(f"not an s3 URI: {uri!r}")
-    return u.netloc, u.path.lstrip("/")
+class S3FS(PrefixObjectFS):
+    scheme = "s3"
 
-
-class S3FS(PinotFS):
     def __init__(self):
         b3 = _boto3()
         kwargs = {}
@@ -51,93 +39,35 @@ class S3FS(PinotFS):
             kwargs["endpoint_url"] = endpoint
         self._s3 = b3.client("s3", **kwargs)
 
-    def mkdir(self, path: str) -> None:
-        pass  # prefixes need no creation
-
-    def _dir_keys(self, bucket: str, prefix: str, max_keys=None) -> list:
-        """Keys of the 'directory' at prefix: everything under prefix + '/'
-        plus an exact-key object — a bare prefix match would also hit
-        same-prefix siblings (seg_1 vs seg_10)."""
-        p = prefix.rstrip("/")
-        keys = self._list_keys(bucket, p + "/", max_keys=max_keys)
-        if max_keys is None or len(keys) < max_keys:
-            # the exact key sorts FIRST among keys sharing the prefix
-            exact = self._list_keys(bucket, p, max_keys=1)
-            if exact and exact[0] == p and p not in keys:
-                keys.append(p)
-        return keys
-
-    def delete(self, path: str) -> None:
-        bucket, prefix = _split(path)
-        keys = self._dir_keys(bucket, prefix)
-        for i in range(0, len(keys), 1000):
-            self._s3.delete_objects(
-                Bucket=bucket,
-                Delete={"Objects": [{"Key": k} for k in keys[i: i + 1000]]})
-
-    def exists(self, path: str) -> bool:
-        bucket, prefix = _split(path)
-        return bool(self._dir_keys(bucket, prefix, max_keys=1))
-
-    def _list_keys(self, bucket: str, prefix: str, max_keys=None) -> list:
+    def _list(self, bucket: str, prefix: str, limit=None) -> list:
         keys = []
         token = None
         while True:
             kw = {"Bucket": bucket, "Prefix": prefix}
             if token:
                 kw["ContinuationToken"] = token
-            if max_keys:
-                kw["MaxKeys"] = max_keys
+            if limit:
+                kw["MaxKeys"] = limit
             resp = self._s3.list_objects_v2(**kw)
             keys.extend(o["Key"] for o in resp.get("Contents", ()))
-            if max_keys or not resp.get("IsTruncated"):
+            if limit or not resp.get("IsTruncated"):
                 return keys
             token = resp.get("NextContinuationToken")
 
-    def copy(self, src: str, dst: str) -> None:
-        src_s3 = src.startswith("s3://")
-        dst_s3 = dst.startswith("s3://")
-        if not src_s3 and dst_s3:  # upload (segment push)
-            self.delete(dst)  # PinotFS contract: dst is REPLACED
-            bucket, prefix = _split(dst)
-            if os.path.isdir(src):
-                for root, _, files in os.walk(src):
-                    for f in sorted(files):
-                        full = os.path.join(root, f)
-                        rel = os.path.relpath(full, src)
-                        self._s3.upload_file(
-                            full, bucket, f"{prefix}/{rel}".replace(os.sep, "/"))
-            else:
-                self._s3.upload_file(src, bucket, prefix)
-        elif src_s3 and not dst_s3:  # download (server sync)
-            bucket, prefix = _split(src)
-            prefix = prefix.rstrip("/")
-            keys = self._dir_keys(bucket, prefix)
-            if not keys:
-                raise FileNotFoundError(src)
-            for key in keys:
-                rel = key[len(prefix):].lstrip("/")
-                local = os.path.join(dst, rel) if rel else dst
-                os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
-                self._s3.download_file(bucket, key, local)
-        elif src_s3 and dst_s3:
-            self.delete(dst)  # PinotFS contract: dst is REPLACED
-            sb, sp = _split(src)
-            sp = sp.rstrip("/")
-            db, dp = _split(dst)
-            for key in self._dir_keys(sb, sp):
-                rel = key[len(sp):].lstrip("/")
-                self._s3.copy_object(
-                    Bucket=db, Key=f"{dp}/{rel}".rstrip("/"),
-                    CopySource={"Bucket": sb, "Key": key})
-        else:
-            raise ValueError("S3FS.copy needs at least one s3:// side")
+    def _put(self, local_path: str, bucket: str, key: str) -> None:
+        self._s3.upload_file(local_path, bucket, key)
 
-    def list_files(self, path: str) -> list:
-        bucket, prefix = _split(path)
-        pfx = prefix.rstrip("/") + "/" if prefix else ""
-        names = set()
-        for key in self._list_keys(bucket, pfx):
-            rest = key[len(pfx):]
-            names.add(rest.split("/", 1)[0])
-        return sorted(n for n in names if n)
+    def _get(self, bucket: str, key: str, local_path: str) -> None:
+        self._s3.download_file(bucket, key, local_path)
+
+    def _delete_objs(self, bucket: str, keys: list) -> None:
+        for i in range(0, len(keys), 1000):  # API batch cap
+            self._s3.delete_objects(
+                Bucket=bucket,
+                Delete={"Objects": [{"Key": k} for k in keys[i: i + 1000]]})
+
+    def _copy_obj(self, src_bucket: str, src_key: str,
+                  dst_bucket: str, dst_key: str) -> None:
+        self._s3.copy_object(Bucket=dst_bucket, Key=dst_key,
+                             CopySource={"Bucket": src_bucket,
+                                         "Key": src_key})

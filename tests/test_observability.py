@@ -104,8 +104,12 @@ class TestFsSpi:
         monkeypatch.setitem(sys.modules, "boto3", None)
         with pytest.raises(RuntimeError, match="boto3"):
             create_fs("s3://bucket/x")
-        with pytest.raises(KeyError, match="no 'fs' plugin"):
+        monkeypatch.setitem(sys.modules, "google", None)
+        monkeypatch.setitem(sys.modules, "google.cloud", None)
+        with pytest.raises(RuntimeError, match="google-cloud"):
             create_fs("gs://bucket/x")
+        with pytest.raises(KeyError, match="no 'fs' plugin"):
+            create_fs("hdfs://nn/x")
 
 
 class TestPluginRegistry:
