@@ -287,22 +287,35 @@ def build_pipeline(template, mm_mode: str = "auto"):
             per_col = [cols[c] for c in group_cols]
             key = agg_ops.combine_keys_int64(per_col, group_cards, mask)
             flat_key = key.reshape(-1)
+            n_rows = flat_key.shape[0]
             # dedup payloads by argument template: MIN(x)+MAX(x)+AVG(x)
-            # must sort ONE copy of x, not three
+            # must carry ONE copy of x, not three. Only args consumed via
+            # the cumsum path (sum/avg) ride the PRIMARY sort; min/max-only
+            # args would be sorted twice for nothing (they get their own
+            # secondary-key sort below)
             payloads, payload_of = [], {}
             int_payload = {}
+            minmax_args = set()
+            sum_args = set()
+            arg_exprs = {}
             for i, (name, argt, extra) in enumerate(aggs):
                 if name == "count":
                     continue
-                if argt not in payload_of:
+                if argt not in arg_exprs:
                     v = _eval_expr(argt, cols, params)
                     # integer args accumulate exactly in int64 (the host /
                     # dense paths are exact; per-doc f64 adds would round)
                     as_int = jnp.issubdtype(v.dtype, jnp.integer)
                     int_payload[argt] = as_int
                     dt = jnp.int64 if as_int else jnp.float64
-                    payload_of[argt] = len(payloads)
-                    payloads.append(v.astype(dt).reshape(-1))
+                    arg_exprs[argt] = v.astype(dt).reshape(-1)
+                if name in ("min", "max", "minmaxrange"):
+                    minmax_args.add(argt)
+                if name in ("sum", "avg"):
+                    sum_args.add(argt)
+            for argt in sum_args:
+                payload_of[argt] = len(payloads)
+                payloads.append(arg_exprs[argt])
             sorted_ops = jax.lax.sort([flat_key] + payloads, num_keys=1)
             sk = sorted_ops[0]
             is_start = jnp.concatenate(
@@ -310,30 +323,66 @@ def build_pipeline(template, mm_mode: str = "auto"):
             real = sk != agg_ops.INT64_SENTINEL
             sid = jnp.cumsum(is_start) - 1
             outs["n_groups_total"] = jnp.sum(is_start & real)
-            sid_c = jnp.where(real & (sid < K), sid, K)
-            outs["skeys"] = jnp.full(
-                K + 1, agg_ops.INT64_SENTINEL, dtype=jnp.int64
-            ).at[sid_c].min(sk)[:K]
-            outs["gcount"] = jnp.zeros(
-                K + 1, dtype=jnp.int64).at[sid_c].add(1)[:K]
+            sid_c = jnp.where(real & (sid < K), sid, K).astype(jnp.int32)
+            # After the sort, each table slot's rows are CONTIGUOUS and sid
+            # ascends 0..G-1 (sentinel rows sort last and land in slot K).
+            # One int32 position scatter yields each slot's LAST row; every
+            # additive aggregate is then a cumsum difference at those
+            # boundaries and min/max come from secondary-key sorts — int64
+            # scatter-adds here measured 8-30x slower than this on v5e
+            # (5.3s -> ~0.5s at 12M rows).
+            pos = jnp.arange(n_rows, dtype=jnp.int32)
+            end_pos = jnp.full(K + 1, -1, dtype=jnp.int32).at[sid_c].max(pos)
+            ends = end_pos[:K]
+            prev = jnp.concatenate([jnp.full(1, -1, dtype=jnp.int32),
+                                    ends[:-1]])
+            empty = ends < 0
+            e_idx = jnp.clip(ends, 0, n_rows - 1)
+            p_idx = jnp.clip(prev, 0, n_rows - 1)
+            outs["skeys"] = jnp.where(
+                empty, agg_ops.INT64_SENTINEL, sk[e_idx])
+            outs["gcount"] = jnp.where(
+                empty, 0, (ends - prev).astype(jnp.int64))
+
+            def seg_sum(argt):
+                v_sorted = sorted_ops[1 + payload_of[argt]]
+                if int_payload[argt]:
+                    # exact for ints even if the running total wraps: the
+                    # two's-complement difference recovers the group sum
+                    csum = jnp.cumsum(v_sorted)
+                    hi = csum[e_idx]
+                    lo = jnp.where(prev >= 0, csum[p_idx], 0)
+                    return jnp.where(empty, 0, hi - lo)
+                # floats: a global cumsum difference suffers catastrophic
+                # cancellation when a group's sum is tiny next to the
+                # running total — keep the order-independent f64 scatter
+                # (matches host/dense float semantics; ints carry the perf)
+                return jnp.zeros(K + 1, dtype=jnp.float64).at[sid_c].add(
+                    v_sorted)[:K]
+
+            # min/max: re-sort with the value as a SECONDARY key, so each
+            # slot's minimum sits at its first row and maximum at its last
+            mm_sorted = {}
+            for argt in minmax_args:
+                _, vv = jax.lax.sort(
+                    [flat_key, arg_exprs[argt]], num_keys=2)
+                mm_sorted[argt] = vv
             for i, (name, argt, extra) in enumerate(aggs):
                 k = f"a{i}"
                 if name == "count":
                     continue
-                v = sorted_ops[1 + payload_of[argt]]
                 is_int = int_payload[argt]
-                acc_dt = jnp.int64 if is_int else jnp.float64
-                lo_fill = jnp.iinfo(jnp.int64).max if is_int else jnp.inf
-                hi_fill = jnp.iinfo(jnp.int64).min if is_int else -jnp.inf
                 if name in ("sum", "avg"):
-                    outs[f"{k}_sum"] = jnp.zeros(
-                        K + 1, dtype=acc_dt).at[sid_c].add(v)[:K]
+                    outs[f"{k}_sum"] = seg_sum(argt)
                 if name in ("min", "minmaxrange"):
-                    outs[f"{k}_min"] = jnp.full(
-                        K + 1, lo_fill, dtype=acc_dt).at[sid_c].min(v)[:K]
+                    vv = mm_sorted[argt]
+                    start = jnp.clip(prev + 1, 0, n_rows - 1)
+                    lo_fill = jnp.iinfo(jnp.int64).max if is_int else jnp.inf
+                    outs[f"{k}_min"] = jnp.where(empty, lo_fill, vv[start])
                 if name in ("max", "minmaxrange"):
-                    outs[f"{k}_max"] = jnp.full(
-                        K + 1, hi_fill, dtype=acc_dt).at[sid_c].max(v)[:K]
+                    vv = mm_sorted[argt]
+                    hi_fill = jnp.iinfo(jnp.int64).min if is_int else -jnp.inf
+                    outs[f"{k}_max"] = jnp.where(empty, hi_fill, vv[e_idx])
             return outs
 
         if shape == "groupby":

@@ -259,6 +259,42 @@ class TestSortedHighCardGroupBy:
         rd, rh = dev_small.execute(sql), host_small.execute(sql)
         assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
 
+    def test_float_sums_no_cancellation(self, tmp_path):
+        """Float SUMs use the order-independent scatter, not a global
+        cumsum difference — a tiny group next to huge ones must not lose
+        its value to cancellation (r3 review)."""
+        n = 20_000
+        rng = np.random.default_rng(9)
+        vals = rng.uniform(1e9, 1e10, n)
+        # one tiny-magnitude group buried at a random key position
+        cols = {
+            # dtype wide enough for the injected key: assigning "a_tiny"
+            # into a '<U4' array would silently truncate to "a_ti"
+            "a": np.array([f"a{i:03d}" for i in range(300)],
+                          dtype="<U8")[rng.integers(0, 300, n)],
+            "b": np.array([f"b{i:05d}" for i in range(n)]),
+            "v": vals,
+        }
+        cols["a"][:3] = "a_tiny"
+        cols["b"][:3] = np.array(["b_t0", "b_t1", "b_t2"])
+        cols["v"][:3] = [1.25, 2.5, 1.25]
+        schema = Schema.build(
+            name="fs",
+            dimensions=[("a", DataType.STRING), ("b", DataType.STRING)],
+            metrics=[("v", DataType.DOUBLE)],
+        )
+        build_segment(schema, cols, str(tmp_path / "s0"),
+                      TableConfig(table_name="fs"), "s0")
+        seg = ImmutableSegment(str(tmp_path / "s0"))
+        dev = QueryEngine()
+        dev.add_segment("fs", seg)
+        r = dev.execute("SELECT a, b, SUM(v) FROM fs WHERE a = 'a_tiny' "
+                        "GROUP BY a, b ORDER BY b")
+        shapes = {t[0] for (t, _m) in dev.device._pipelines}
+        assert "groupby_sorted" in shapes
+        got = [row[2] for row in r["resultTable"]["rows"]]
+        assert got == [1.25, 2.5, 1.25], got
+
     def test_large_int_sums_exact(self, tmp_path):
         """Integer payloads accumulate in int64 on the sorted path — per-doc
         f64 adds would round past 2^53 (r3 review)."""
