@@ -229,12 +229,30 @@ class ClusterRegistry:
         return self._tx_read(lambda s: list(s["tables"]))
 
     # ---- segments + assignment ------------------------------------------
-    def add_segment(self, record: SegmentRecord, instance_ids: list) -> None:
+    def add_segment(self, record: SegmentRecord, instance_ids: list,
+                    merge_instances: bool = False) -> None:
+        """Register a segment + its replica assignment.
+
+        ``merge_instances=True`` unions ``instance_ids`` into the existing
+        assignment instead of replacing it — the multi-replica realtime
+        commit path needs this: EVERY replica of a stream partition
+        publishes the same committed segment under its own instance id
+        (winner via finish, losers via adopt), and replace semantics would
+        make the last publisher the only replica, silently dropping
+        replication to 1 (the reference instead has the controller write
+        the full ideal-state replica set once at commit)."""
         record.push_time_ms = record.push_time_ms or int(time.time() * 1000)
 
         def fn(s):
             s["segments"].setdefault(record.table, {})[record.name] = record
-            s["assignment"].setdefault(record.table, {})[record.name] = list(instance_ids)
+            assign = s["assignment"].setdefault(record.table, {})
+            if merge_instances:
+                cur = assign.setdefault(record.name, [])
+                for i in instance_ids:
+                    if i not in cur:
+                        cur.append(i)
+            else:
+                assign[record.name] = list(instance_ids)
 
         self._tx(fn)
 
@@ -286,6 +304,33 @@ class ClusterRegistry:
                     lst = ev.setdefault(name, [])
                     if instance_id not in lst:
                         lst.append(instance_id)
+
+        self._tx(fn)
+
+    def scrub_instances(self, instance_ids) -> None:
+        """Remove hard-dead instances from every external-view AND
+        assignment entry in one transaction. Needed because (a) a killed
+        server can't deregister itself, and (b) merge_instances publishing
+        means assignment lists never self-clean — without a sweeper, ghost
+        replica ids accumulate forever (the reference gets both from Helix
+        dropping the dead participant's ephemeral node)."""
+        ids = set(instance_ids)
+        if not ids:
+            return
+
+        def fn(s):
+            hit = False
+            for table, ev in s["external_view"].items():
+                for seg, insts in list(ev.items()):
+                    if ids & set(insts):
+                        hit = True
+                        ev[seg] = [i for i in insts if i not in ids]
+            for table, assign in s["assignment"].items():
+                for seg, insts in list(assign.items()):
+                    if ids & set(insts):
+                        hit = True
+                        assign[seg] = [i for i in insts if i not in ids]
+            return hit
 
         self._tx(fn)
 
@@ -788,22 +833,50 @@ class FileRegistry(ClusterRegistry):
         self._sig[name] = self._file_sig(name)
         return data
 
-    def _write_section(self, name: str, data: dict) -> bool:
-        """Serialize and persist ONE section; returns False (and skips the
-        disk write) when the content is byte-identical to what's on disk —
-        read-shaped write txs (empty claim_task polls, no-op heartbeats)
-        must not churn files or invalidate peer caches."""
+    def _stage_section(self, name: str, data: dict):
+        """Serialize ONE section to a tmp file; returns (tmp_path, text), or
+        None (skipping the disk write) when the content is byte-identical to
+        what's on disk — read-shaped write txs (empty claim_task polls, no-op
+        heartbeats) must not churn files or invalidate peer caches.
+
+        Staging is separate from publishing (the os.replace in _tx) so a
+        multi-section tx hits its slow/fallible part — serialization + data
+        writes — before ANY section becomes visible to peers; the publish
+        pass is metadata-only renames."""
         # dumps-then-write hits the C encoder; json.dump's streaming
         # iterencode is ~10x slower on large sections
         text = json.dumps(_section_to_json(name, data))
         if text == self._raw.get(name):
-            return False
+            return None
         tmp = f"{self._section_path(name)}.{os.getpid()}"
-        with open(tmp, "w") as f:
-            f.write(text)
+        try:
+            with open(tmp, "w") as f:
+                f.write(text)
+        except Exception:
+            # a partial tmp (ENOSPC mid-write) must not linger — debris
+            # accumulates exactly when the disk is already full
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return tmp, text
+
+    def _publish_staged(self, name: str, tmp: str, text: str) -> None:
+        """Atomically swap a staged tmp into place + refresh cache
+        bookkeeping (single publication contract for both the one-section
+        and multi-section write paths)."""
         os.replace(tmp, self._section_path(name))
         self._raw[name] = text
         self._sig[name] = self._file_sig(name)
+
+    def _write_section(self, name: str, data: dict) -> bool:
+        """Stage + publish ONE section (single-section callers like legacy
+        migration, where cross-section atomicity doesn't apply)."""
+        s = self._stage_section(name, data)
+        if s is None:
+            return False
+        self._publish_staged(name, *s)
         return True
 
     def _drop_cache(self) -> None:
@@ -826,10 +899,32 @@ class FileRegistry(ClusterRegistry):
             try:
                 out = fn(state)
                 if write and state.accessed:
-                    changed = [name for name in state.accessed
-                               if self._write_section(name, self._cache[name])]
-                    if changed:
-                        self._bump_version(changed)
+                    # two-phase write-back: stage every dirty section fully,
+                    # THEN publish with a tight rename-only loop, so a crash
+                    # or serialization error mid-tx leaves peers seeing either
+                    # none or all of a cross-section transaction (the advisor
+                    # case: segments updated but external_view not)
+                    staged = []
+                    try:
+                        for name in state.accessed:
+                            s = self._stage_section(name, self._cache[name])
+                            if s is not None:
+                                staged.append((name, *s))
+                        for name, tmp, text in staged:
+                            self._publish_staged(name, tmp, text)
+                    except Exception:
+                        # staging failure → nothing published; publish
+                        # failure → torn state is unavoidable (renames are
+                        # metadata-only, so this is a pathological fs), but
+                        # at least don't leak the unpublished tmps
+                        for _, tmp, _ in staged:
+                            try:
+                                os.unlink(tmp)
+                            except OSError:
+                                pass
+                        raise
+                    if staged:
+                        self._bump_version([name for name, _, _ in staged])
             except Exception:
                 # fn (or a failed write-back) may have left cached sections
                 # diverged from disk: never serve them again

@@ -230,6 +230,25 @@ class Controller:
             if dirty:
                 self.registry.set_partition_assignment(table, new_pa)
                 changed[table] = new_pa
+        # scrub hard-dead instances out of the external view + assignment:
+        # a killed server can't deregister itself, stale EV entries keep
+        # brokers routing (and 427-ing) at it, and merge_instances
+        # publishing means assignment ghosts never self-clean (the
+        # reference gets all of this from Helix dropping the dead
+        # participant's ephemeral node). Conservative cut: 2x the liveness
+        # TTL — a server mid-way through a long segment download heartbeats
+        # late but isn't dead — and never sweep when NO server looks live
+        # (host suspend/resume makes every heartbeat stale at once; a
+        # routing blackout is worse than stale entries).
+        if live:
+            hard_live = {
+                i.instance_id
+                for i in self.registry.instances(
+                    Role.SERVER, live_ttl_ms=self.assigner.live_ttl_ms * 2)
+            }
+            registered = {i.instance_id
+                          for i in self.registry.instances(Role.SERVER)}
+            self.registry.scrub_instances(registered - hard_live)
         return changed
 
     # ---- segment lifecycle -----------------------------------------------

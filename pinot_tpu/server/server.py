@@ -169,7 +169,7 @@ class ServerInstance:
         timer = self.metrics.timed("query")
         timer.__enter__()
         q = optimize_query(compile_query(req["sql"]))
-        tracer = trace.start_trace() if dict(q.options).get("trace") else None
+        tracer = trace.start_trace() if q.options_ci().get("trace") else None
         try:
             q = _apply_request_overrides(q, req)
             tdm = self.engine.tables.get(q.table_name)
@@ -469,6 +469,7 @@ class ServerInstance:
                 location="", state=SegmentState.CONSUMING,
             ),
             [self.instance_id],
+            merge_instances=True,
         )
 
     def _publish_committed(self, table: str, partition: int, sealed) -> None:
@@ -485,4 +486,5 @@ class ServerInstance:
                 **_partition_record_fields(meta),
             ),
             [self.instance_id],
+            merge_instances=True,
         )

@@ -80,17 +80,53 @@ class TestSectionedPersistence:
         reg = FileRegistry(path)
         reg.register_instance(InstanceInfo("s1", Role.SERVER))
 
-        real = FileRegistry._write_section
+        real = FileRegistry._stage_section
 
         def boom(self, name, data):
             raise OSError("disk full")
 
-        monkeypatch.setattr(FileRegistry, "_write_section", boom)
+        monkeypatch.setattr(FileRegistry, "_stage_section", boom)
         with pytest.raises(OSError):
             reg.register_instance(InstanceInfo("s2", Role.SERVER))
-        monkeypatch.setattr(FileRegistry, "_write_section", real)
+        monkeypatch.setattr(FileRegistry, "_stage_section", real)
         assert [i.instance_id for i in reg.instances()] == ["s1"]
         assert [i.instance_id for i in FileRegistry(path).instances()] == ["s1"]
+
+    def test_partial_stage_failure_publishes_nothing(self, tmp_path, monkeypatch):
+        """Cross-section tx atomicity (r3 advisor): if staging section B
+        fails after section A staged OK, NEITHER section is published —
+        peers must never observe a torn multi-section transaction."""
+        path = str(tmp_path / "c.json")
+        reg = FileRegistry(path)
+        reg.register_instance(InstanceInfo("s1", Role.SERVER))
+
+        real = FileRegistry._stage_section
+        calls = {"n": 0}
+
+        def fail_second(self, name, data):
+            calls["n"] += 1
+            if calls["n"] >= 2:
+                raise OSError("disk full")
+            return real(self, name, data)
+
+        def multi_section_tx(state):
+            # touch two sections so both are dirty in one tx
+            state["instances"]["s2"] = state["instances"]["s1"]
+            state["tasks"]["t1"] = {"status": "pending"}
+
+        monkeypatch.setattr(FileRegistry, "_stage_section", fail_second)
+        with pytest.raises(OSError):
+            reg._tx(multi_section_tx)
+        monkeypatch.setattr(FileRegistry, "_stage_section", real)
+        # neither the staged-OK section nor the failed one is visible,
+        # in this process or a fresh peer
+        assert [i.instance_id for i in reg.instances()] == ["s1"]
+        peer = FileRegistry(path)
+        assert [i.instance_id for i in peer.instances()] == ["s1"]
+        assert peer._tx(lambda s: dict(s["tasks"]), write=False) == {}
+        # and no orphaned staging tmp files linger in the section dir
+        assert not [f for f in os.listdir(reg.dir)
+                    if f.split(".")[-1].isdigit()]
 
     def test_peer_crash_between_write_and_bump_not_stale(self, tmp_path):
         """Cache validates against the section FILE, not the version
