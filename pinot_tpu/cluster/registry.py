@@ -308,29 +308,24 @@ class ClusterRegistry:
         self._tx(fn)
 
     def scrub_instances(self, instance_ids) -> None:
-        """Remove hard-dead instances from every external-view AND
-        assignment entry in one transaction. Needed because (a) a killed
-        server can't deregister itself, and (b) merge_instances publishing
-        means assignment lists never self-clean — without a sweeper, ghost
-        replica ids accumulate forever (the reference gets both from Helix
-        dropping the dead participant's ephemeral node)."""
+        """Remove hard-dead instances from every external-view entry in one
+        transaction — a killed server can't deregister itself, and stale EV
+        entries keep brokers routing at it (the reference gets this from
+        Helix dropping the dead participant's ephemeral node). The
+        ASSIGNMENT (ideal state) is deliberately untouched: stripping it
+        would make a transiently-stalled server delete its local copies on
+        return; assignment ghosts are cleaned by the controller's
+        rebalance-on-dead repair, which restores replication on live
+        servers in the same move."""
         ids = set(instance_ids)
         if not ids:
             return
 
         def fn(s):
-            hit = False
             for table, ev in s["external_view"].items():
                 for seg, insts in list(ev.items()):
                     if ids & set(insts):
-                        hit = True
                         ev[seg] = [i for i in insts if i not in ids]
-            for table, assign in s["assignment"].items():
-                for seg, insts in list(assign.items()):
-                    if ids & set(insts):
-                        hit = True
-                        assign[seg] = [i for i in insts if i not in ids]
-            return hit
 
         self._tx(fn)
 

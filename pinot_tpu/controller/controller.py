@@ -77,11 +77,15 @@ class SegmentAssigner:
         ordered = sorted(counts, key=lambda i: counts[i])
         return ordered[: max(1, min(replication, len(ordered)))]
 
-    def rebalance(self, table: str, replication: int) -> dict:
+    def rebalance(self, table: str, replication: int,
+                  servers: Optional[list] = None) -> dict:
         """Minimal-movement rebalance (rebalance/TableRebalancer.java): keep
         existing replicas where possible, move only to fix replication or
-        heavy skew."""
-        servers = [i.instance_id for i in self._live_servers()]
+        heavy skew. ``servers`` overrides the liveness-derived target set
+        (the dead-instance repair passes the conservative hard-live set so
+        a merely-slow server isn't stripped of its replicas)."""
+        if servers is None:
+            servers = [i.instance_id for i in self._live_servers()]
         if not servers:
             return {}
         current = self.registry.assignment(table)
@@ -230,16 +234,20 @@ class Controller:
             if dirty:
                 self.registry.set_partition_assignment(table, new_pa)
                 changed[table] = new_pa
-        # scrub hard-dead instances out of the external view + assignment:
-        # a killed server can't deregister itself, stale EV entries keep
-        # brokers routing (and 427-ing) at it, and merge_instances
-        # publishing means assignment ghosts never self-clean (the
-        # reference gets all of this from Helix dropping the dead
-        # participant's ephemeral node). Conservative cut: 2x the liveness
-        # TTL — a server mid-way through a long segment download heartbeats
-        # late but isn't dead — and never sweep when NO server looks live
-        # (host suspend/resume makes every heartbeat stale at once; a
-        # routing blackout is worse than stale entries).
+        # Hard-dead repair (the reference gets this from Helix dropping the
+        # dead participant's ephemeral node + the periodic validators):
+        # 1. scrub dead instances from the external view — a killed server
+        #    can't deregister itself, and stale EV entries keep brokers
+        #    routing (and 427-ing) at it;
+        # 2. rebalance tables whose ASSIGNMENT references a dead instance,
+        #    against the conservatively-live server set — this restores
+        #    replication on live servers AND bounds the assignment ghosts
+        #    merge_instances publishing would otherwise accumulate.
+        # Conservative cut: 2x the liveness TTL — a server mid-way through
+        # a long segment download heartbeats late but isn't dead — and
+        # never sweep when NO server looks live (host suspend/resume makes
+        # every heartbeat stale at once; a routing blackout is worse than
+        # stale entries).
         if live:
             hard_live = {
                 i.instance_id
@@ -248,7 +256,20 @@ class Controller:
             }
             registered = {i.instance_id
                           for i in self.registry.instances(Role.SERVER)}
-            self.registry.scrub_instances(registered - hard_live)
+            dead = registered - hard_live
+            if dead:
+                self.registry.scrub_instances(dead)
+                for table in self.registry.tables():
+                    assign = self.registry.assignment(table)
+                    if not any(dead & set(v) for v in assign.values()):
+                        continue
+                    cfg = self.registry.table_config(table)
+                    if cfg is None:
+                        continue
+                    self.assigner.rebalance(
+                        table, self._table_replication(cfg),
+                        servers=sorted(hard_live),
+                    )
         return changed
 
     # ---- segment lifecycle -----------------------------------------------

@@ -217,10 +217,17 @@ class DistinctCountSpec(AggSpec):
         return {"sets": _obj_array(n, set)}
 
     def scatter_merge(self, acc, idx, part):
+        if "cnt" in part:
+            raise AssertionError(
+                "finalized distinct counts are not mergeable — 'cnt' "
+                "partials only occur on the terminal single-partial path")
         for i, g in enumerate(idx):
             acc["sets"][g] |= part["sets"][i]
 
     def finalize(self, part):
+        if "cnt" in part:
+            # terminal device path: the popcount already happened on device
+            return np.asarray(part["cnt"], dtype=np.int64)
         return np.array([len(s) for s in part["sets"]], dtype=np.int64)
 
     def result_type(self):
@@ -247,13 +254,69 @@ class DistinctCountHLLSpec(AggSpec):
         return {"regs": np.zeros((n, self.m), dtype=np.int32)}
 
     def scatter_merge(self, acc, idx, part):
+        if "est" in part:
+            raise AssertionError(
+                "finalized HLL estimates are not mergeable — 'est' "
+                "partials only occur on the terminal single-partial path")
         np.maximum.at(acc["regs"], idx, part["regs"])
 
     def finalize(self, part):
-        return np.array([hll_ops.estimate(r) for r in part["regs"]], dtype=np.int64)
+        if "est" in part:
+            # terminal device path: estimated on device, registers never
+            # crossed the host link
+            return np.asarray(part["est"], dtype=np.int64)
+        if len(part["regs"]) == 0:
+            return np.zeros(0, dtype=np.int64)
+        return hll_ops.estimate_batch_np(part["regs"])
 
     def result_type(self):
         return "LONG"
+
+
+def bytes_planes(values, m: int) -> np.ndarray:
+    """(n_rows, m) int32 register planes from a fixed-width BYTES column
+    (np 'S<m>' array or object array of bytes). The numpy view recovers
+    trailing zero registers that element access would strip."""
+    arr = np.asarray(values)
+    if arr.dtype.kind == "S":
+        if arr.dtype.itemsize != m:
+            raise ValueError(
+                f"HLLMERGE state column width {arr.dtype.itemsize} != "
+                f"register count {m} — was the cube built with a different "
+                f"log2m?")
+        return arr.view(np.uint8).reshape(len(arr), m).astype(np.int32)
+    out = np.zeros((len(arr), m), dtype=np.int32)
+    for i, b in enumerate(arr):
+        if not isinstance(b, (bytes, bytearray)):
+            raise ValueError(
+                "HLLMERGE requires a BYTES column of HLL register planes "
+                f"(got {type(b).__name__} values)")
+        if len(b) > m:
+            raise ValueError(
+                f"HLLMERGE plane of {len(b)} bytes exceeds register "
+                f"count {m}")
+        out[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+class HllMergeSpec(DistinctCountHLLSpec):
+    """HLLMERGE(state_col[, log2m]): max-merge pre-aggregated HLL register
+    planes (one fixed-width BYTES row = one int8 register plane) into the
+    same canonical {"regs"} partial DISTINCTCOUNTHLL produces.
+
+    This is the star-tree execution rewrite of DISTINCTCOUNTHLL over the
+    cube's sketch column — the reference's DistinctCountHLLAggregationFunction
+    byte[]-input merge path paired with DistinctCountHLLValueAggregator
+    (pinot-segment-local/.../aggregator/DistinctCountHLLValueAggregator.java:1).
+    """
+
+    name = "hllmerge"
+
+    def host_groups(self, arg_values, group_idx, n):
+        planes = bytes_planes(arg_values[0], self.m)
+        acc = np.zeros((n, self.m), dtype=np.int32)
+        np.maximum.at(acc, np.asarray(group_idx), planes)
+        return {"regs": acc}
 
 
 class RawHLLSpec(DistinctCountHLLSpec):
@@ -786,6 +849,7 @@ _SPECS = {
     "distinctcountbitmap": DistinctCountSpec,  # same exact semantics
     "segmentpartitioneddistinctcount": DistinctCountSpec,
     "distinctcounthll": DistinctCountHLLSpec,
+    "hllmerge": HllMergeSpec,
     "distinctcountthetasketch": DistinctCountThetaSketchSpec,
     "distinctcountrawthetasketch": DistinctCountThetaSketchSpec,
     "percentile": PercentileSpec,

@@ -46,6 +46,7 @@ DEVICE_AGGS = {
     "count", "sum", "min", "max", "avg", "minmaxrange",
     "distinctcount", "distinctcountbitmap", "distinctcounthll",
     "segmentpartitioneddistinctcount",
+    "hllmerge",  # star-tree sketch-state re-merge (engine/startree_exec.py)
 }
 
 MAX_DENSE_GROUPS = 1 << 22        # ARRAY_BASED regime guard (~4M groups)
@@ -252,14 +253,109 @@ def _resolve_mm_mode(mm_mode: str) -> str:
     return mm_mode
 
 
+def _finalize_sketch_outs(outs, agg_tpls):
+    """TERMINAL-query device finalize (traced, applied AFTER the mesh
+    combine so multi-shard presence/register merges stay max-semantics):
+    HLL registers → int64 estimates, distinct presence → int64 popcounts.
+    Only answer-sized arrays cross the host link instead of G×m mergeable
+    state — on the bench tunnel (~5MB/s) a 2000-group log2m=11 register
+    plane is 4MB ≈ 1s of transfer for 16KB of answers."""
+    outs = dict(outs)
+    for i, (name, _argt, _extra) in enumerate(agg_tpls):
+        k = f"a{i}"
+        if name == "distinctcount" and f"{k}_pres" in outs:
+            pres = outs.pop(f"{k}_pres")
+            outs[f"{k}_cnt"] = jnp.sum(pres, axis=-1, dtype=jnp.int64)
+        elif name in ("distinctcounthll", "hllmerge") and f"{k}_regs" in outs:
+            regs = outs.pop(f"{k}_regs")
+            if regs.ndim == 1:
+                outs[f"{k}_est"] = hll_ops.estimate_jnp(regs[None, :])[0]
+            else:
+                outs[f"{k}_est"] = hll_ops.estimate_jnp(regs)
+    return outs
+
+
+def _is_f64(dt) -> bool:
+    return np.dtype(dt) == np.float64
+
+
+def _pack_outs(outs):
+    """Flatten the output leaves into at most TWO arrays: a uint8 buffer
+    (bitcast + concat) and a float64 buffer (concat only).
+
+    The result crosses the host link as few arrays as possible:
+    jax.device_get fetches tree leaves serially, and on a high-latency
+    link (the bench tunnel RTT is ~100ms) each extra leaf is an extra
+    round trip — a 3-leaf scalar aggregation paid 3x the floor. float64
+    rides its own buffer because the TPU AOT x64 rewriter has no
+    bitcast-convert lowering for f64 (i64 works). Bitcast leaves are
+    ordered by descending itemsize so every offset stays naturally
+    aligned for zero-copy np views on the host side."""
+    names = sorted(outs, key=lambda n: (-jnp.dtype(outs[n].dtype).itemsize, n))
+    bleaves, fleaves = [], []
+    for n in names:
+        x = outs[n]
+        if _is_f64(x.dtype):
+            fleaves.append(x.reshape(-1))
+            continue
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.uint8)
+        bleaves.append(jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1))
+    packed = {}
+    if bleaves:
+        packed["b"] = jnp.concatenate(bleaves) if len(bleaves) > 1 else bleaves[0]
+    if fleaves:
+        packed["f"] = jnp.concatenate(fleaves) if len(fleaves) > 1 else fleaves[0]
+    return packed
+
+
+def _out_layout(out_shapes) -> list:
+    """[(name, np_dtype, shape, buffer_key, offset_elems_or_bytes, nbytes)]
+    matching _pack_outs order, from a jax.eval_shape result (no device
+    work). Offsets are bytes in the "b" buffer, elements in "f"."""
+    items = sorted(
+        out_shapes.items(),
+        key=lambda kv: (-np.dtype(kv[1].dtype).itemsize, kv[0]),
+    )
+    layout, boff, foff = [], 0, 0
+    for name, sds in items:
+        dt = np.dtype(sds.dtype)
+        n_elems = int(np.prod(sds.shape, dtype=np.int64))
+        if _is_f64(dt):
+            layout.append((name, dt, tuple(sds.shape), "f", foff, n_elems))
+            foff += n_elems
+            continue
+        if dt == np.bool_:
+            dt = np.dtype(np.uint8)
+        nbytes = dt.itemsize * n_elems
+        layout.append((name, dt, tuple(sds.shape), "b", boff, nbytes))
+        boff += nbytes
+    return layout
+
+
+def _unpack_outs(bufs: dict, layout) -> dict:
+    outs = {}
+    for name, dt, shp, which, off, size in layout:
+        buf = bufs[which]
+        if which == "f":
+            outs[name] = buf[off:off + size].reshape(shp)
+        else:
+            outs[name] = buf[off:off + size].view(dt).reshape(shp)
+    return outs
+
+
 def build_pipeline(template, mm_mode: str = "auto"):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
     ``mm_mode``: "auto" → the factored one-hot matmul kernel
     (ops/groupby_mm.py) on TPU, scatter elsewhere; "interpret" forces the
     kernel in Pallas interpret mode (CPU tests); "off" forces scatter.
+
+    The trailing ``final`` template field is consumed OUTSIDE this function
+    (``_finalize_sketch_outs``, applied after the mesh combine) — here it
+    only participates in the cache key.
     """
-    shape, filter_tpl, group_cols, group_cards, aggs, sorted_k = template
+    shape, filter_tpl, group_cols, group_cards, aggs, sorted_k, _final = template
     mm_mode = _resolve_mm_mode(mm_mode)
     num_groups = 1
     for c in group_cards:
@@ -429,6 +525,16 @@ def build_pipeline(template, mm_mode: str = "auto"):
                     outs[f"{k}_regs"] = _hll_regs(
                         slot, rho, num_groups, log2m, mm_mode
                     )
+                elif name == "hllmerge":
+                    # cube rows carry whole register planes: scatter-max the
+                    # (rows, m) planes into (G, m) — rows ≈ distinct dim
+                    # combos, so this is answer-sized work
+                    m = 1 << extra
+                    planes = cols["bp::" + argt].astype(jnp.int32)
+                    gid2 = jnp.where(mask, gid, num_groups).reshape(-1)
+                    regs = jnp.zeros((num_groups + 1, m), dtype=jnp.int32)
+                    regs = regs.at[gid2].max(planes.reshape(-1, m))
+                    outs[f"{k}_regs"] = regs[:num_groups]
             return outs
 
         # scalar aggregation shape
@@ -459,6 +565,11 @@ def build_pipeline(template, mm_mode: str = "auto"):
                 idx, rho = hll_ops.hll_idx_rho(h, log2m)
                 slot = jnp.where(mask, idx, m)
                 outs[f"{k}_regs"] = _hll_regs(slot, rho, 1, log2m, mm_mode)[0]
+            elif name == "hllmerge":
+                m = 1 << extra
+                planes = cols["bp::" + argt].astype(jnp.int32)
+                outs[f"{k}_regs"] = jnp.max(
+                    jnp.where(mask[..., None], planes, 0), axis=(0, 1))
         return outs
 
     return pipeline  # caller jits (single-device) or shard_maps (mesh)
@@ -487,6 +598,9 @@ class DeviceExecutor:
         self.num_groups_limit = max(1, num_groups_limit)
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
         self._pipelines: dict = {}   # (template, mm_mode) -> jitted/sharded fn
+        # cumulative host-link observability (bench reads deltas per query)
+        self.fetch_bytes_total = 0
+        self.fetch_leaves_total = 0
 
     # cheap static check (EXPLAIN backend display)
     def supports(self, q: QueryContext) -> bool:
@@ -524,10 +638,14 @@ class DeviceExecutor:
             lru = next(k for k in self._batches if k != keep)
             self._batches.pop(lru)
 
-    def try_execute(self, q: QueryContext, segments):
-        """list[IntermediateResult] (length 1) or None → host fallback."""
+    def try_execute(self, q: QueryContext, segments, final: bool = False):
+        """list[IntermediateResult] (length 1) or None → host fallback.
+
+        ``final=True``: this result will be finalized directly with no
+        upstream merge (terminal local query) — sketch aggregations may
+        finalize on device and ship answers instead of mergeable state."""
         try:
-            return [self._execute(q, segments)]
+            return [self._execute(q, segments, final)]
         except DeviceUnsupported:
             return None
 
@@ -551,6 +669,16 @@ class DeviceExecutor:
                 raise DeviceUnsupported("distinctcounthll device path needs a dict column")
             spec = aggspec.make_spec(a)
             return ("distinctcounthll", arg.name, spec.log2m)
+        if name == "hllmerge":
+            arg = a.args[0]
+            if not arg.is_identifier or ctx.encoding(arg.name) != Encoding.DICT:
+                raise DeviceUnsupported("hllmerge needs a dict BYTES column")
+            spec = aggspec.make_spec(a)
+            width = ctx.bytes_width(arg.name)
+            if width != spec.m:
+                raise DeviceUnsupported(
+                    f"hllmerge plane width {width} != m {spec.m}")
+            return ("hllmerge", arg.name, spec.log2m)
         # numeric-arg aggregations
         argt = build_expr(a.args[0], ctx, params, counter)
         rpb = None
@@ -570,7 +698,8 @@ class DeviceExecutor:
             return (name, argt, (nplanes, rpb))
         return (name, argt, rpb)
 
-    def _execute(self, q: QueryContext, segments) -> IntermediateResult:
+    def _execute(self, q: QueryContext, segments,
+                 final: bool = False) -> IntermediateResult:
         aggs = q.aggregations()
         if q.distinct:
             # DISTINCT == group-by over the select columns with no aggs:
@@ -634,7 +763,8 @@ class DeviceExecutor:
                         f"agg {a.name} not on the sorted group-by path")
             shape = "groupby_sorted"
         for name, argt, extra in agg_tpls:
-            if shape == "groupby" and name in ("distinctcount", "distinctcounthll"):
+            if shape == "groupby" and name in (
+                    "distinctcount", "distinctcounthll", "hllmerge"):
                 cells = extra if name == "distinctcount" else (1 << extra)
                 for c in group_cards:
                     cells *= c
@@ -642,19 +772,37 @@ class DeviceExecutor:
                     raise DeviceUnsupported(f"{name} per-group state too large ({cells})")
         sorted_k = min(self.num_groups_limit, MAX_SORTED_GROUPS) \
             if shape == "groupby_sorted" else 0
+        # final only changes sketch outputs; don't fork the jit cache for
+        # templates where it is a no-op
+        final = final and any(
+            name in ("distinctcount", "distinctcounthll", "hllmerge")
+            for name, _, _ in agg_tpls
+        )
         template = (shape, filter_tpl, group_cols, group_cards, agg_tpls,
-                    sorted_k)
+                    sorted_k, final)
 
-        pipeline = self._pipelines.get((template, self.mm_mode))
-        if pipeline is None:
+        entry = self._pipelines.get((template, self.mm_mode))
+        if entry is None:
             raw = build_pipeline(template, self.mm_mode)
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
 
-                pipeline = shard_pipeline(raw, self.mesh)
+                sharded = shard_pipeline(raw, self.mesh)
             else:
-                pipeline = jax.jit(raw)
-            self._pipelines[(template, self.mm_mode)] = pipeline
+                sharded = raw
+            if final:
+                # device finalize runs AFTER the cross-shard max-combine
+                def inner(cols, n_docs, params, _fn=sharded):
+                    return _finalize_sketch_outs(
+                        _fn(cols, n_docs, params), agg_tpls)
+            else:
+                inner = sharded
+            pipeline = jax.jit(
+                lambda cols, n_docs, params: _pack_outs(inner(cols, n_docs, params))
+            )
+            entry = (pipeline, inner, {})
+            self._pipelines[(template, self.mm_mode)] = entry
+        pipeline, inner, layout_cache = entry
 
         needed = self._needed_columns(filter_tpl) | set(group_cols)
         for name, argt, extra in agg_tpls:
@@ -662,6 +810,8 @@ class DeviceExecutor:
                 needed.add(argt)
             elif name == "distinctcounthll":
                 needed.add("hh::" + argt)
+            elif name == "hllmerge":
+                needed.add("bp::" + argt)
             elif argt is not None:
                 needed |= self._needed_columns(argt)
         cols = {}
@@ -670,6 +820,8 @@ class DeviceExecutor:
                 cols[c] = ctx.decoded_column(c[4:])
             elif c.startswith("hh::"):
                 cols[c] = ctx.prehashed_column(c[4:])
+            elif c.startswith("bp::"):
+                cols[c] = ctx.bytes_plane_column(c[4:])
             elif c.startswith("mv::"):
                 cols[c] = ctx.mv_column(c[4:])
             else:
@@ -686,10 +838,22 @@ class DeviceExecutor:
                 cols, n_docs, params, self.mesh.devices.size
             )
 
-        # single batched host transfer: per-leaf np.asarray costs one tunnel
-        # round-trip each, device_get overlaps them (measured 4-5x)
-        outs = jax.device_get(pipeline(cols, n_docs, params))
-        outs = {k: np.asarray(v) for k, v in outs.items()}
+        # ONE packed buffer crosses the host link: device_get fetches tree
+        # leaves serially, so on a high-RTT link every leaf would be a full
+        # round trip (measured ~100ms each on the bench tunnel). The layout
+        # is shape-deterministic per (template, batch shapes) — eval_shape
+        # traces without touching the device.
+        lkey = (ctx.S, next(iter(cols.values())).shape[1])
+        layout = layout_cache.get(lkey)
+        if layout is None:
+            layout = _out_layout(jax.eval_shape(inner, cols, n_docs, params))
+            layout_cache[lkey] = layout
+        bufs = jax.device_get(pipeline(cols, n_docs, params))
+        bufs = {k: np.asarray(v) for k, v in bufs.items()}
+        # observability: what actually crossed the host link (bench breakdown)
+        self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())
+        self.fetch_leaves_total += len(bufs)
+        outs = _unpack_outs(bufs, layout)
         self._evict(keep=self._batch_key(segments))
         return self._to_intermediate(q, ctx, template, outs, aggs)
 
@@ -716,7 +880,7 @@ class DeviceExecutor:
 
     # ---- device outputs → canonical IntermediateResult -------------------
     def _to_intermediate(self, q, ctx: BatchContext, template, outs, aggs):
-        shape, _, group_cols, group_cards, agg_tpls, sorted_k = template
+        shape, _, group_cols, group_cards, agg_tpls, sorted_k, _final = template
         doc_count = int(outs["doc_count"])
         # mirror the host executor's stats accounting so responses are
         # backend-independent (host.py execute_segment)
@@ -807,12 +971,16 @@ class DeviceExecutor:
                 "max": np.asarray([outs[f"{k}_max"]], dtype=np.float64),
             }
         if name == "distinctcount":
+            if f"{k}_cnt" in outs:  # terminal: popcount came from device
+                return {"cnt": np.asarray([outs[f"{k}_cnt"]], dtype=np.int64)}
             pres = outs[f"{k}_pres"]
             vals = ctx.global_dict(argt).take(np.nonzero(pres > 0)[0])
             s = np.empty(1, dtype=object)
             s[0] = set(np.asarray(vals).tolist())
             return {"sets": s}
-        if name == "distinctcounthll":
+        if name in ("distinctcounthll", "hllmerge"):
+            if f"{k}_est" in outs:  # terminal: estimated on device
+                return {"est": np.asarray([outs[f"{k}_est"]], dtype=np.int64)}
             return {"regs": outs[f"{k}_regs"].reshape(1, -1)}
         raise AssertionError(name)
 
@@ -838,12 +1006,16 @@ class DeviceExecutor:
                 "max": outs[f"{k}_max"][present].astype(np.float64),
             }
         if name == "distinctcount":
+            if f"{k}_cnt" in outs:  # terminal: popcounts came from device
+                return {"cnt": outs[f"{k}_cnt"][present].astype(np.int64)}
             pres = outs[f"{k}_pres"][present]
             gvals = np.asarray(ctx.global_dict(argt).values)
             sets = np.empty(len(present), dtype=object)
             for j in range(len(present)):
                 sets[j] = set(gvals[np.nonzero(pres[j] > 0)[0]].tolist())
             return {"sets": sets}
-        if name == "distinctcounthll":
+        if name in ("distinctcounthll", "hllmerge"):
+            if f"{k}_est" in outs:  # terminal: estimated on device
+                return {"est": outs[f"{k}_est"][present].astype(np.int64)}
             return {"regs": outs[f"{k}_regs"][present]}
         raise AssertionError(name)

@@ -221,16 +221,22 @@ class QueryEngine:
         try:
             if not segments:
                 raise ValueError(f"table {q.table_name!r} has no segments")
-            merged = self.execute_segments(q, segments)
+            merged = self.execute_segments(q, segments, terminal=True)
             q = self._expand_star(q, segments[0])
             return finalize(q, merged), merged.stats
         finally:
             tdm.release(segments)
 
-    def execute_segments(self, q: QueryContext, segments):
+    def execute_segments(self, q: QueryContext, segments, terminal: bool = False):
         """Server-side partial execution over an explicit segment list →
         merged (unfinalized) IntermediateResult — what a server ships to the
-        broker as a DataTable (ServerQueryExecutorV1Impl.processQuery)."""
+        broker as a DataTable (ServerQueryExecutorV1Impl.processQuery).
+
+        ``terminal=True`` (the local execute_query path): nothing upstream
+        will merge this result, so when the device batch is the SOLE
+        partial, sketch aggregations may finalize on device and skip
+        shipping G×m mergeable state over the host link. Server-shipped
+        partials stay mergeable (the broker combines them)."""
         q = self._expand_star(q, segments[0])
 
         kept, pruned = [], 0
@@ -269,9 +275,14 @@ class QueryEngine:
                     grp["docs"] += s.n_docs
                 else:
                     remaining.append(s)
+            # a lone star-tree group with nothing to merge against stays
+            # terminal: its cube execution may finalize sketches on device
+            st_terminal = (terminal and not results and not remaining
+                           and len(st_groups) == 1)
             for grp in st_groups.values():
                 results.append(
-                    execute_star_tree_group(self, q, grp["meta"], grp["sts"], grp["docs"])
+                    execute_star_tree_group(self, q, grp["meta"], grp["sts"],
+                                            grp["docs"], terminal=st_terminal)
                 )
             scan = remaining
         else:
@@ -286,7 +297,11 @@ class QueryEngine:
                 (device_ok if segment_device_eligible(s) else host_segs).append(s)
             device_result = None
             if self.device is not None and device_ok:
-                device_result = self.device.try_execute(q, device_ok)
+                # device finalize is safe only when the device batch is the
+                # whole answer: no host segments, no star-tree/metadata
+                # partials to merge with
+                final = terminal and not results and not host_segs
+                device_result = self.device.try_execute(q, device_ok, final=final)
             if device_result is not None:
                 results.extend(device_result)
             else:

@@ -214,6 +214,39 @@ class BatchContext:
             self._prehashed[name] = jnp.asarray(blocks)
         return self._prehashed[name]
 
+    def bytes_width(self, name: str) -> int:
+        """Fixed byte width of a BYTES dict column's values (0 = not a
+        fixed-width bytes column)."""
+        widths = set()
+        for s in self.segments:
+            d = s.dictionary(name)
+            if d is None:
+                return 0
+            dt = np.asarray(d.values).dtype
+            if dt.kind != "S":
+                return 0
+            widths.add(dt.itemsize)
+        return widths.pop() if len(widths) == 1 else 0
+
+    def bytes_plane_column(self, name: str):
+        """(S, L, W) device array of raw byte planes for a fixed-width
+        BYTES dict column (HLLMERGE's pre-aggregated register planes) —
+        per-doc LUT gather on the host at upload, like decoded_column."""
+        key = "bp::" + name
+        if key not in self._decoded:
+            W = self.bytes_width(name)
+            if W == 0:
+                raise DeviceUnsupported(
+                    f"column {name} is not a fixed-width BYTES dict column")
+            blocks = np.zeros((self.S, self.pad_to, W), dtype=np.uint8)
+            for i, s in enumerate(self.segments):
+                vals = np.asarray(s.dictionary(name).values)
+                planes = vals.view(np.uint8).reshape(len(vals), W)
+                fwd = np.asarray(s.forward(name))
+                blocks[i, : len(fwd)] = planes[fwd]
+            self._decoded[key] = jnp.asarray(blocks)
+        return self._decoded[key]
+
     def device_bytes(self) -> int:
         """HBM resident bytes of materialized column blocks (columns +
         decoded + prehashed) — the executor's byte-aware LRU eviction key."""

@@ -34,6 +34,13 @@ def merge_intermediates(q: QueryContext, results: list) -> IntermediateResult:
     if not results:
         raise ValueError("no results to merge")
     shape = results[0].shape
+    if len(results) == 1 and shape in ("aggregation", "group_by", "distinct"):
+        # single partial: its keys are already unique (dense/sorted device
+        # tables and host group tables are deduped per execution), so the
+        # factorize + scatter_merge round is identity work — and on sketch
+        # partials it was the most expensive host step of the whole query
+        # (np.maximum.at over G×m registers)
+        return results[0]
     stats = ExecutionStats()
     for r in results:
         stats.merge(r.stats)

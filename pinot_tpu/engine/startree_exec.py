@@ -24,7 +24,20 @@ from pinot_tpu.engine.result import IntermediateResult
 from pinot_tpu.query.context import Expression, QueryContext
 from pinot_tpu.storage.startree import load_star_trees, pair_column, parse_pair
 
-_REWRITABLE = {"count", "sum", "min", "max", "avg", "minmaxrange"}
+_REWRITABLE = {"count", "sum", "min", "max", "avg", "minmaxrange",
+               "distinctcounthll"}
+
+
+def _q2_expr(fn: str, col: str, meta: dict) -> Expression:
+    """The cube-side aggregation expression for one mapping entry."""
+    if fn == "hllmerge":
+        # the state column's plane width must be decoded with the SAME m it
+        # was built with; carried as a literal arg like HLL's log2m
+        return Expression.function(
+            "hllmerge", Expression.identifier(col),
+            Expression.literal(int(meta["hll_log2m"])),
+        )
+    return Expression.function(fn, Expression.identifier(col))
 
 
 @dataclasses.dataclass
@@ -34,6 +47,7 @@ class StarTreePlan:
     # per original agg: list of (q2-agg expression, role) where role names the
     # canonical partial field the q2 partial feeds
     mapping: list
+    meta: dict
 
 
 def _available_pairs(meta: dict) -> set:
@@ -82,6 +96,18 @@ def fit(q: QueryContext, meta: dict) -> Optional[list]:
         if not arg.is_identifier:
             return None
         col = arg.name
+        if name == "distinctcounthll":
+            # sketch pair: cube rows carry register planes, re-merged by
+            # HLLMERGE — only if the plane resolution matches the query's
+            from pinot_tpu.engine.aggspec import make_spec
+
+            if ("distinctcounthll", col) not in pairs:
+                return None
+            if meta.get("hll_log2m") != make_spec(a).log2m:
+                return None
+            mapping.append(
+                [("hllmerge", pair_column("distinctcounthll", col), "state")])
+            continue
         need = {
             "sum": [("sum", col, "sum")],
             "min": [("min", col, "min")],
@@ -109,8 +135,7 @@ def build_plan(q: QueryContext, meta: dict, st_segment) -> Optional[StarTreePlan
     q2_aggs: dict = {}
     for entries in mapping:
         for fn, col, _role in entries:
-            expr = Expression.function(fn, Expression.identifier(col))
-            q2_aggs.setdefault(expr)
+            q2_aggs.setdefault(_q2_expr(fn, col, meta))
     q2 = dataclasses.replace(
         q,
         select_expressions=tuple(q2_aggs),
@@ -118,7 +143,8 @@ def build_plan(q: QueryContext, meta: dict, st_segment) -> Optional[StarTreePlan
         having=None,
         order_by=(),
     )
-    return StarTreePlan(q2=q2, st_segment=st_segment, mapping=mapping)
+    return StarTreePlan(q2=q2, st_segment=st_segment, mapping=mapping,
+                        meta=meta)
 
 
 def convert(result: IntermediateResult, plan: StarTreePlan, q: QueryContext,
@@ -130,10 +156,13 @@ def convert(result: IntermediateResult, plan: StarTreePlan, q: QueryContext,
     for orig, entries in zip(q.aggregations(), plan.mapping):
         partial: dict = {}
         for fn, col, role in entries:
-            expr = Expression.function(fn, Expression.identifier(col))
-            p2 = result.agg_partials[index[expr]]
+            p2 = result.agg_partials[index[_q2_expr(fn, col, plan.meta)]]
             if role == "count":
                 partial["count"] = np.rint(p2["sum"]).astype(np.int64)
+            elif role == "state":
+                # sketch states pass through verbatim (regs — or est when
+                # the cube execution finalized on device)
+                partial.update(p2)
             else:
                 partial[role] = p2[role if role in p2 else "sum"]
         out_partials.append(partial)
@@ -178,13 +207,15 @@ def fitting_tree(q: QueryContext, segment):
 
 
 def execute_star_tree_group(engine, q: QueryContext, meta: dict, st_segments: list,
-                            parent_total_docs: int) -> IntermediateResult:
+                            parent_total_docs: int,
+                            terminal: bool = False) -> IntermediateResult:
     """One batched execution over MANY segments' star-trees sharing a
     signature — a single device launch replaces per-segment tree traversals
     (and per-segment kernel dispatches, which dominate when the pre-agg data
-    is tiny)."""
+    is tiny). ``terminal``: no upstream merge — sketch re-merges may
+    finalize on device (convert passes their 'est' partials through)."""
     plan = build_plan(q, meta, st_segments[0])
-    r2 = engine.execute_segments(plan.q2, st_segments)
+    r2 = engine.execute_segments(plan.q2, st_segments, terminal=terminal)
     return convert(r2, plan, q, parent_total_docs)
 
 

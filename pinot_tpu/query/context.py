@@ -135,6 +135,8 @@ AGGREGATION_FUNCTIONS = frozenset(
         "percentilemv",
         "percentileestmv",
         "percentiletdigestmv",
+        # internal: star-tree sketch-state re-merge (engine/startree_exec.py)
+        "hllmerge",
     }
 )
 

@@ -35,7 +35,7 @@ META_FILE = "startree_meta.json"
 # function-column pair name separator (reference: AggregationFunctionColumnPair)
 SEP = "__"
 
-SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max"}
+SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max", "distinctcounthll"}
 
 
 def parse_pair(pair: str):
@@ -76,12 +76,31 @@ def build_star_trees(segment, star_tree_configs) -> None:
         for d in dims:
             meta = segment.column_metadata(d)
             dim_specs.append((d, meta.data_type))
+        hll_log2m = None
         for fn, col in pairs:
             name = pair_column(fn, col)
             if fn == "count":
                 acc = np.zeros(n_groups, dtype=np.int64)
                 np.add.at(acc, ginv, 1)
                 metric_specs.append((name, DataType.LONG))
+            elif fn == "distinctcounthll":
+                # sketch pre-aggregation (DistinctCountHLLValueAggregator):
+                # one int8 register plane per cube row, stored as a
+                # fixed-width BYTES metric; queries re-merge planes by max
+                # through the HLLMERGE rewrite (engine/startree_exec.py).
+                # Same value hashing as the scan path (ops/hll.registers_np)
+                # so cube and scan estimates are bit-identical.
+                from pinot_tpu.ops import hll as hll_ops
+
+                hll_log2m = hll_ops.DEFAULT_LOG2M
+                regs = hll_ops.registers_np(
+                    np.asarray(segment.values(col)), ginv, n_groups,
+                    hll_log2m,
+                )
+                m = 1 << hll_log2m
+                acc = np.ascontiguousarray(
+                    regs.astype(np.uint8)).view(f"S{m}").reshape(n_groups)
+                metric_specs.append((name, DataType.BYTES))
             else:
                 v = np.asarray(segment.values(col), dtype=np.float64)
                 if fn == "sum":
@@ -112,6 +131,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
                     "dimensions_split_order": dims,
                     "function_column_pairs": list(cfg.function_column_pairs),
                     "max_leaf_records": cfg.max_leaf_records,
+                    "hll_log2m": hll_log2m,
                 },
                 f,
             )

@@ -41,7 +41,7 @@ def engines(tmp_path_factory):
         dimensions_split_order=["d_year", "d_region", "d_category"],
         function_column_pairs=[
             "SUM__revenue", "COUNT__*", "MIN__revenue", "MAX__revenue",
-            "SUM__quantity",
+            "SUM__quantity", "DISTINCTCOUNTHLL__quantity",
         ],
     )
     cfg = TableConfig(
@@ -84,6 +84,13 @@ ST_QUERIES = [
     "SELECT d_region, AVG(revenue) FROM ssb GROUP BY d_region ORDER BY d_region",
     "SELECT d_year, MINMAXRANGE(revenue) FROM ssb GROUP BY d_year ORDER BY d_year",
     "SELECT SUM(quantity) FROM ssb WHERE d_region != 'AFRICA'",
+    # sketch pre-aggregation (DistinctCountHLLValueAggregator analog): the
+    # cube's register planes must merge to BIT-IDENTICAL estimates vs the
+    # scan path (same value hashing on both sides)
+    "SELECT DISTINCTCOUNTHLL(quantity) FROM ssb",
+    "SELECT DISTINCTCOUNTHLL(quantity) FROM ssb WHERE d_region = 'ASIA'",
+    "SELECT d_year, COUNT(*), AVG(revenue), DISTINCTCOUNTHLL(quantity) "
+    "FROM ssb GROUP BY d_year ORDER BY COUNT(*) DESC, d_year LIMIT 5",
 ]
 
 
@@ -127,6 +134,29 @@ def test_unfit_queries_fall_through(engines):
     assert opt["resultTable"]["rows"] == plain_engine.execute(
         "SELECT SUM(revenue) FROM ssb WHERE d_region = 'ASIA'"
     )["resultTable"]["rows"]
+
+
+def test_hll_pre_aggregation_used(engines):
+    """The HLL query must run over cube rows, not raw docs."""
+    st_engine, plain_engine, _ = engines
+    sql = "SELECT d_year, DISTINCTCOUNTHLL(quantity) FROM ssb GROUP BY d_year"
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
+    assert a["numDocsScanned"] < b["numDocsScanned"] / 3, (
+        a["numDocsScanned"], b["numDocsScanned"])
+
+
+def test_hll_log2m_mismatch_falls_through(engines):
+    """A query at a different register resolution than the cube's must scan
+    (merging planes of the wrong m would silently skew the estimate)."""
+    st_engine, plain_engine, _ = engines
+    sql = "SELECT DISTINCTCOUNTHLL(quantity, 8) FROM ssb"
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert not a.get("exceptions"), a
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
+    assert a["numDocsScanned"] == b["numDocsScanned"]  # scan on both
 
 
 def test_metadata_only_path(engines):
