@@ -194,22 +194,7 @@ def estimate_jnp(regs):
 
 
 def estimate(registers: np.ndarray) -> int:
-    """Host-side cardinality estimate (standard HLL with corrections)."""
-    regs = np.asarray(registers)
-    m = len(regs)
-    if m >= 128:
-        alpha = 0.7213 / (1 + 1.079 / m)
-    elif m == 64:
-        alpha = 0.709
-    elif m == 32:
-        alpha = 0.697
-    else:
-        alpha = 0.673
-    est = alpha * m * m / np.sum(np.exp2(-regs.astype(np.float64)))
-    if est <= 2.5 * m:
-        zeros = int(np.sum(regs == 0))
-        if zeros:
-            est = m * np.log(m / zeros)  # linear counting
-    elif est > (1 << 32) / 30.0:
-        est = -(1 << 32) * np.log(1.0 - est / (1 << 32))
-    return int(round(est))
+    """Host-side cardinality estimate (standard HLL with corrections) —
+    one row of the batch form, so the correction math lives in exactly one
+    np implementation (plus its jnp mirror)."""
+    return int(estimate_batch_np(np.asarray(registers)[None, :])[0])

@@ -354,6 +354,269 @@ def _dtfield(millis, field):
     raise KeyError(field)
 
 
+# ---- TIMECONVERT / DATETIMECONVERT (host-only) ----------------------------
+# Reference: TimeConversionTransformFunction.java,
+# DateTimeConversionTransformFunction.java:80 + DateTimeFormatSpec — the
+# workhorse Pinot time-rollup functions.
+
+_UNIT_MS = {
+    "NANOSECONDS": 1e-6, "MICROSECONDS": 1e-3, "MILLISECONDS": 1,
+    "SECONDS": 1_000, "MINUTES": 60_000, "HOURS": 3_600_000,
+    "DAYS": 86_400_000,
+}
+
+
+def _unit_ms(unit: str) -> float:
+    u = str(unit).upper()
+    if u not in _UNIT_MS:
+        raise ValueError(f"unknown time unit {unit!r}")
+    return _UNIT_MS[u]
+
+
+def _div_trunc(v: np.ndarray, d: np.int64) -> np.ndarray:
+    """Integer division truncating toward ZERO (Java long division) — numpy
+    // floors, which differs on negatives."""
+    return np.sign(v) * (np.abs(v) // d)
+
+
+def _to_millis(values: np.ndarray, unit: str) -> np.ndarray:
+    """TimeUnit.MILLISECONDS.convert(value, unit) — exact integer
+    arithmetic, truncating toward zero like Java (a float64 path here
+    rounded epoch-nanos into the wrong millisecond bucket)."""
+    f = _unit_ms(unit)
+    v = np.asarray(values, dtype=np.int64)
+    if f >= 1:
+        return v * np.int64(f)
+    return _div_trunc(v, np.int64(round(1 / f)))
+
+
+def _from_millis(millis: np.ndarray, unit: str) -> np.ndarray:
+    f = _unit_ms(unit)
+    ms = np.asarray(millis, dtype=np.int64)
+    if f >= 1:
+        return _div_trunc(ms, np.int64(f))
+    return ms * np.int64(round(1 / f))
+
+
+def _timeconvert(values, from_unit, to_unit):
+    return _from_millis(_to_millis(values, str(from_unit)), str(to_unit))
+
+
+_reg("timeconvert", _timeconvert, min_args=3, max_args=3)
+
+# Java SimpleDateFormat tokens → strftime (longest-first so yyyy wins over
+# yy). SSS maps to %f for PARSING (strptime right-pads fraction digits to
+# microseconds, matching SDF millis); formatting post-processes %f's 6
+# digits down to SDF's 3 (_fix_sss).
+_SDF_TOKENS = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+    ("HH", "%H"), ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"), ("a", "%p"),
+    ("EEE", "%a"), ("M", "%m"), ("d", "%d"), ("H", "%H"), ("h", "%I"),
+]
+
+
+def _sdf_to_strftime(pattern: str) -> str:
+    out, i = [], 0
+    while i < len(pattern):
+        for tok, rep in _SDF_TOKENS:
+            if pattern.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            out.append(pattern[i])
+            i += 1
+    return "".join(out)
+
+
+class _DateTimeFormat:
+    """DateTimeFormatSpec: 'size:unit:EPOCH' or
+    'size:unit:SIMPLE_DATE_FORMAT:pattern[ tz(...)]'."""
+
+    def __init__(self, spec: str):
+        parts = str(spec).split(":", 3)
+        if len(parts) < 3:
+            raise ValueError(f"bad datetime format {spec!r}")
+        self.size = int(parts[0])
+        self.unit = parts[1].upper()
+        self.fmt = parts[2].upper()
+        self.pattern = parts[3] if len(parts) > 3 else None
+        if self.fmt == "SIMPLE_DATE_FORMAT" and self.pattern:
+            pat = self.pattern
+            if " tz(" in pat:
+                pat, tz = pat.split(" tz(", 1)
+                tz = tz.rstrip(")")
+                if tz.upper() not in ("UTC", "GMT"):
+                    raise ValueError(
+                        f"only UTC SIMPLE_DATE_FORMAT timezones supported "
+                        f"(got {tz!r})")
+            self.strftime = _sdf_to_strftime(pat)
+
+    def to_millis(self, values: np.ndarray) -> np.ndarray:
+        if self.fmt == "EPOCH":
+            return _to_millis(
+                np.asarray(values, dtype=np.int64) * self.size, self.unit)
+        if self.fmt in ("SIMPLE_DATE_FORMAT", "TIMESTAMP"):
+            import pandas as pd
+
+            if self.fmt == "TIMESTAMP":
+                dt = pd.to_datetime(np.asarray(values))
+            else:
+                vals = np.asarray(values).astype(str)
+                fmt = self.strftime
+                if "%Y" not in fmt and "%y" not in fmt:
+                    # Java SDF defaults missing date fields to the 1970
+                    # epoch; C strptime defaults to 1900 — pin the base
+                    vals = np.char.add("1970-01-01 ", vals)
+                    fmt = "%Y-%m-%d " + fmt
+                dt = pd.to_datetime(vals, format=fmt)
+            # normalize to ms regardless of the index's native resolution
+            # (pandas 2.x may parse to s/us/ns depending on the format)
+            return np.asarray(dt, dtype="datetime64[ms]").astype(np.int64)
+        raise ValueError(f"unknown datetime format {self.fmt!r}")
+
+    def from_millis(self, millis: np.ndarray):
+        if self.fmt == "EPOCH":
+            return _div_trunc(_from_millis(millis, self.unit),
+                              np.int64(self.size))
+        import pandas as pd
+
+        ms = np.asarray(millis, dtype=np.int64)
+        dt = pd.to_datetime(ms, unit="ms")
+        fmt = self.strftime
+        # U-dtype (not object): string results flow into group keys and the
+        # DataTable wire codec, which round-trips numpy string arrays but
+        # refuses pickled object arrays
+        if "%f" in fmt:
+            # SDF's SSS is 3-digit millis; strftime %f would emit 6-digit
+            # micros — format around a sentinel and splice the millis in
+            sent = "\x00"
+            base = np.asarray(dt.strftime(fmt.replace("%f", sent)))
+            frac = np.char.zfill((ms % 1000).astype(str), 3)
+            return np.asarray(
+                [s.replace(sent, f) for s, f in zip(base, frac)],
+                dtype=np.str_)
+        return np.asarray(dt.strftime(fmt), dtype=np.str_)
+
+
+def _datetimeconvert(values, in_fmt, out_fmt, granularity):
+    inf = _DateTimeFormat(str(in_fmt))
+    outf = _DateTimeFormat(str(out_fmt))
+    gsize, gunit = str(granularity).split(":", 1)
+    g = np.int64(int(gsize) * _unit_ms(gunit))
+    ms = inf.to_millis(values)
+    bucketed = _div_trunc(ms, g) * g
+    return outf.from_millis(bucketed)
+
+
+_reg("datetimeconvert", _datetimeconvert, min_args=4, max_args=4)
+
+
+# ---- array / MV transforms (host-only) ------------------------------------
+# Reference: ArrayLength/ArraySum/ArrayAverage/ArrayMin/ArrayMax
+# TransformFunction.java, ValueInTransformFunction.java:1,
+# MapValueTransformFunction. MV identifier evaluation yields an object
+# array of per-doc entry arrays (or a 2-D array when all docs have equal
+# entry counts) — helpers handle both.
+
+
+def _mv_rows(col):
+    arr = np.asarray(col)
+    if arr.ndim == 2:
+        return list(arr)
+    if arr.dtype == object:
+        return [np.asarray(r) for r in arr]
+    # an SV column is a 1-entry MV per the reference's implicit widening
+    return [np.asarray([v]) for v in arr]
+
+
+def _array_reduce(col, fn, empty):
+    rows = _mv_rows(col)
+    return np.asarray([fn(r) if len(r) else empty for r in rows])
+
+
+_reg("arraylength", lambda c: np.asarray([len(r) for r in _mv_rows(c)],
+                                         dtype=np.int64), min_args=1)
+_reg("cardinality", lambda c: np.asarray([len(r) for r in _mv_rows(c)],
+                                         dtype=np.int64), min_args=1)
+_reg("arraysum", lambda c: _array_reduce(c, np.sum, 0.0), min_args=1)
+_reg("arrayaverage",
+     lambda c: _array_reduce(c, np.mean, float("nan")), min_args=1)
+_reg("arraymin", lambda c: _array_reduce(c, np.min, float("inf")), min_args=1)
+_reg("arraymax", lambda c: _array_reduce(c, np.max, float("-inf")), min_args=1)
+
+
+def _valuein(col, *wanted):
+    """Per-doc entry filter: keep MV entries ∈ {wanted} (the reference
+    dedups while preserving first-seen order)."""
+    want = {np.asarray(w).item() for w in wanted}
+    rows = _mv_rows(col)
+    out = np.empty(len(rows), dtype=object)
+    for i, r in enumerate(rows):
+        seen, kept = set(), []
+        for v in r.tolist():
+            if v in want and v not in seen:
+                seen.add(v)
+                kept.append(v)
+        out[i] = kept
+    return out
+
+
+_reg("valuein", _valuein, min_args=2, max_args=99)
+
+
+def _mapvalue(keys_col, key, values_col):
+    """MAPVALUE(map__KEYS, 'k', map__VALUES): per doc, the value at the
+    key's position in the keys MV (reference MapValueTransformFunction);
+    missing keys yield the value column's type default."""
+    k = np.asarray(key).item()
+    krows = _mv_rows(keys_col)
+    vrows = _mv_rows(values_col)
+    first = next((r for r in vrows if len(r)), None)
+    if first is not None and np.asarray(first).dtype.kind in "UOS":
+        default = ""
+    else:
+        default = 0
+    out = []
+    for kr, vr in zip(krows, vrows):
+        hits = np.nonzero(np.asarray(kr) == k)[0]
+        if len(hits) and hits[0] < len(vr):
+            out.append(np.asarray(vr)[hits[0]])
+        else:
+            out.append(default)
+    return np.asarray(out)
+
+
+_reg("mapvalue", _mapvalue, min_args=3, max_args=3)
+
+
+# ---- REGEXP_EXTRACT (host-only) -------------------------------------------
+
+
+def _regexp_extract(col, pattern, group=None, default=None):
+    """REGEXP_EXTRACT(value, pattern[, group[, default]]) — first match's
+    group (0 = whole match), or the default ('' like the reference's null
+    string) when the pattern doesn't match
+    (RegexpExtractTransformFunction.java)."""
+    import re
+
+    rx = re.compile(str(np.asarray(pattern).item()))
+    g = int(np.asarray(group).item()) if group is not None else 0
+    d = str(np.asarray(default).item()) if default is not None else ""
+    vals = np.asarray(col)
+    if vals.ndim == 0:
+        vals = vals[None]
+    # U-dtype so results can serve as group keys over the DataTable wire
+    return np.asarray([
+        (m.group(g) if (m := rx.search(str(v))) and g <= rx.groups else d)
+        for v in vals.tolist()
+    ])
+
+
+_reg("regexp_extract", _regexp_extract, min_args=2, max_args=4)
+_reg("regexpextract", _regexp_extract, min_args=2, max_args=4)
+
+
 def _datetrunc(unit, millis):
     unit = str(unit).lower()
     ms = np.asarray(millis, dtype=np.int64)

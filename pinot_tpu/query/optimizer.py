@@ -127,7 +127,10 @@ def _merge_ranges(f: FilterNode) -> FilterNode:
         ):
             p = c.predicate
             if p.lhs in ranges:
-                ranges[p.lhs] = _intersect(ranges[p.lhs], p)
+                # an already-empty intersection (None) stays empty — a third
+                # range on the same column must not resurrect it
+                if ranges[p.lhs] is not None:
+                    ranges[p.lhs] = _intersect(ranges[p.lhs], p)
             else:
                 ranges[p.lhs] = p
         else:

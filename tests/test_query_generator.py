@@ -110,8 +110,34 @@ class QueryGenerator:
         joiner = " AND " if self.rng.random() < 0.7 else " OR "
         return " WHERE " + joiner.join(preds)
 
-    def next_query(self) -> str:
+    # time-transform expressions: (pinot form, sqlite-oracle form) — sqlite
+    # integer division matches Java TimeUnit truncation on non-negative ints
+    TIME_EXPRS = [
+        ("TIMECONVERT(clicks, 'MILLISECONDS', 'SECONDS')",
+         "(clicks / 1000)"),
+        ("TIMECONVERT(clicks, 'MILLISECONDS', 'MINUTES')",
+         "(clicks / 60000)"),
+        ("DATETIMECONVERT(clicks, '1:MILLISECONDS:EPOCH', "
+         "'1:SECONDS:EPOCH', '1:MINUTES')",
+         "(((clicks / 60000) * 60000) / 1000)"),
+        ("DATETIMECONVERT(clicks, '1:MILLISECONDS:EPOCH', "
+         "'5:SECONDS:EPOCH', '5:SECONDS')",
+         "(((clicks / 5000) * 5000) / 5000)"),
+    ]
+
+    def next_query(self):
         roll = self.rng.random()
+        if roll < 0.1:  # time-rollup group-by (DATETIMECONVERT/TIMECONVERT)
+            p_expr, s_expr = self.TIME_EXPRS[
+                self.rng.integers(len(self.TIME_EXPRS))]
+            agg = self.AGGS[self.rng.integers(len(self.AGGS))]
+            where = self._where()
+            return (
+                f"SELECT {p_expr}, {agg} FROM ads{where} "
+                f"GROUP BY {p_expr} ORDER BY {p_expr} LIMIT 100000",
+                f"SELECT {s_expr}, {agg} FROM ads{where} "
+                f"GROUP BY {s_expr} ORDER BY {s_expr} LIMIT 100000",
+            )
         if roll < 0.45:  # scalar aggregation
             aggs = list(self.rng.choice(self.AGGS, size=int(self.rng.integers(1, 4)),
                                         replace=False))
@@ -164,13 +190,14 @@ def test_random_queries_match_oracle(setup, seed):
     gen = QueryGenerator(cols, seed)
     failures = []
     for i in range(N_QUERIES):
-        sql = gen.next_query()
+        q = gen.next_query()
+        sql, oracle_sql = q if isinstance(q, tuple) else (q, q)
         resp = engine.execute(sql)
         if resp.get("exceptions"):
             failures.append((sql, resp["exceptions"]))
             continue
         got = [tuple(r) for r in resp["resultTable"]["rows"]]
-        want = [tuple(r) for r in con.execute(sql).fetchall()]
+        want = [tuple(r) for r in con.execute(oracle_sql).fetchall()]
         err = _diff(got, want)
         if err:
             failures.append((sql, err))
