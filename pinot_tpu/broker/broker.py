@@ -152,10 +152,16 @@ class RoutingManager:
 
 class Broker:
     def __init__(self, registry: ClusterRegistry, broker_id: str = "broker_0",
-                 timeout_s: float = 10.0):
+                 timeout_s: float = 10.0, tls="auto"):
         self.registry = registry
         self.broker_id = broker_id
         self.timeout_s = timeout_s
+        if tls == "auto":
+            # layered config (pinot.tls.*) like the reference's TlsConfig
+            from pinot_tpu.common.tls import TlsConfig
+
+            tls = TlsConfig.from_config()
+        self.tls = tls
         from pinot_tpu.common.metrics import get_metrics
 
         self.metrics = get_metrics("broker")
@@ -183,7 +189,7 @@ class Broker:
             if ch is None or ch.endpoint != info.endpoint:
                 if ch is not None:
                     ch.close()
-                ch = QueryRouterChannel(info.endpoint)
+                ch = QueryRouterChannel(info.endpoint, tls=self.tls)
                 self._channels[instance_id] = ch
             return ch
 
@@ -446,6 +452,9 @@ class Broker:
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,
+                # summed across servers, like the reference's V3 metadata
+                "threadCpuTimeNs": stats.thread_cpu_time_ns,
+                "schedulerWaitMs": round(stats.scheduler_wait_ms, 3),
                 "requestId": request_id,
             }
         )

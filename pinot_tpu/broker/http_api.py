@@ -21,9 +21,14 @@ from typing import Optional
 
 class BrokerHttpServer:
     def __init__(self, broker, host: str = "127.0.0.1", port: int = 0,
-                 users: Optional[dict] = None):
+                 users: Optional[dict] = None, tls="auto"):
         self.broker = broker
         self._users = dict(users) if users else None
+        if tls == "auto":
+            from pinot_tpu.common.tls import TlsConfig
+
+            tls = TlsConfig.from_config()
+        self.tls = tls
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,6 +116,17 @@ class BrokerHttpServer:
                     )
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
+        if self.tls is not None:
+            # HTTPS listener (reference: broker TLS via TlsConfig/Netty).
+            # Defer the handshake off the accept loop: with
+            # do_handshake_on_connect=True, SSLSocket.accept() handshakes
+            # inside serve_forever's single accept thread, so one client
+            # that connects and never sends a ClientHello would block ALL
+            # broker HTTP traffic. Deferred, the handshake happens on the
+            # handler thread's first recv.
+            self._httpd.socket = self.tls.server_ssl_context().wrap_socket(
+                self._httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="broker-http", daemon=True
@@ -125,4 +141,5 @@ class BrokerHttpServer:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"

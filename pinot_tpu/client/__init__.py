@@ -161,9 +161,13 @@ def _quote(v) -> str:
 
 class Connection:
     def __init__(self, broker_url: Optional[str] = None, broker=None,
-                 registry=None, timeout_s: float = 30.0, auth=None):
+                 registry=None, timeout_s: float = 30.0, auth=None,
+                 ssl_context=None):
         """``auth``: optional (username, password) for brokers running
-        with HTTP Basic auth."""
+        with HTTP Basic auth. ``ssl_context``: optional ssl.SSLContext for
+        https:// broker URLs (e.g. TlsConfig.client_ssl_context() to trust
+        a private CA)."""
+        self._ssl_context = ssl_context
         if broker_url is None and broker is None and registry is None:
             raise ProgrammingError(
                 "connect() needs a broker_url, a Broker, or a registry")
@@ -199,7 +203,8 @@ class Connection:
             headers=headers,
         )
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+            with urllib.request.urlopen(req, timeout=self._timeout_s,
+                                        context=self._ssl_context) as resp:
                 return json.loads(resp.read())
         except Error:
             raise

@@ -47,6 +47,10 @@ class IndexingConfig:
     # (io/compression analog: per-chunk LZ4/Snappy/zstd in the reference);
     # decoded by the native codec at load time
     compressed_columns: list[str] = dataclasses.field(default_factory=list)
+    # per-column chunk codec override (reference ChunkCompressionType):
+    # {"col": "zlib" | "zstd" | "lz4"}; listing a column here implies
+    # compression even if it is absent from compressed_columns
+    compression_codec: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -28,6 +28,10 @@ class ExecutionStats:
     num_segments_pruned: int = 0
     total_docs: int = 0
     time_used_ms: float = 0.0
+    # per-query resource accounting (reference: DataTable V3 metadata
+    # threadCpuTimeNs + scheduler wait) — filled by the server's scheduler
+    thread_cpu_time_ns: int = 0
+    scheduler_wait_ms: float = 0.0
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -38,6 +42,8 @@ class ExecutionStats:
         self.num_segments_matched += other.num_segments_matched
         self.num_segments_pruned += other.num_segments_pruned
         self.total_docs += other.total_docs
+        self.thread_cpu_time_ns += other.thread_cpu_time_ns
+        self.scheduler_wait_ms += other.scheduler_wait_ms
 
 
 @dataclasses.dataclass

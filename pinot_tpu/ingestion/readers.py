@@ -121,11 +121,23 @@ class ORCRecordReader(RecordReader):
         return orc.ORCFile(path).read().to_pylist()
 
 
+class AvroRecordReader(RecordReader):
+    """Avro Object Container Files (pinot-avro AvroRecordReader analog) —
+    decoded by the in-tree pure-python codec (ingestion/avro_io.py), so no
+    external avro dependency gates the canonical Pinot ingestion format."""
+
+    def read_rows(self, path: str) -> list:
+        from pinot_tpu.ingestion.avro_io import read_container
+
+        return read_container(path)
+
+
 _READERS = {
     "csv": CSVRecordReader,
     "json": JSONRecordReader,
     "parquet": ParquetRecordReader,
     "orc": ORCRecordReader,
+    "avro": AvroRecordReader,
 }
 
 

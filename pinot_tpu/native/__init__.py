@@ -35,20 +35,64 @@ def _compile() -> bool:
     # racing through a fresh checkout must never dlopen a half-written .so
     tmp = f"{_LIB}.{os.getpid()}"
     base = ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
-    # no zlib dev headers must not cost the bit-packing codec its native
-    # path: retry without the inflate section (python stdlib zlib covers
-    # decompression of the same bytes)
-    for cmd in (base + ["-lz"], base + ["-DPINOT_NO_ZLIB"]):
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _LIB)
-            return True
-        except Exception as e:  # noqa: BLE001 — try next variant / fall back
-            log.warning("native packer build failed (%s) with %s", e, cmd[-1])
+    # degrade codec by codec: a host missing one dev header/library must
+    # not cost the others their native path (python fallbacks read the
+    # same bytes, slower). liblz4 often ships only the versioned .so.
+    # probe each codec independently, then compile once with exactly the
+    # available set — a host missing one dev header/library must not cost
+    # the OTHERS their native path
+    probes = {
+        "zlib": (["-lz"], "#include <zlib.h>\nint main(){return 0;}"),
+        "zstd": (["-lzstd"], "#include <zstd.h>\nint main(){return 0;}"),
+        # liblz4 often ships only the versioned .so and no header; the
+        # packer declares the stable ABI itself, so probe link-only
+        "lz4": (["-l:liblz4.so.1"],
+                "extern \"C\" int LZ4_compressBound(int);\n"
+                "int main(){return LZ4_compressBound(1) > 0 ? 0 : 1;}"),
+        "lz4alt": (["-llz4"],
+                   "extern \"C\" int LZ4_compressBound(int);\n"
+                   "int main(){return LZ4_compressBound(1) > 0 ? 0 : 1;}"),
+    }
+    import tempfile
+
+    def _probe(flags, src_text) -> bool:
+        with tempfile.TemporaryDirectory() as td:
+            src = os.path.join(td, "probe.cpp")
+            with open(src, "w") as f:
+                f.write(src_text)
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+                subprocess.run(
+                    ["g++", "-o", os.path.join(td, "probe"), src] + flags,
+                    check=True, capture_output=True, timeout=60)
+                return True
+            except Exception:  # noqa: BLE001 — feature probe
+                return False
+
+    extra = []
+    for name, define in (("zlib", "PINOT_NO_ZLIB"),
+                         ("zstd", "PINOT_NO_ZSTD")):
+        flags, src_text = probes[name]
+        if _probe(flags, src_text):
+            extra += flags
+        else:
+            extra.append(f"-D{define}")
+    if _probe(*probes["lz4"]):
+        extra.append("-l:liblz4.so.1")
+    elif _probe(*probes["lz4alt"]):
+        extra.append("-llz4")
+    else:
+        extra.append("-DPINOT_NO_LZ4")
+    try:
+        subprocess.run(base + extra, check=True, capture_output=True,
+                       timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception as e:  # noqa: BLE001 — numpy/python fallbacks serve
+        log.warning("native packer build failed (%s) with %s", e, extra)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
     return False
 
 
@@ -81,6 +125,33 @@ def _load():
                     ctypes.POINTER(ctypes.c_int64),
                 ]
                 lib.inflate_chunks.restype = ctypes.c_int
+            _chunk_args = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_int64),
+            ]
+            for fn in ("zstd_decompress_chunks", "lz4_decompress_chunks"):
+                if hasattr(lib, fn):  # absent under PINOT_NO_ZSTD/_LZ4
+                    getattr(lib, fn).argtypes = _chunk_args
+                    getattr(lib, fn).restype = ctypes.c_int
+            if hasattr(lib, "zstd_compress_chunk"):
+                lib.zstd_compress_chunk.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                    ctypes.c_int,
+                ]
+                lib.zstd_compress_chunk.restype = ctypes.c_int64
+                lib.zstd_bound.argtypes = [ctypes.c_int64]
+                lib.zstd_bound.restype = ctypes.c_int64
+            if hasattr(lib, "lz4_compress_chunk"):
+                lib.lz4_compress_chunk.argtypes = [
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ]
+                lib.lz4_compress_chunk.restype = ctypes.c_int64
+                lib.lz4_bound.argtypes = [ctypes.c_int64]
+                lib.lz4_bound.restype = ctypes.c_int64
             _lib = lib
         except Exception as e:  # noqa: BLE001
             log.warning("native packer load failed (%s); numpy fallback", e)
@@ -139,33 +210,166 @@ def unpack(buf: np.ndarray, n: int, bits: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Chunked zlib compression for raw forward indexes (io/compression analog:
-# the reference's per-chunk LZ4/Snappy/zstd compressors behind
-# Fixed/VarByteChunkSVForwardIndex). zlib so the C++ decoder and the
-# stdlib-zlib fallback read the same bytes.
+# Chunked compression for raw forward indexes (io/compression analog: the
+# reference's per-chunk compressors behind Fixed/VarByteChunkSVForwardIndex,
+# ChunkCompressionType = PASS_THROUGH | SNAPPY | ZSTANDARD | LZ4; here
+# zlib | zstd | lz4, selectable per column via IndexingConfig). Each codec
+# has a native C++ loop and a pure-python fallback reading the same bytes.
 # ---------------------------------------------------------------------------
 
 CHUNK_BYTES = 1 << 18  # 256 KiB uncompressed per chunk
 
+CHUNK_CODECS = ("zlib", "zstd", "lz4")
 
-def compress_chunks(data: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+
+def _lz4_compress_py(src: bytes) -> bytes:
+    """Literal-only LZ4 block (valid format, no matches) — the build-path
+    fallback when the native library is absent: round-trips correctly at
+    roughly pass-through size."""
+    out = bytearray()
+    L = len(src)
+    token_lit = min(L, 15)
+    out.append(token_lit << 4)
+    if token_lit == 15:
+        rem = L - 15
+        while rem >= 255:
+            out.append(255)
+            rem -= 255
+        out.append(rem)
+    out += src
+    return bytes(out)
+
+
+def _lz4_decompress_py(src: bytes, expected: int) -> bytes:
+    """Pure-python LZ4 block decoder (load-path fallback)."""
+    out = bytearray()
+    i, n = 0, len(src)
+    while i < n:
+        token = src[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = src[i]
+                i += 1
+                lit += b
+                if b != 255:
+                    break
+        out += src[i: i + lit]
+        i += lit
+        if i >= n:
+            break  # last sequence carries no match
+        off = src[i] | (src[i + 1] << 8)
+        i += 2
+        ml = token & 15
+        if ml == 15:
+            while True:
+                b = src[i]
+                i += 1
+                ml += b
+                if b != 255:
+                    break
+        ml += 4
+        start = len(out) - off
+        if start < 0:
+            raise ValueError("corrupt LZ4 block (offset before start)")
+        for _ in range(ml):  # byte-wise: matches may overlap themselves
+            out.append(out[start])
+            start += 1
+    if len(out) != expected:
+        raise ValueError(
+            f"corrupt LZ4 block ({len(out)} bytes, expected {expected})")
+    return bytes(out)
+
+
+def _compress_chunk(raw: bytes, codec: str, lib) -> bytes:
+    if codec == "zlib":
+        import zlib
+
+        return zlib.compress(raw, 6)
+    if codec == "zstd":
+        try:
+            import zstandard
+
+            return zstandard.ZstdCompressor(level=3).compress(raw)
+        except ImportError:
+            pass
+        if lib is not None and hasattr(lib, "zstd_compress_chunk"):
+            cap = int(lib.zstd_bound(len(raw)))
+            dst = np.empty(max(cap, 64), dtype=np.uint8)
+            src = np.frombuffer(raw, dtype=np.uint8)
+            n = lib.zstd_compress_chunk(
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(len(raw)),
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(len(dst)), ctypes.c_int(3))
+            if n < 0:
+                raise ValueError("zstd compression failed")
+            return dst[:n].tobytes()
+        raise RuntimeError(
+            "zstd codec needs the zstandard package or the native library")
+    if codec == "lz4":
+        if lib is not None and hasattr(lib, "lz4_compress_chunk"):
+            cap = int(lib.lz4_bound(len(raw))) if len(raw) else 64
+            dst = np.empty(max(cap, 64), dtype=np.uint8)
+            src = np.frombuffer(raw, dtype=np.uint8) if raw else \
+                np.empty(0, dtype=np.uint8)
+            n = lib.lz4_compress_chunk(
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(len(raw)),
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.c_int64(len(dst)))
+            if n > 0:
+                return dst[:n].tobytes()
+        return _lz4_compress_py(raw)
+    raise ValueError(f"unknown chunk codec {codec!r} (use {CHUNK_CODECS})")
+
+
+def compress_chunks(data: np.ndarray,
+                    codec: str = "zlib") -> tuple[np.ndarray, np.ndarray]:
     """Raw little-endian bytes -> (concatenated compressed chunks,
-    offsets[n_chunks+1]). Build path: stdlib zlib (cold, simple)."""
-    import zlib
-
+    offsets[n_chunks+1]). Build path (cold)."""
+    lib = _load()
     data = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
     raw = data.tobytes()
-    chunks = [zlib.compress(raw[i: i + CHUNK_BYTES], 6)
-              for i in range(0, len(raw), CHUNK_BYTES)] or [zlib.compress(b"")]
+    pieces = [raw[i: i + CHUNK_BYTES]
+              for i in range(0, len(raw), CHUNK_BYTES)] or [b""]
+    chunks = [_compress_chunk(p, codec, lib) for p in pieces]
     offsets = np.zeros(len(chunks) + 1, dtype=np.int64)
     np.cumsum([len(c) for c in chunks], out=offsets[1:])
     return np.frombuffer(b"".join(chunks), dtype=np.uint8), offsets
 
 
+_NATIVE_DECOMPRESS = {
+    "zlib": "inflate_chunks",
+    "zstd": "zstd_decompress_chunks",
+    "lz4": "lz4_decompress_chunks",
+}
+
+
+def _decompress_chunk_py(buf: bytes, codec: str, expected: int) -> bytes:
+    if codec == "zlib":
+        import zlib
+
+        return zlib.decompress(buf)
+    if codec == "zstd":
+        try:
+            import zstandard
+        except ImportError as e:
+            raise RuntimeError(
+                "loading a zstd-compressed segment needs the zstandard "
+                "package or the native library") from e
+        return zstandard.ZstdDecompressor().decompress(
+            buf, max_output_size=max(expected, 1))
+    if codec == "lz4":
+        return _lz4_decompress_py(buf, expected)
+    raise ValueError(f"unknown chunk codec {codec!r} (use {CHUNK_CODECS})")
+
+
 def decompress_chunks(blob: np.ndarray, offsets: np.ndarray,
-                      total_bytes: int) -> np.ndarray:
+                      total_bytes: int, codec: str = "zlib") -> np.ndarray:
     """(compressed chunks, offsets) -> uncompressed uint8 array of
-    total_bytes. Load path: native inflate loop, stdlib zlib fallback."""
+    total_bytes. Load path: native per-chunk loop, python fallback."""
     blob = np.ascontiguousarray(blob, dtype=np.uint8)
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
     n_chunks = len(offsets) - 1
@@ -175,8 +379,11 @@ def decompress_chunks(blob: np.ndarray, offsets: np.ndarray,
     dst_off = np.minimum(
         np.arange(n_chunks + 1, dtype=np.int64) * CHUNK_BYTES, total_bytes)
     lib = _load()
-    if lib is not None and hasattr(lib, "inflate_chunks"):
-        rc = lib.inflate_chunks(
+    fn_name = _NATIVE_DECOMPRESS.get(codec)
+    if fn_name is None:
+        raise ValueError(f"unknown chunk codec {codec!r} (use {CHUNK_CODECS})")
+    if lib is not None and hasattr(lib, fn_name):
+        rc = getattr(lib, fn_name)(
             blob.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             ctypes.c_int64(n_chunks),
@@ -184,14 +391,15 @@ def decompress_chunks(blob: np.ndarray, offsets: np.ndarray,
             dst_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         )
         if rc != 0:
-            raise ValueError(f"corrupt compressed forward index (zlib rc={rc})")
+            raise ValueError(
+                f"corrupt compressed forward index ({codec} rc={rc})")
         return out
-    import zlib
-
     buf = blob.tobytes()
     pos = 0
     for c in range(n_chunks):
-        chunk = zlib.decompress(buf[offsets[c]: offsets[c + 1]])
+        expected = int(dst_off[c + 1] - dst_off[c])
+        chunk = _decompress_chunk_py(
+            buf[offsets[c]: offsets[c + 1]], codec, expected)
         out[pos: pos + len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
         pos += len(chunk)
     if pos != total_bytes:

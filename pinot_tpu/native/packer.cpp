@@ -94,3 +94,89 @@ int inflate_chunks(const uint8_t* src, const int64_t* offsets,
 
 }  // extern "C"
 #endif  // PINOT_NO_ZLIB
+
+// ---------------------------------------------------------------------------
+// zstd chunk codec (reference ChunkCompressionType.ZSTANDARD,
+// io/compression/ZstandardCompressor). System libzstd; compiled out with
+// -DPINOT_NO_ZSTD where the dev header is absent (python `zstandard`
+// serves the same frames).
+// ---------------------------------------------------------------------------
+
+#ifndef PINOT_NO_ZSTD
+#include <zstd.h>
+
+extern "C" {
+
+int zstd_decompress_chunks(const uint8_t* src, const int64_t* offsets,
+                           int64_t n_chunks, uint8_t* dst,
+                           const int64_t* dst_offsets) {
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        const size_t cap = static_cast<size_t>(dst_offsets[c + 1] - dst_offsets[c]);
+        size_t rc = ZSTD_decompress(dst + dst_offsets[c], cap,
+                                    src + offsets[c],
+                                    static_cast<size_t>(offsets[c + 1] - offsets[c]));
+        if (ZSTD_isError(rc) || rc != cap) return -1;
+    }
+    return 0;
+}
+
+int64_t zstd_compress_chunk(const uint8_t* src, int64_t src_len,
+                            uint8_t* dst, int64_t cap, int level) {
+    size_t rc = ZSTD_compress(dst, static_cast<size_t>(cap), src,
+                              static_cast<size_t>(src_len), level);
+    return ZSTD_isError(rc) ? -1 : static_cast<int64_t>(rc);
+}
+
+int64_t zstd_bound(int64_t n) {
+    return static_cast<int64_t>(ZSTD_compressBound(static_cast<size_t>(n)));
+}
+
+}  // extern "C"
+#endif  // PINOT_NO_ZSTD
+
+// ---------------------------------------------------------------------------
+// LZ4 block chunk codec (reference ChunkCompressionType.LZ4,
+// io/compression/LZ4Compressor). The build image ships liblz4.so.1 but no
+// header, so the stable liblz4 ABI is declared here; compiled out with
+// -DPINOT_NO_LZ4 where the library is absent (a pure-python block decoder
+// in native/__init__.py reads the same bytes).
+// ---------------------------------------------------------------------------
+
+#ifndef PINOT_NO_LZ4
+extern "C" {
+int LZ4_compress_default(const char* src, char* dst, int srcSize, int dstCap);
+int LZ4_decompress_safe(const char* src, char* dst, int srcSize, int dstCap);
+int LZ4_compressBound(int inputSize);
+}
+
+extern "C" {
+
+int lz4_decompress_chunks(const uint8_t* src, const int64_t* offsets,
+                          int64_t n_chunks, uint8_t* dst,
+                          const int64_t* dst_offsets) {
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        const int cap = static_cast<int>(dst_offsets[c + 1] - dst_offsets[c]);
+        int rc = LZ4_decompress_safe(
+            reinterpret_cast<const char*>(src + offsets[c]),
+            reinterpret_cast<char*>(dst + dst_offsets[c]),
+            static_cast<int>(offsets[c + 1] - offsets[c]), cap);
+        if (rc != cap) return -1;
+    }
+    return 0;
+}
+
+int64_t lz4_compress_chunk(const uint8_t* src, int64_t src_len,
+                           uint8_t* dst, int64_t cap) {
+    int rc = LZ4_compress_default(reinterpret_cast<const char*>(src),
+                                  reinterpret_cast<char*>(dst),
+                                  static_cast<int>(src_len),
+                                  static_cast<int>(cap));
+    return rc <= 0 ? -1 : static_cast<int64_t>(rc);
+}
+
+int64_t lz4_bound(int64_t n) {
+    return static_cast<int64_t>(LZ4_compressBound(static_cast<int>(n)));
+}
+
+}  // extern "C"
+#endif  // PINOT_NO_LZ4

@@ -28,7 +28,10 @@ from pinot_tpu.cluster.registry import (
 from pinot_tpu.engine.datatable import encode, encode_error
 from pinot_tpu.engine.engine import QueryEngine
 from pinot_tpu.engine.reduce import trim_group_by
-from pinot_tpu.engine.scheduler import QueryScheduler, SchedulerSaturated
+from pinot_tpu.engine.scheduler import (
+    SchedulerSaturated,
+    make_scheduler,
+)
 from pinot_tpu.query.optimizer import optimize_query
 from pinot_tpu.sql.compiler import compile_query
 from pinot_tpu.storage.segment import ImmutableSegment
@@ -71,7 +74,8 @@ class ServerInstance:
                  data_dir: str, host: str = "127.0.0.1", port: int = 0,
                  sync_interval_s: float = 0.2, device_executor="auto",
                  max_concurrent_queries: int = 8, max_queued_queries: int = 32,
-                 group_trim_size: int = 5000):
+                 group_trim_size: int = 5000, scheduler_name: str = None,
+                 tls="auto"):
         self.instance_id = instance_id
         self.registry = registry
         self.data_dir = data_dir
@@ -82,14 +86,27 @@ class ServerInstance:
         # queue invisibly in grpc's executor and time out as transport
         # failures (poisoning the broker's failure detector) before the
         # scheduler's in-band rejection can ever fire
+        if tls == "auto":
+            from pinot_tpu.common.tls import TlsConfig
+
+            tls = TlsConfig.from_config()
         self.transport = QueryServerTransport(
             self._handle_submit, host=host, port=port,
             max_workers=max_concurrent_queries + max_queued_queries + 2,
             submit_streaming_fn=self._handle_submit_streaming,
+            tls=tls,
         )
         self.sync_interval_s = sync_interval_s
-        self.scheduler = QueryScheduler(max_concurrent=max_concurrent_queries,
-                                        max_queued=max_queued_queries)
+        if scheduler_name is None:
+            # config-selected like the reference's
+            # pinot.server.query.scheduler.name (fcfs | tokenbucket)
+            from pinot_tpu.common.config import Configuration
+
+            scheduler_name = Configuration().get(
+                "pinot.server.query.scheduler.name", "fcfs")
+        self.scheduler = make_scheduler(
+            scheduler_name, max_concurrent=max_concurrent_queries,
+            max_queued=max_queued_queries)
         self.group_trim_size = group_trim_size
         from pinot_tpu.common.metrics import get_metrics
 
@@ -143,15 +160,27 @@ class ServerInstance:
         m = _re.search(r"SET\s+timeoutMs\s*=\s*([0-9.]+)", sql, _re.IGNORECASE)
         return max(0.001, float(m.group(1)) / 1000.0) if m else None
 
+    @staticmethod
+    def _scheduler_group(sql: str) -> str:
+        """Tenant key for token-bucket priority: the table name
+        (TableBasedGroupMapper analog), extracted cheaply pre-compile."""
+        import re as _re
+
+        m = _re.search(r"\bFROM\s+([A-Za-z_][\w.]*)", sql, _re.IGNORECASE)
+        return m.group(1) if m else "default"
+
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)
         try:
             # NOTE: the latency timer lives inside _handle_submit_inner —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
+            acct: dict = {}
             return self.scheduler.run(
-                lambda: self._handle_submit_inner(req),
-                queue_timeout_s=self._request_timeout_s(req["sql"]))
+                lambda: self._handle_submit_inner(req, acct),
+                queue_timeout_s=self._request_timeout_s(req["sql"]),
+                group=self._scheduler_group(req["sql"]),
+                stats_out=acct)
         except SchedulerSaturated as e:
             # admission rejection is a query-level error: the server is
             # healthy (broker must not poison its failure detector)
@@ -161,10 +190,13 @@ class ServerInstance:
             self.metrics.count("queryErrors")
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
-    def _handle_submit_inner(self, req: dict) -> bytes:
+    def _handle_submit_inner(self, req: dict, acct: dict = None) -> bytes:
+        import time as _time
+
         from pinot_tpu.common import trace
         from pinot_tpu.common.trace import span
 
+        t_cpu = _time.thread_time_ns()
         self.metrics.count("queries")
         timer = self.metrics.timed("query")
         timer.__enter__()
@@ -195,6 +227,12 @@ class ServerInstance:
                     tdm.release(acquired)
             with span("server.trim"):
                 merged = trim_group_by(q, merged, self.group_trim_size)
+            # per-query resource accounting shipped in the partial's stats
+            # (the reference's DataTable V3 threadCpuTimeNs metadata)
+            merged.stats.thread_cpu_time_ns = _time.thread_time_ns() - t_cpu
+            if acct:
+                merged.stats.scheduler_wait_ms = acct.get(
+                    "scheduler_wait_ms", 0.0)
             self.queries_served += 1
             if tracer is not None:
                 # encode itself can't appear in the trace: the spans are
@@ -216,7 +254,8 @@ class ServerInstance:
         req = parse_instance_request(request)
         try:
             yield from self.scheduler.run(
-                lambda: self._stream_blocks(req)
+                lambda: self._stream_blocks(req),
+                group=self._scheduler_group(req["sql"]),
             )
         except SchedulerSaturated as e:
             self.metrics.count("queriesRejected")

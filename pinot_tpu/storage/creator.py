@@ -210,13 +210,16 @@ class SegmentCreator:
         else:
             dict_values = None
             packed_bits = None
-            if name in idx_cfg.compressed_columns and spec.single_value:
+            codec_map = getattr(idx_cfg, "compression_codec", {}) or {}
+            if (name in idx_cfg.compressed_columns or name in codec_map) \
+                    and spec.single_value:
                 from pinot_tpu import native
 
-                blob, offs = native.compress_chunks(raw)
+                codec = codec_map.get(name, "zlib")
+                blob, offs = native.compress_chunks(raw, codec=codec)
                 blob.tofile(p(f"{name}.fwdz.bin"))
                 np.save(p(f"{name}.fwdz.off.npy"), offs, allow_pickle=False)
-                compression = "zlib"
+                compression = codec
             else:
                 np.save(p(f"{name}.fwd.npy"), raw, allow_pickle=False)
                 compression = None

@@ -70,7 +70,7 @@ class ColumnMetadata:
     has_text_index: bool = False
     has_null_vector: bool = False
     packed_bits: Optional[int] = None  # bit-packed fwd index width, else None
-    compression: Optional[str] = None  # raw fwd chunk codec ("zlib"), else None
+    compression: Optional[str] = None  # raw fwd chunk codec (zlib|zstd|lz4)
     total_number_of_entries: int = 0  # == n_docs for SV, total MV entries for MV
     partition_function: Optional[str] = None
     num_partitions: Optional[int] = None
@@ -194,7 +194,8 @@ class ImmutableSegment:
                 n = (self.n_docs if meta.single_value
                      else meta.total_number_of_entries)
                 dtype = np.dtype(meta.data_type.np_dtype)
-                raw = native.decompress_chunks(blob, offs, n * dtype.itemsize)
+                raw = native.decompress_chunks(blob, offs, n * dtype.itemsize,
+                                               codec=meta.compression)
                 self._fwd_cache[col] = raw.view(dtype)
             elif meta.packed_bits is not None:
                 from pinot_tpu import native

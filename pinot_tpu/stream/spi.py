@@ -120,6 +120,18 @@ def get_decoder(name: str, stream_config: StreamConfig) -> Callable:
         cols = stream_config.properties.get("csv.columns", "")
         return csv_decoder_for(cols.split(","),
                                stream_config.properties.get("csv.delimiter", ","))
+    if name == "avro":
+        # schemaful binary records (SimpleAvroMessageDecoder analog): the
+        # writer schema rides in stream properties, one binary record per
+        # message, no container framing
+        from pinot_tpu.ingestion.avro_io import binary_decoder_for
+
+        schema_json = stream_config.properties.get("avro.schema", "")
+        if not schema_json:
+            raise KeyError(
+                "avro decoder needs the writer schema in stream "
+                "properties['avro.schema']")
+        return binary_decoder_for(schema_json)
     try:
         return _DECODERS[name]
     except KeyError:
@@ -147,6 +159,8 @@ def create_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
         from pinot_tpu.stream import memory_stream  # noqa: F401  (registers)
     if config.stream_type == "kafka" and "kafka" not in _FACTORIES:
         from pinot_tpu.stream import kafka_stream  # noqa: F401  (registers)
+    if config.stream_type == "kinesis" and "kinesis" not in _FACTORIES:
+        from pinot_tpu.stream import kinesis_stream  # noqa: F401  (registers)
     try:
         cls = _FACTORIES[config.stream_type]
     except KeyError:

@@ -79,13 +79,20 @@ class QueryServerTransport:
 
     def __init__(self, submit_fn: Callable[[bytes], bytes],
                  host: str = "127.0.0.1", port: int = 0, max_workers: int = 8,
-                 submit_streaming_fn: Optional[Callable] = None):
+                 submit_streaming_fn: Optional[Callable] = None, tls=None):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             handlers=(_BytesHandler(submit_fn, submit_streaming_fn),),
         )
-        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if tls is not None:
+            # TlsConfig (common/tls.py) — the reference's Netty/gRPC TLS
+            # listener (TlsConfig.java + GrpcQueryServer secure mode)
+            self.port = self._server.add_secure_port(
+                f"{host}:{port}", tls.server_credentials())
+        else:
+            self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
+        self.tls_enabled = tls is not None
 
     def start(self) -> None:
         self._server.start()
@@ -102,9 +109,14 @@ class QueryRouterChannel:
     """Broker side: one channel per server instance
     (transport/QueryRouter.java + ServerChannels analog)."""
 
-    def __init__(self, endpoint: str):
+    def __init__(self, endpoint: str, tls=None):
         self.endpoint = endpoint
-        self._channel = grpc.insecure_channel(endpoint)
+        if tls is not None:
+            self._channel = grpc.secure_channel(
+                endpoint, tls.channel_credentials(),
+                options=tls.channel_options())
+        else:
+            self._channel = grpc.insecure_channel(endpoint)
         self._submit = self._channel.unary_unary(
             SUBMIT_METHOD, request_serializer=None, response_deserializer=None
         )

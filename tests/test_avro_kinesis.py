@@ -1,0 +1,269 @@
+"""Avro input format (pure-python codec) + Kinesis stream plugin (faked
+boto3), in the style of test_kafka_stream.py / test_s3fs.py.
+
+Reference analogs: pinot-plugins/pinot-input-format/pinot-avro/,
+pinot-stream-ingestion/pinot-kinesis/, SimpleAvroMessageDecoder.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig
+from pinot_tpu.ingestion import avro_io
+
+
+AVRO_SCHEMA = {
+    "type": "record",
+    "name": "Event",
+    "fields": [
+        {"name": "user", "type": "string"},
+        {"name": "clicks", "type": "long"},
+        {"name": "score", "type": "double"},
+        {"name": "ok", "type": "boolean"},
+        {"name": "tags", "type": {"type": "array", "items": "string"}},
+        {"name": "attrs", "type": {"type": "map", "values": "int"}},
+        {"name": "maybe", "type": ["null", "long"]},
+        {"name": "blob", "type": "bytes"},
+    ],
+}
+
+ROWS = [
+    {"user": "ué", "clicks": 2**40, "score": 1.5, "ok": True,
+     "tags": ["a", "b"], "attrs": {"k": 1}, "maybe": None, "blob": b"\x00\x01"},
+    {"user": "v", "clicks": -7, "score": -0.25, "ok": False,
+     "tags": [], "attrs": {}, "maybe": 42, "blob": b""},
+    {"user": "w", "clicks": 0, "score": 0.0, "ok": True,
+     "tags": ["x"], "attrs": {"a": -1, "b": 2}, "maybe": -(2**50),
+     "blob": b"zz"},
+]
+
+
+class TestAvroCodec:
+    @pytest.mark.parametrize("codec", ["null", "deflate"])
+    def test_container_roundtrip(self, tmp_path, codec):
+        p = str(tmp_path / f"f_{codec}.avro")
+        avro_io.write_container(p, AVRO_SCHEMA, ROWS, codec=codec)
+        assert avro_io.read_container(p) == ROWS
+
+    def test_binary_record_roundtrip(self):
+        import json
+
+        dec = avro_io.binary_decoder_for(json.dumps(AVRO_SCHEMA))
+        for r in ROWS:
+            assert dec(avro_io.encode_record(AVRO_SCHEMA, r)) == r
+
+    def test_record_reader_registered(self, tmp_path):
+        from pinot_tpu.ingestion.readers import create_record_reader
+
+        p = str(tmp_path / "f.avro")
+        avro_io.write_container(p, AVRO_SCHEMA, ROWS)
+        rows = create_record_reader("avro").read_rows(p)
+        assert [r["user"] for r in rows] == ["ué", "v", "w"]
+
+    def test_batch_ingestion_end_to_end(self, tmp_path):
+        """Avro files → segment → query (the pinot-avro batch path)."""
+        from pinot_tpu.engine.engine import QueryEngine
+        from pinot_tpu.ingestion.readers import create_record_reader, rows_to_columns
+        from pinot_tpu.storage.creator import build_segment
+
+        schema = Schema.build(
+            name="ev",
+            dimensions=[("user", DataType.STRING)],
+            metrics=[("clicks", DataType.LONG)],
+        )
+        avro_schema = avro_io.schema_for_pinot(schema)
+        rows = [{"user": f"u{i % 5}", "clicks": i} for i in range(1000)]
+        p = str(tmp_path / "data.avro")
+        avro_io.write_container(p, avro_schema, rows, codec="deflate")
+
+        read = create_record_reader("avro").read_rows(p)
+        cols = rows_to_columns(read, schema)
+        seg = build_segment(schema, cols, str(tmp_path / "seg"),
+                            TableConfig(table_name="ev"), "s0")
+        eng = QueryEngine(device_executor=None)
+        eng.add_segment("ev", seg)
+        r = eng.execute("SELECT user, SUM(clicks) FROM ev GROUP BY user "
+                        "ORDER BY user")
+        assert not r.get("exceptions"), r
+        want = {f"u{j}": sum(i for i in range(1000) if i % 5 == j)
+                for j in range(5)}
+        assert [(row[0], row[1]) for row in r["resultTable"]["rows"]] == \
+            sorted((k, float(v)) for k, v in want.items())
+
+    def test_avro_stream_decoder(self):
+        import json
+
+        cfg = StreamConfig(
+            stream_type="memory", topic="t", decoder="avro",
+            properties={"avro.schema": json.dumps(AVRO_SCHEMA)})
+        from pinot_tpu.stream.spi import get_decoder
+
+        dec = get_decoder("avro", cfg)
+        out = dec(avro_io.encode_record(AVRO_SCHEMA, ROWS[0]))
+        assert out["user"] == "ué" and out["clicks"] == 2**40
+
+    def test_missing_stream_schema_raises(self):
+        from pinot_tpu.stream.spi import get_decoder
+
+        cfg = StreamConfig(stream_type="memory", topic="t", decoder="avro")
+        with pytest.raises(KeyError):
+            get_decoder("avro", cfg)
+
+
+# ---------------------------------------------------------------------------
+# faked boto3 kinesis
+# ---------------------------------------------------------------------------
+
+
+class _FakeKinesisClient:
+    def __init__(self, streams):
+        # streams: {name: {shard_id: [ (seq:int, data:bytes, pkey) ]}}
+        self._streams = streams
+        self._iters = {}
+        self._n = 0
+        self.closed = False
+
+    def list_shards(self, StreamName=None, NextToken=None):
+        return {"Shards": [{"ShardId": sid}
+                           for sid in sorted(self._streams[StreamName])]}
+
+    def get_shard_iterator(self, StreamName, ShardId, ShardIteratorType,
+                           StartingSequenceNumber=None):
+        self._n += 1
+        token = f"it{self._n}"
+        if ShardIteratorType == "TRIM_HORIZON":
+            pos = 0
+        elif ShardIteratorType == "AFTER_SEQUENCE_NUMBER":
+            pos = int(StartingSequenceNumber) + 1
+        else:
+            raise AssertionError(ShardIteratorType)
+        self._iters[token] = (StreamName, ShardId, pos)
+        return {"ShardIterator": token}
+
+    def get_records(self, ShardIterator, Limit=None):
+        stream, shard, pos = self._iters.pop(ShardIterator)
+        log = self._streams[stream][shard]
+        batch = [r for r in log if r[0] >= pos][:100]
+        next_pos = (batch[-1][0] + 1) if batch else pos
+        self._n += 1
+        token = f"it{self._n}"
+        self._iters[token] = (stream, shard, next_pos)
+        return {
+            "Records": [
+                {"SequenceNumber": str(seq), "Data": data,
+                 "PartitionKey": pk, "ApproximateArrivalTimestamp": None}
+                for seq, data, pk in batch
+            ],
+            "NextShardIterator": token,
+        }
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.fixture()
+def fake_boto3(monkeypatch):
+    streams = {
+        "events": {
+            "shardId-000": [(100, b'{"v": 1}', "a"), (101, b'{"v": 2}', "b"),
+                            (105, b'{"v": 3}', "c")],
+            "shardId-001": [(500, b'{"v": 10}', "d")],
+        }
+    }
+    mod = types.ModuleType("boto3")
+    mod.client = lambda service, **kw: _FakeKinesisClient(streams)
+    monkeypatch.setitem(sys.modules, "boto3", mod)
+    # the plugin may already be registered from a previous test run in this
+    # process; re-import is harmless (idempotent register)
+    return streams
+
+
+class TestKinesisPlugin:
+    def _cfg(self):
+        return StreamConfig(stream_type="kinesis", topic="events",
+                            decoder="json",
+                            properties={"aws.region": "us-test-1"})
+
+    def test_partition_count_and_earliest(self, fake_boto3):
+        from pinot_tpu.stream.spi import create_consumer_factory
+
+        f = create_consumer_factory(self._cfg())
+        assert f.partition_count() == 2
+        assert f.earliest_offset(0).value == 0
+
+    def test_fetch_resume_and_next_offset(self, fake_boto3):
+        from pinot_tpu.stream.spi import create_consumer_factory
+        from pinot_tpu.stream.spi import StreamPartitionMsgOffset
+
+        f = create_consumer_factory(self._cfg())
+        c = f.create_partition_consumer(0)
+        batch = c.fetch_messages(StreamPartitionMsgOffset(0), 100)
+        assert [m.payload for m in batch.messages] == \
+            [b'{"v": 1}', b'{"v": 2}', b'{"v": 3}']
+        # sequence-number offsets: next = last seq + 1
+        assert batch.next_offset.value == 106
+        # resume from a checkpoint mid-stream replays only the tail
+        batch2 = c.fetch_messages(StreamPartitionMsgOffset(102), 100)
+        assert [m.payload for m in batch2.messages] == [b'{"v": 3}']
+        c.close()
+
+    def test_second_shard_is_partition_1(self, fake_boto3):
+        from pinot_tpu.stream.spi import create_consumer_factory
+        from pinot_tpu.stream.spi import StreamPartitionMsgOffset
+
+        f = create_consumer_factory(self._cfg())
+        c = f.create_partition_consumer(1)
+        batch = c.fetch_messages(StreamPartitionMsgOffset(0), 100)
+        assert [m.payload for m in batch.messages] == [b'{"v": 10}']
+        assert batch.next_offset.value == 501
+
+    def test_gating_error_without_boto3(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "boto3", None)
+        import importlib
+
+        from pinot_tpu.stream import kinesis_stream
+
+        monkeypatch.setattr(
+            kinesis_stream, "_boto3",
+            lambda: (_ for _ in ()).throw(
+                RuntimeError("stream_type 'kinesis' needs the boto3 package")))
+        with pytest.raises(RuntimeError, match="boto3"):
+            kinesis_stream.KinesisConsumerFactory(self._cfg())
+
+    def test_realtime_consume_via_kinesis(self, fake_boto3, tmp_path):
+        """Full realtime manager loop over the faked kinesis stream."""
+        import time
+
+        from pinot_tpu.engine.engine import QueryEngine
+        from pinot_tpu.realtime.manager import RealtimeTableDataManager
+
+        schema = Schema.build(name="ev", dimensions=[],
+                              metrics=[("v", DataType.INT)])
+        cfg = TableConfig(
+            table_name="ev", table_type=None,
+            stream=StreamConfig(
+                stream_type="kinesis", topic="events", decoder="json",
+                segment_flush_threshold_rows=100_000,
+                segment_flush_threshold_seconds=3600,
+                properties={"aws.region": "us-test-1"}),
+        )
+        eng = QueryEngine(device_executor=None)
+        mgr = RealtimeTableDataManager(schema, cfg, eng.table("ev"),
+                                       str(tmp_path / "rt"))
+        mgr.start(partitions=[0, 1])
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                r = eng.execute("SELECT COUNT(*), SUM(v) FROM ev")
+                if not r.get("exceptions") and \
+                        r["resultTable"]["rows"][0][0] == 4:
+                    break
+                time.sleep(0.1)
+            assert r["resultTable"]["rows"][0] == [4, 16.0], r
+        finally:
+            mgr.stop(commit_remaining=False)

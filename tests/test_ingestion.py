@@ -86,7 +86,7 @@ class TestReaders:
 
     def test_unknown_format_raises(self):
         with pytest.raises(ValueError, match="unknown input format"):
-            create_record_reader("avro")
+            create_record_reader("xml")
 
     def test_parquet_roundtrip(self, tmp_path):
         pa = pytest.importorskip("pyarrow")

@@ -1,0 +1,189 @@
+"""TLS on the data plane (gRPC) and broker HTTP.
+
+Reference analogs: TlsConfig.java:1 + NettyConfig + the TLS cluster
+integration tests — a TLS cluster serves queries end-to-end, and a
+plaintext client is rejected.
+"""
+
+import json
+import ssl
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.common.tls import TlsConfig, generate_self_signed
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+
+@pytest.fixture(scope="module")
+def tls(tmp_path_factory):
+    return generate_self_signed(str(tmp_path_factory.mktemp("certs")))
+
+
+@pytest.fixture()
+def tls_cluster(tmp_path, tls):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    server = ServerInstance("s0", registry, str(tmp_path / "srv"),
+                            device_executor=None, tls=tls)
+    server.start()
+    broker = Broker(registry, tls=tls)
+    schema = Schema.build(name="t", dimensions=[("k", DataType.STRING)],
+                          metrics=[("v", DataType.INT)])
+    cfg = TableConfig(table_name="t")
+    controller.add_table(cfg, schema)
+    d = str(tmp_path / "seg")
+    build_segment(schema, {"k": np.array(["a", "b"] * 500),
+                           "v": np.arange(1000, dtype=np.int32)}, d, cfg, "t_0")
+    controller.upload_segment("t", d)
+    yield registry, server, broker
+    broker.close()
+    server.stop()
+
+
+def _query_until(broker, sql, timeout=10):
+    deadline = time.time() + timeout
+    r = None
+    while time.time() < deadline:
+        r = broker.execute(sql)
+        if not r.get("exceptions"):
+            return r
+        time.sleep(0.1)
+    raise AssertionError(r)
+
+
+class TestGrpcTls:
+    def test_tls_cluster_serves_queries(self, tls_cluster):
+        registry, server, broker = tls_cluster
+        assert server.transport.tls_enabled
+        r = _query_until(broker, "SELECT COUNT(*), SUM(v) FROM t")
+        assert r["resultTable"]["rows"][0] == [1000, float(sum(range(1000)))]
+
+    def test_plaintext_client_rejected(self, tls_cluster):
+        """A non-TLS channel to a TLS server must fail the handshake, not
+        silently serve (the deployable-posture check)."""
+        registry, server, broker = tls_cluster
+        from pinot_tpu.transport.grpc_transport import (
+            QueryRouterChannel,
+            make_instance_request,
+        )
+
+        _query_until(broker, "SELECT COUNT(*) FROM t")  # server is up
+        plain = QueryRouterChannel(server.transport.endpoint, tls=None)
+        try:
+            with pytest.raises(Exception):
+                plain.submit(
+                    make_instance_request("SELECT COUNT(*) FROM t", ["t_0"], 1),
+                    timeout_s=3,
+                )
+        finally:
+            plain.close()
+
+    def test_wrong_ca_rejected(self, tls_cluster, tmp_path):
+        registry, server, broker = tls_cluster
+        from pinot_tpu.transport.grpc_transport import (
+            QueryRouterChannel,
+            make_instance_request,
+        )
+
+        other = generate_self_signed(str(tmp_path / "othercerts"))
+        _query_until(broker, "SELECT COUNT(*) FROM t")
+        bad = QueryRouterChannel(server.transport.endpoint, tls=other)
+        try:
+            with pytest.raises(Exception):
+                bad.submit(
+                    make_instance_request("SELECT COUNT(*) FROM t", ["t_0"], 1),
+                    timeout_s=3,
+                )
+        finally:
+            bad.close()
+
+
+class TestHttpsTls:
+    def test_https_query_and_plaintext_rejected(self, tls_cluster, tls):
+        registry, server, broker = tls_cluster
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+
+        _query_until(broker, "SELECT COUNT(*) FROM t")
+        srv = BrokerHttpServer(broker, tls=tls)
+        srv.start()
+        try:
+            assert srv.url.startswith("https://")
+            ctx = tls.client_ssl_context()
+            ctx.check_hostname = False  # cert CN=localhost, dialing by IP
+            req = urllib.request.Request(
+                srv.url + "/query/sql",
+                data=json.dumps({"sql": "SELECT COUNT(*) FROM t"}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5, context=ctx) as resp:
+                out = json.loads(resp.read())
+            assert out["resultTable"]["rows"][0][0] == 1000
+
+            # plain http to the TLS port fails
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}/health", timeout=3)
+
+            # an https client that doesn't trust the CA fails verification
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    srv.url + "/health", timeout=3,
+                    context=ssl.create_default_context())
+        finally:
+            srv.stop()
+
+    def test_dbapi_client_over_https(self, tls_cluster, tls):
+        registry, server, broker = tls_cluster
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+        from pinot_tpu.client import connect
+
+        _query_until(broker, "SELECT COUNT(*) FROM t")
+        srv = BrokerHttpServer(broker, tls=tls)
+        srv.start()
+        try:
+            ctx = tls.client_ssl_context()
+            ctx.check_hostname = False
+            conn = connect(srv.url, ssl_context=ctx)
+            cur = conn.cursor()
+            cur.execute("SELECT k, COUNT(*) FROM t GROUP BY k ORDER BY k")
+            rows = cur.fetchall()
+            assert rows == [("a", 500), ("b", 500)]
+            conn.close()
+        finally:
+            srv.stop()
+
+
+class TestTlsConfigLoading:
+    def test_from_config_disabled_by_default(self):
+        assert TlsConfig.from_config() is None
+
+    def test_from_config_enabled(self, tls):
+        from pinot_tpu.common.config import Configuration
+
+        cfg = Configuration(overrides={
+            "pinot.tls.enabled": "true",
+            "pinot.tls.cert_file": tls.cert_file,
+            "pinot.tls.key_file": tls.key_file,
+            "pinot.tls.target_name_override": "localhost",
+        })
+        t = TlsConfig.from_config(cfg)
+        assert t is not None and t.cert_file == tls.cert_file
+        assert t.channel_options() == [
+            ("grpc.ssl_target_name_override", "localhost")]
+
+    def test_missing_files_raise(self):
+        from pinot_tpu.common.config import Configuration
+
+        cfg = Configuration(overrides={"pinot.tls.enabled": "true"})
+        with pytest.raises(ValueError):
+            TlsConfig.from_config(cfg)
