@@ -346,6 +346,59 @@ def bench_suite(engine, queries, warm=2, iters=7):
     return detail
 
 
+def bench_realtime():
+    """Realtime path numbers (BenchmarkRealtimeConsumptionSpeed analog):
+    row-at-a-time ingest rate into a consuming (mutable) segment, seal
+    time, and query latency OVER the consuming segment (host scan path —
+    the reference serves CONSUMING segments as a first-class path)."""
+    import shutil
+
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.mutable import MutableSegment
+
+    schema = Schema.build(
+        name="rt",
+        dimensions=[("zone", DataType.STRING), ("hour", DataType.INT)],
+        metrics=[("fare", DataType.INT)],
+    )
+    rng = np.random.default_rng(4)
+    n = 200_000
+    zones = [f"zone_{i:03d}" for i in range(260)]
+    rows = [
+        {"zone": zones[z], "hour": int(h), "fare": int(f)}
+        for z, h, f in zip(
+            rng.integers(0, 260, n), rng.integers(0, 24, n),
+            rng.integers(100, 10_000, n),
+        )
+    ]
+    seg = MutableSegment(schema, "rt__0__0__0")
+    t0 = time.perf_counter()
+    for r in rows:
+        seg.index(r)
+    ingest_s = time.perf_counter() - t0
+
+    eng = QueryEngine(device_executor=None)
+    eng.add_segment("rt", seg)
+    sql = ("SELECT zone, COUNT(*), SUM(fare) FROM rt GROUP BY zone "
+           "ORDER BY SUM(fare) DESC LIMIT 10")
+    lat = run_samples(eng, sql, 5)
+
+    out = os.path.join(CACHE, "rt_sealed")
+    shutil.rmtree(out, ignore_errors=True)
+    t0 = time.perf_counter()
+    seg.seal(out)
+    seal_s = time.perf_counter() - t0
+    return {
+        "ingest_rows_per_s": round(n / ingest_s),
+        "seal_ms": round(seal_s * 1e3, 1),
+        "consuming_query_p50_ms": round(
+            float(np.percentile(lat, 50)) * 1e3, 2),
+        "consuming_rows": n,
+    }
+
+
 def main():
     os.makedirs(CACHE, exist_ok=True)
     smoke_gate()
@@ -377,6 +430,7 @@ def main():
 
     ssb_detail = bench_suite(eng, SSB_QUERIES)
     taxi_detail = bench_suite(eng, TAXI_QUERIES)
+    realtime_detail = bench_realtime()
 
     # exactness gate: the cube-routed q4 must answer EXACTLY like the
     # forced-scan q4 at full scale (same value hashing on both sides)
@@ -407,6 +461,7 @@ def main():
                 "detail": {
                     "ssb100m": ssb_detail,
                     "taxi12m": taxi_detail,
+                    "realtime": realtime_detail,
                     "ssb_rows": ssb_rows,
                     "taxi_rows": taxi_rows,
                     "dataset_build_s": build_s,

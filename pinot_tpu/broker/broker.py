@@ -452,6 +452,7 @@ class Broker:
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,
+                "numGroupsLimitReached": stats.num_groups_limit_reached,
                 # summed across servers, like the reference's V3 metadata
                 "threadCpuTimeNs": stats.thread_cpu_time_ns,
                 "schedulerWaitMs": round(stats.scheduler_wait_ms, 3),

@@ -917,13 +917,16 @@ class DeviceExecutor:
         gcount = outs["gcount"]
         present = np.nonzero(gcount > 0)[0]
         opts = q.options_ci()
+        # numGroupsLimit applies on the device path too (engine default or
+        # per-query SET override): excess groups drop arbitrarily-but-
+        # deterministically (gid order), like the reference's hash-order
+        # drops, and the stats flag marks the result plan-dependent-partial
+        limit = self.num_groups_limit
         if "numgroupslimit" in opts:
-            # per-query numGroupsLimit (SET option): excess groups drop —
-            # arbitrary-but-deterministic (gid order), like the reference's
-            # hash-order drops
             limit = max(1, int(opts["numgroupslimit"]))
-            if len(present) > limit:
-                present = present[:limit]
+        if len(present) > limit:
+            present = present[:limit]
+            stats.num_groups_limit_reached = True
         # decode the combined key (dense: the gid itself; sorted: the int64
         # key recorded per table slot) → per-column global ids → values
         if shape == "groupby_sorted":

@@ -207,6 +207,7 @@ class QueryEngine:
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "numSegmentsPrunedByServer": stats.num_segments_pruned,
+                "numGroupsLimitReached": stats.num_groups_limit_reached,
                 "totalDocs": stats.total_docs,
                 "timeUsedMs": round((time.time() - t0) * 1000, 3),
             }

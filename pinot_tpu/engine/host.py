@@ -651,7 +651,10 @@ class HostExecutor:
             limit = max(1, int(opts["numgroupslimit"]))
         if n_groups > limit:
             # keep the first `limit` groups *encountered*, by doc order
-            # (reference numGroupsLimit semantics: excess groups dropped)
+            # (reference numGroupsLimit semantics: excess groups dropped);
+            # the flag tells callers the result is plan-dependent-partial
+            # (reference numGroupsLimitReached response metadata)
+            stats.num_groups_limit_reached = True
             _, first_idx = np.unique(ginv, return_index=True)
             keep = np.argsort(first_idx)[:limit]
             keep_mask = np.isin(ginv, keep)

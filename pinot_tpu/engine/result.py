@@ -32,6 +32,9 @@ class ExecutionStats:
     # threadCpuTimeNs + scheduler wait) — filled by the server's scheduler
     thread_cpu_time_ns: int = 0
     scheduler_wait_ms: float = 0.0
+    # groups dropped by numGroupsLimit: the result is plan-dependent
+    # partial (reference numGroupsLimitReached response metadata)
+    num_groups_limit_reached: bool = False
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -44,6 +47,7 @@ class ExecutionStats:
         self.total_docs += other.total_docs
         self.thread_cpu_time_ns += other.thread_cpu_time_ns
         self.scheduler_wait_ms += other.scheduler_wait_ms
+        self.num_groups_limit_reached |= other.num_groups_limit_reached
 
 
 @dataclasses.dataclass

@@ -152,9 +152,17 @@ class TokenBucketScheduler:
         self.num_rejected = 0
         self.num_executed = 0
 
+    MAX_GROUPS = 1024  # arbitrary-SQL servers must not grow state unboundedly
+
     def _group(self, name: str) -> SchedulerGroup:
         g = self._groups.get(name)
         if g is None:
+            if len(self._groups) >= self.MAX_GROUPS:
+                # overflow tenants share one bucket rather than minting
+                # fresh full-burst groups forever
+                return self._groups.setdefault(
+                    "__overflow__", SchedulerGroup(
+                        "__overflow__", self.rate_ms_per_s, self.burst_ms))
             g = self._groups[name] = SchedulerGroup(
                 name, self.rate_ms_per_s, self.burst_ms)
         return g
@@ -190,13 +198,15 @@ class TokenBucketScheduler:
             else min(self.queue_timeout_s, queue_timeout_s)
         deadline = time.perf_counter() + wait_s
         with self._cond:
+            # resolve to the EFFECTIVE group once (overflow sharing) so all
+            # later lookups agree
+            group = self._group(group).name
             if len(self._waiters) >= self.max_queued:
                 self.num_rejected += 1
-                self._group(group).num_rejected += 1
+                self._groups[group].num_rejected += 1
                 raise SchedulerSaturated(
                     f"query queue full ({len(self._waiters)} waiting, "
                     f"{self._running} running)")
-            self._group(group)
             seq = self._seq
             self._seq += 1
             me = (seq, group)
@@ -221,6 +231,9 @@ class TokenBucketScheduler:
                 self._running_by_group.get(group, 0) + 1
             self.num_executed += 1
             self._groups[group].num_executed += 1
+            # other waiters may now also be eligible (free slots remain);
+            # without this they idle until their 20ms poll expires
+            self._cond.notify_all()
         if stats_out is not None:
             stats_out["scheduler_wait_ms"] = \
                 (time.perf_counter() - (deadline - wait_s)) * 1e3

@@ -174,7 +174,14 @@ def encode_value(out: io.BytesIO, schema, value) -> None:
         t = schema["type"]
         if t == "record":
             for f in schema["fields"]:
-                encode_value(out, f["type"], value.get(f["name"]))
+                v = value.get(f["name"])
+                if v is None and not _nullable(f["type"]):
+                    # str(None)/int(None) would silently corrupt the file
+                    # or raise a context-free TypeError rows later
+                    raise ValueError(
+                        f"missing required avro field {f['name']!r} "
+                        f"(schema {schema.get('name', '?')})")
+                encode_value(out, f["type"], v)
             return
         if t == "array":
             if value:
@@ -215,6 +222,14 @@ def encode_value(out: io.BytesIO, schema, value) -> None:
         _write_bytes(out, str(value).encode("utf-8"))
     else:
         raise ValueError(f"unsupported avro schema {schema!r}")
+
+
+def _nullable(schema) -> bool:
+    if schema == "null":
+        return True
+    if isinstance(schema, list):
+        return any(_nullable(s) for s in schema)
+    return isinstance(schema, dict) and schema.get("type") == "null"
 
 
 def _matches(schema, value) -> bool:

@@ -163,11 +163,20 @@ class ServerInstance:
     @staticmethod
     def _scheduler_group(sql: str) -> str:
         """Tenant key for token-bucket priority: the table name
-        (TableBasedGroupMapper analog), extracted cheaply pre-compile."""
+        (TableBasedGroupMapper analog), extracted cheaply pre-compile.
+        Normalized (lowercase, physical-type suffix stripped) so spelling
+        variants of one table share ONE bucket — distinct raw strings
+        would each mint a fresh full-burst group and defeat fairness."""
         import re as _re
 
         m = _re.search(r"\bFROM\s+([A-Za-z_][\w.]*)", sql, _re.IGNORECASE)
-        return m.group(1) if m else "default"
+        if not m:
+            return "default"
+        name = m.group(1).lower()
+        for suffix in ("_offline", "_realtime"):
+            if name.endswith(suffix):
+                name = name[: -len(suffix)]
+        return name
 
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)

@@ -96,7 +96,13 @@ def _shard_ids(client, stream: str) -> list:
 class KinesisPartitionConsumer(PartitionGroupConsumer):
     def __init__(self, config: StreamConfig, partition: int):
         self.config = config
-        self._client = _client(config)
+        # client-level SDK bound approximating the per-fetch SPI timeout
+        # (boto3 configures timeouts per client, not per call); the stream
+        # property overrides the 10s default
+        props = config.properties or {}
+        self._client = _client(
+            config,
+            timeout_ms=int(props.get("kinesis.fetch.timeout.ms", 10_000)))
         self._stream = config.topic
         ids = _shard_ids(self._client, self._stream)
         if partition >= len(ids):

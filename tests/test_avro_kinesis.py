@@ -223,15 +223,11 @@ class TestKinesisPlugin:
         assert batch.next_offset.value == 501
 
     def test_gating_error_without_boto3(self, monkeypatch):
+        # sys.modules[name] = None makes `import boto3` raise ImportError,
+        # driving the REAL gating path (no mocking of _boto3 itself)
         monkeypatch.setitem(sys.modules, "boto3", None)
-        import importlib
-
         from pinot_tpu.stream import kinesis_stream
 
-        monkeypatch.setattr(
-            kinesis_stream, "_boto3",
-            lambda: (_ for _ in ()).throw(
-                RuntimeError("stream_type 'kinesis' needs the boto3 package")))
         with pytest.raises(RuntimeError, match="boto3"):
             kinesis_stream.KinesisConsumerFactory(self._cfg())
 
