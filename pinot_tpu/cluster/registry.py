@@ -56,6 +56,8 @@ class InstanceInfo:
     host: str = "127.0.0.1"
     grpc_port: int = 0
     last_heartbeat_ms: int = 0
+    # instance tags (Helix tag analog): tier placement targets one tag
+    tags: list = dataclasses.field(default_factory=list)
 
     @property
     def endpoint(self) -> str:
@@ -220,6 +222,17 @@ class ClusterRegistry:
     def table_config(self, table: str) -> Optional[TableConfig]:
         d = self._tx_read(lambda s: s["tables"].get(table))
         return None if d is None else TableConfig.from_json(d)
+
+    def set_table_config(self, table: str, config: TableConfig) -> None:
+        """Hot config update (controller REST table-config PUT analog);
+        servers pick it up level-triggered on their next sync."""
+
+        def fn(s):
+            if table not in s["tables"]:
+                raise KeyError(f"table {table!r} not found")
+            s["tables"][table] = config.to_json()
+
+        self._tx(fn)
 
     def table_schema(self, table: str) -> Optional[Schema]:
         d = self._tx_read(lambda s: s["schemas"].get(table))

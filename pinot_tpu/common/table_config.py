@@ -36,6 +36,12 @@ class IndexingConfig:
     bloom_filter_columns: list[str] = dataclasses.field(default_factory=list)
     json_index_columns: list[str] = dataclasses.field(default_factory=list)
     text_index_columns: list[str] = dataclasses.field(default_factory=list)
+    # FST-index analog: trigram posting index accelerating LIKE/REGEXP_LIKE
+    # on dictionary columns (storage/fstindex.py)
+    fst_index_columns: list[str] = dataclasses.field(default_factory=list)
+    # H3-index analog: grid-cell postings accelerating
+    # ST_DISTANCE(col, point) < r on WKT POINT columns (storage/geoindex.py)
+    h3_index_columns: list[str] = dataclasses.field(default_factory=list)
     sorted_column: Optional[str] = None
     no_dictionary_columns: list[str] = dataclasses.field(default_factory=list)
     star_tree_configs: list[StarTreeIndexConfig] = dataclasses.field(default_factory=list)
@@ -131,6 +137,11 @@ class TableConfig:
     # Minion task configs keyed by task type (TableTaskConfig analog), e.g.
     # {"MergeRollupTask": {"max_docs_per_segment": 1_000_000}}
     task_configs: dict = dataclasses.field(default_factory=dict)
+    # Tier storage (TierConfig analog): ordered oldest-tier-last; segments
+    # whose end-time age exceeds segment_age_ms relocate to servers carrying
+    # server_tag, e.g. [{"name": "cold", "segment_age_ms": 86400000,
+    # "server_tag": "cold_tier"}]
+    tiers: list = dataclasses.field(default_factory=list)
 
     def __post_init__(self):
         # TableConfigUtils analog: star-trees pre-aggregate over all rows at

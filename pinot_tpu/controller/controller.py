@@ -14,6 +14,7 @@ import logging
 import os
 import threading
 import time
+import zlib
 from typing import Optional
 
 from pinot_tpu.cluster.registry import (
@@ -366,6 +367,92 @@ class Controller:
         requeued = self.registry.requeue_stale_tasks(stale_ms)
         return {"requeued_tasks": requeued, "reverted_lineage": reverted}
 
+    def run_segment_relocation(self, now_ms: Optional[int] = None) -> dict:
+        """Tier storage relocation (relocation/SegmentRelocator.java
+        analog): segments older than a tier's segment_age_ms move to live
+        servers carrying the tier's server_tag (Helix-tag analog on
+        InstanceInfo.tags); servers reconcile the new assignment on their
+        next sync (download there, refcounted unload here). Returns
+        {table: {segment: {tier, to}}}."""
+        import time as _time
+
+        now = now_ms if now_ms is not None else int(_time.time() * 1000)
+        live = self.registry.instances(
+            Role.SERVER, live_ttl_ms=self.assigner.live_ttl_ms)
+        by_tag: dict = {}
+        for i in live:
+            for t in getattr(i, "tags", ()) or ():
+                by_tag.setdefault(t, []).append(i.instance_id)
+        moved: dict = {}
+        for table in self.registry.tables():
+            cfg = self.registry.table_config(table)
+            tiers = getattr(cfg, "tiers", None) if cfg else None
+            if not tiers:
+                continue
+            assign = self.registry.assignment(table)
+            recs = self.registry.segments(table)
+            new = {k: list(v) for k, v in assign.items()}
+            dirty = False
+            repl = self._table_replication(cfg)
+            for name, rec in recs.items():
+                # age by the segment's data END TIME like run_retention and
+                # the reference's TimeBasedTierSegmentSelector — push time
+                # only when the table has no time column (a backfilled
+                # segment of old data must tier by its data, not its push)
+                basis = rec.end_time if rec.end_time is not None \
+                    else rec.push_time_ms
+                age = now - (basis or now)
+                tier = None
+                # oldest-threshold tier wins when several match
+                for t in sorted(tiers, key=lambda t: t["segment_age_ms"],
+                                reverse=True):
+                    if age >= t["segment_age_ms"]:
+                        tier = t
+                        break
+                if tier is None:
+                    continue
+                targets = sorted(by_tag.get(tier["server_tag"], []))
+                if not targets:
+                    continue  # no capacity on the tier: stay put
+                k = max(1, min(repl, len(targets)))
+                # spread segments across the tier (balanced like the
+                # reference relocator) — a fixed prefix would pile every
+                # segment onto the lexicographically-first tagged server
+                start = zlib.crc32(name.encode()) % len(targets)
+                want = sorted(targets[(start + j) % len(targets)]
+                              for j in range(k))
+                if sorted(new.get(name, [])) != want:
+                    new[name] = want
+                    dirty = True
+                    moved.setdefault(table, {})[name] = {
+                        "tier": tier["name"], "to": want}
+            if dirty:
+                self.registry.set_assignment(table, new)
+        return moved
+
+    def recommend_config(self, schema, sample_queries,
+                         qps: float = 100.0) -> dict:
+        """Workload-driven config advisor (recommender/RecommenderDriver
+        role) — advisory, nothing is applied."""
+        from pinot_tpu.controller.advisor import recommend_config
+
+        return recommend_config(schema, sample_queries, qps)
+
+    def tune_table(self, table: str) -> dict:
+        """Observed-metadata config tuner (tuner/TableConfigTuner role):
+        grows the registered table's IndexingConfig from hosted segment
+        stats and persists the update."""
+        from pinot_tpu.controller.advisor import tune_table
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        table = self.resolve(table)
+        segs = []
+        for name, rec in self.registry.segments(table).items():
+            if rec.location and os.path.isdir(rec.location):
+                segs.append(ImmutableSegment(rec.location))
+                break  # stats from one representative segment suffice
+        return tune_table(self.registry, table, segs)
+
     def start_periodic_tasks(self, interval_s: float = 60.0) -> None:
         """ControllerPeriodicTaskScheduler analog: retention, realtime
         repair, minion task generation and stale-task repair on a timer
@@ -379,6 +466,7 @@ class Controller:
             while not self._periodic_stop.wait(interval_s):
                 for step in (self.run_retention, self.run_realtime_repair,
                              self.run_dim_table_replication,
+                             self.run_segment_relocation,
                              self.run_task_generation, self.run_task_repair):
                     try:
                         step()

@@ -303,6 +303,10 @@ class SegmentEvaluator:
             return self._json_match_mask(p)
         if p.type is PredicateType.TEXT_MATCH:
             return self._text_match_mask(p)
+        if p.type is PredicateType.RANGE and p.upper is not None:
+            m = self._geo_distance_mask(p)
+            if m is not None:
+                return m
         if lhs.is_identifier and lhs.name not in self.seg.metadata.columns \
                 and self.is_mv_column(lhs.name) and \
                 p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
@@ -317,7 +321,9 @@ class SegmentEvaluator:
             if meta.encoding == Encoding.DICT and meta.single_value and \
                     p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
                 d = self.seg.dictionary(lhs.name)
-                lut = self._predicate_over_values(p, d.values)
+                lut = self._regex_indexed_lut(lhs.name, p, d.values)
+                if lut is None:
+                    lut = self._predicate_over_values(p, d.values)
                 m = self._indexed_mask(lhs.name, meta, p, np.nonzero(lut)[0])
                 if m is not None:
                     return m
@@ -387,6 +393,93 @@ class SegmentEvaluator:
             values = np.asarray(self.seg.values(col))[: self.n]
             idx = textindex.ScanTextIndex(values)
         return idx.match(p.value, self.n)
+
+    def _geo_distance_mask(self, p: Predicate):
+        """ST_DISTANCE(col, point) < r through the grid geo index
+        (H3IndexFilterOperator role): candidate docs from the cells
+        covering the query circle, exact haversine verify on candidates
+        only. None → shape doesn't fit / no index → generic expression
+        evaluation."""
+        e = p.lhs
+        if not (e.is_function and e.name == "st_distance" and len(e.args) == 2):
+            return None
+
+        def constant(x):
+            if x.is_literal:
+                return True
+            if x.is_function:
+                return all(constant(a) for a in x.args)
+            return False
+
+        col_arg = qpt_arg = None
+        for a, b in ((e.args[0], e.args[1]), (e.args[1], e.args[0])):
+            if a.is_identifier and constant(b):
+                col_arg, qpt_arg = a, b
+                break
+        if col_arg is None or col_arg.name not in self.seg.metadata.columns:
+            return None
+        idx = None
+        if hasattr(self.seg, "geo_index"):
+            try:
+                idx = self.seg.geo_index(col_arg.name)
+            except Exception:  # noqa: BLE001 — absent/corrupt index: scan
+                idx = None
+        if idx is None:
+            return None
+        from pinot_tpu.ops.geo import haversine_m, parse_points
+
+        qlon, qlat = parse_points(self.eval(qpt_arg))
+        if len(qlon) != 1 or not np.isfinite(qlon[0]):
+            return None
+        radius = float(p.upper)
+        cand = idx.candidate_docs(float(qlon[0]), float(qlat[0]), radius)
+        if cand is None:
+            # antimeridian/pole bbox: the grid can't promise a superset —
+            # fall back to the generic full-column evaluation
+            return None
+        cand = cand[cand < self.n]
+        mask = np.zeros(self.n, dtype=bool)
+        if len(cand) == 0:
+            return mask
+        self.entries_scanned_in_filter += len(cand)
+        vals = np.asarray(self.seg.values(col_arg.name))[cand]
+        lon, lat = parse_points(vals)
+        d = haversine_m(lon, lat, qlon[0], qlat[0])
+        ok = (d <= radius) if p.upper_inclusive else (d < radius)
+        if p.lower is not None:
+            lo = float(p.lower)
+            ok &= (d >= lo) if p.lower_inclusive else (d > lo)
+        mask[cand[ok]] = True
+        return mask
+
+    def _regex_indexed_lut(self, col: str, p: Predicate, values):
+        """Dict-id LUT for LIKE/REGEXP_LIKE through the trigram (FST-role)
+        index: intersected posting lists narrow the candidate entries, the
+        real pattern verifies survivors. None → no index / no narrowing →
+        caller evaluates every dictionary entry (O(C) regex evals, the
+        pre-index behavior)."""
+        if p.type not in (PredicateType.LIKE, PredicateType.REGEXP_LIKE):
+            return None
+        idx = None
+        if hasattr(self.seg, "fst_index"):
+            try:
+                idx = self.seg.fst_index(col)
+            except Exception:  # noqa: BLE001 — absent/corrupt index: scan
+                idx = None
+        if idx is None:
+            return None
+        pat = p.value if p.type is not PredicateType.LIKE \
+            else like_to_regex(p.value)
+        cand = idx.candidates(pat, len(values))
+        if cand is None:
+            return None
+        lut = np.zeros(len(values), dtype=bool)
+        if len(cand):
+            # one source of truth for LIKE/REGEXP semantics: evaluate the
+            # generic predicate over the candidate SUBSET
+            lut[cand] = self._predicate_over_values(
+                p, np.asarray(values)[cand])
+        return lut
 
     def _indexed_mask(self, col: str, meta, p: Predicate, ids: np.ndarray):
         """Index-served mask for a dict predicate whose matching dict ids are

@@ -87,6 +87,64 @@ def st_distance(a, b) -> np.ndarray:
     return haversine_m(lon1, lat1, lon2, lat2)
 
 
+def st_polygon(wkt) -> np.ndarray:
+    """ST_Polygon: validate + normalize a WKT POLYGON (reference
+    StPolygonFunction constructs the geometry; here geometries stay WKT)."""
+    s = _as_str_array(wkt)
+    for w in s:
+        parse_polygon(w)  # raises on malformed input
+    return s
+
+
+def st_area(poly_wkt) -> np.ndarray:
+    """Spherical polygon area in m² (StAreaFunction geography semantics):
+    the spherical excess via L'Huilier-free line-integral form."""
+    s = _as_str_array(poly_wkt)
+    out = np.zeros(len(s), dtype=np.float64)
+    for i, w in enumerate(s):
+        ring = parse_polygon(w)
+        lon = np.radians(ring[:, 0])
+        lat = np.radians(ring[:, 1])
+        if lon[0] != lon[-1] or lat[0] != lat[-1]:
+            lon = np.append(lon, lon[0])
+            lat = np.append(lat, lat[0])
+        # spherical excess line integral: sum (λ2-λ1)·(2+sinφ1+sinφ2)/2
+        area = np.sum(
+            (lon[1:] - lon[:-1])
+            * (2 + np.sin(lat[:-1]) + np.sin(lat[1:]))) / 2.0
+        out[i] = abs(area) * EARTH_RADIUS_M * EARTH_RADIUS_M
+    return out
+
+
+# ---- WKB (well-known binary) points ---------------------------------------
+# Reference: ST_GeomFromWKB / ST_AsBinary over JTS; here little-endian WKB
+# point encoding per the OGC spec (byte order 1, type 1, two f64s).
+
+import struct as _struct
+
+
+def st_as_binary(points) -> np.ndarray:
+    lon, lat = parse_points(points)
+    out = np.empty(len(lon), dtype=object)
+    for i in range(len(lon)):
+        out[i] = _struct.pack("<BIdd", 1, 1, lon[i], lat[i])
+    return out
+
+
+def st_geom_from_wkb(blobs) -> np.ndarray:
+    arr = np.atleast_1d(np.asarray(blobs, dtype=object))
+    lon = np.full(len(arr), np.nan)
+    lat = np.full(len(arr), np.nan)
+    for i, b in enumerate(arr):
+        if isinstance(b, (bytes, bytearray)) and len(b) >= 21:
+            (order,) = _struct.unpack_from("<B", b, 0)
+            fmt = "<" if order == 1 else ">"
+            (gtype,) = _struct.unpack_from(fmt + "I", b, 1)
+            if gtype == 1:
+                lon[i], lat[i] = _struct.unpack_from(fmt + "dd", b, 5)
+    return st_point(lon, lat)
+
+
 def _points_in_ring(ring: np.ndarray, lon: np.ndarray, lat: np.ndarray) -> np.ndarray:
     """Vectorized even-odd ray cast (planar lon/lat, like JTS contains on
     geometries): True where (lon, lat) falls inside the ring."""

@@ -306,6 +306,11 @@ _reg("st_within", lambda pt, p: _geo("st_within")(pt, p), min_args=2,
 _reg("st_geogfromtext", lambda w: _geo("st_geog_from_text")(w), min_args=1)
 _reg("st_geomfromtext", lambda w: _geo("st_geog_from_text")(w), min_args=1)
 _reg("st_astext", lambda g: _geo("st_as_text")(g), min_args=1)
+_reg("st_polygon", lambda w: _geo("st_polygon")(w), min_args=1)
+_reg("st_area", lambda p: _geo("st_area")(p), min_args=1)
+_reg("st_asbinary", lambda p: _geo("st_as_binary")(p), min_args=1)
+_reg("st_geomfromwkb", lambda b: _geo("st_geom_from_wkb")(b), min_args=1)
+_reg("st_geogfromwkb", lambda b: _geo("st_geom_from_wkb")(b), min_args=1)
 
 
 # ---- lookup join (host-only; evaluated by SegmentEvaluator._lookup with

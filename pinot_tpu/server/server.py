@@ -75,7 +75,7 @@ class ServerInstance:
                  sync_interval_s: float = 0.2, device_executor="auto",
                  max_concurrent_queries: int = 8, max_queued_queries: int = 32,
                  group_trim_size: int = 5000, scheduler_name: str = None,
-                 tls="auto"):
+                 tls="auto", tags=()):
         self.instance_id = instance_id
         self.registry = registry
         self.data_dir = data_dir
@@ -121,13 +121,15 @@ class ServerInstance:
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
         self.queries_served = 0
+        self.tags = tuple(tags)  # tier placement tags (Helix tag analog)
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> None:
         self.transport.start()
         self.registry.register_instance(
             InstanceInfo(self.instance_id, Role.SERVER,
-                         host=self.transport.host, grpc_port=self.transport.port)
+                         host=self.transport.host, grpc_port=self.transport.port,
+                         tags=list(self.tags))
         )
         self._sync_once()  # load assigned segments before serving
         self._sync_thread = threading.Thread(

@@ -273,6 +273,27 @@ class SegmentCreator:
             build_text_index(raw, p(f"{name}.textidx"))
             has_text_index = True
 
+        has_fst_index = False
+        if name in getattr(idx_cfg, "fst_index_columns", ()):
+            if encoding != Encoding.DICT or dict_values is None:
+                raise ValueError(
+                    f"fst index requires a dictionary column, got {name}")
+            from pinot_tpu.storage.fstindex import TrigramIndex
+
+            TrigramIndex.build(dict_values).save(out_dir, name)
+            has_fst_index = True
+
+        has_h3_index = False
+        if name in getattr(idx_cfg, "h3_index_columns", ()):
+            if not (spec.single_value and spec.data_type.is_string_like):
+                raise ValueError(
+                    f"geo (h3-role) index requires a single-value STRING "
+                    f"POINT column, got {name}")
+            from pinot_tpu.storage.geoindex import GeoGridIndex
+
+            GeoGridIndex.build(raw).save(out_dir, name)
+            has_h3_index = True
+
         # Range acceleration: DICT columns get it for free — the sorted
         # dictionary maps a value range to a dict-id interval. RAW SV
         # columns get a sorted-projection range index (RangeIndexCreator /
@@ -310,6 +331,8 @@ class SegmentCreator:
             has_bloom=has_bloom,
             has_json_index=has_json_index,
             has_text_index=has_text_index,
+            has_fst_index=has_fst_index,
+            has_h3_index=has_h3_index,
             packed_bits=packed_bits,
             compression=compression,
             total_number_of_entries=int(total_entries),

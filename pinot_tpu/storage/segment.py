@@ -68,6 +68,8 @@ class ColumnMetadata:
     has_bloom: bool = False
     has_json_index: bool = False
     has_text_index: bool = False
+    has_fst_index: bool = False
+    has_h3_index: bool = False
     has_null_vector: bool = False
     packed_bits: Optional[int] = None  # bit-packed fwd index width, else None
     compression: Optional[str] = None  # raw fwd chunk codec (zlib|zstd|lz4)
@@ -275,6 +277,34 @@ class ImmutableSegment:
                 self._text_cache[col] = TextIndexReader(
                     self._path(f"{col}.textidx.npz"))
         return self._text_cache[col]
+
+    def fst_index(self, col: str):
+        """Trigram regex-acceleration index (LuceneFSTIndexReader role), or
+        None."""
+        if not hasattr(self, "_fst_cache"):
+            self._fst_cache = {}
+        if col not in self._fst_cache:
+            if not getattr(self.column_metadata(col), "has_fst_index", False):
+                self._fst_cache[col] = None
+            else:
+                from pinot_tpu.storage.fstindex import TrigramIndex
+
+                self._fst_cache[col] = TrigramIndex.load(self.dir, col)
+        return self._fst_cache[col]
+
+    def geo_index(self, col: str):
+        """Grid-cell geospatial index (ImmutableH3IndexReader role), or
+        None."""
+        if not hasattr(self, "_geo_cache"):
+            self._geo_cache = {}
+        if col not in self._geo_cache:
+            if not getattr(self.column_metadata(col), "has_h3_index", False):
+                self._geo_cache[col] = None
+            else:
+                from pinot_tpu.storage.geoindex import GeoGridIndex
+
+                self._geo_cache[col] = GeoGridIndex.load(self.dir, col)
+        return self._geo_cache[col]
 
     def null_vector(self, col: str) -> Optional[np.ndarray]:
         """Per-doc null bitmap, or None when the column has no nulls

@@ -1,0 +1,81 @@
+"""DataFrame connectors (spark read / flink sink roles)."""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.connectors import query_df, read_table, write_table
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    server = ServerInstance("s0", registry, str(tmp_path / "srv"),
+                            device_executor=None)
+    server.start()
+    broker = Broker(registry)
+    yield registry, controller, broker
+    broker.close()
+    server.stop()
+
+
+def _wait_count(broker, table, want, timeout=12):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = broker.execute(f"SELECT COUNT(*) FROM {table}")
+        if not r.get("exceptions") and r["resultTable"]["rows"][0][0] == want:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def test_write_then_read_roundtrip(cluster):
+    registry, controller, broker = cluster
+    schema = Schema.build(name="sales",
+                          dimensions=[("region", DataType.STRING)],
+                          metrics=[("amt", DataType.LONG)])
+    controller.add_table(TableConfig(table_name="sales"), schema)
+    rng = np.random.default_rng(6)
+    df = pd.DataFrame({
+        "region": np.array(["na", "eu", "ap"])[rng.integers(0, 3, 25_000)],
+        "amt": rng.integers(0, 1000, 25_000).astype(np.int64),
+    })
+    names = write_table(df, schema, "sales", controller, segment_rows=10_000)
+    assert len(names) == 3  # 25k rows / 10k per segment
+    assert _wait_count(broker, "sales", 25_000)
+
+    # aggregate query → DataFrame
+    g = query_df(broker, "SELECT region, SUM(amt) FROM sales "
+                         "GROUP BY region ORDER BY region")
+    want = df.groupby("region").amt.sum()
+    assert list(g.iloc[:, 0]) == ["ap", "eu", "na"]
+    for _, row in g.iterrows():
+        assert row.iloc[1] == float(want[row.iloc[0]])
+
+    # paged full-table read returns every row
+    back = read_table(broker, "sales", batch_rows=7_000)
+    assert len(back) == 25_000
+    assert back["amt"].sum() == df["amt"].sum()
+    assert sorted(back["region"].unique()) == ["ap", "eu", "na"]
+
+    # filtered + projected read
+    na = read_table(broker, "sales", columns=["amt"],
+                    where="region = 'na'", batch_rows=9_999)
+    assert len(na) == int((df.region == "na").sum())
+    assert na["amt"].sum() == int(df[df.region == "na"].amt.sum())
+
+
+def test_query_df_error_surfaces(cluster):
+    registry, controller, broker = cluster
+    with pytest.raises(RuntimeError, match="query failed"):
+        query_df(broker, "SELECT * FROM does_not_exist")

@@ -1,0 +1,182 @@
+"""Controller long-tail: tier relocation, config recommender, table tuner.
+
+Reference analogs: relocation/SegmentRelocator.java,
+recommender/RecommenderDriver.java, tuner/TableConfigTuner.java.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry, Role
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+
+def wait_until(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestTierRelocation:
+    def test_aged_segments_move_to_tagged_servers(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        hot = ServerInstance("hot_0", registry, str(tmp_path / "hot"),
+                             device_executor=None)
+        cold = ServerInstance("cold_0", registry, str(tmp_path / "cold"),
+                              device_executor=None, tags=["cold_tier"])
+        hot.start()
+        cold.start()
+        broker = Broker(registry)
+        try:
+            schema = Schema.build(name="t",
+                                  dimensions=[("k", DataType.STRING)],
+                                  metrics=[("v", DataType.INT)])
+            day_ms = 86_400_000
+            cfg = TableConfig(table_name="t", tiers=[
+                {"name": "cold", "segment_age_ms": 7 * day_ms,
+                 "server_tag": "cold_tier"}])
+            controller.add_table(cfg, schema)
+            d = str(tmp_path / "seg")
+            build_segment(schema, {"k": np.array(["a", "b"] * 100),
+                                   "v": np.arange(200, dtype=np.int32)},
+                          d, cfg, "t_old")
+            controller.upload_segment("t", d)
+            d2 = str(tmp_path / "seg2")
+            build_segment(schema, {"k": np.array(["c"] * 100),
+                                   "v": np.arange(100, dtype=np.int32)},
+                          d2, cfg, "t_new")
+            controller.upload_segment("t", d2)
+
+            # nothing is old enough yet: no movement
+            assert controller.run_segment_relocation() == {}
+
+            # age t_old past the tier threshold
+            def age(s):
+                recs = registry.segments("t_OFFLINE")
+                recs["t_old"].push_time_ms -= 8 * day_ms
+                registry.add_segment(recs["t_old"],
+                                     registry.assignment("t_OFFLINE")["t_old"])
+
+            age(registry)
+            moved = controller.run_segment_relocation()
+            assert moved["t_OFFLINE"]["t_old"]["to"] == ["cold_0"]
+            assert moved["t_OFFLINE"]["t_old"]["tier"] == "cold"
+            # servers reconcile: cold serves t_old, hot unloads it
+            assert wait_until(
+                lambda: "t_old" in cold.engine.tables.get(
+                    "t_OFFLINE", type("e", (), {"segments": {}})).segments)
+            assert wait_until(
+                lambda: "t_old" not in hot.engine.tables.get(
+                    "t_OFFLINE", type("e", (), {"segments": {}})).segments)
+            # queries still see every row across tiers
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                r = broker.execute("SELECT COUNT(*) FROM t")
+                if not r.get("exceptions") and \
+                        r["resultTable"]["rows"][0][0] == 300:
+                    break
+                time.sleep(0.1)
+            assert r["resultTable"]["rows"][0][0] == 300, r
+            # idempotent: second run moves nothing
+            assert controller.run_segment_relocation() == {}
+        finally:
+            broker.close()
+            hot.stop()
+            cold.stop()
+
+
+class TestRecommender:
+    def test_workload_driven_recommendation(self):
+        registry = ClusterRegistry()
+        schema = Schema.build(
+            name="ads",
+            dimensions=[("city", DataType.STRING), ("tier", DataType.STRING),
+                        ("url", DataType.STRING)],
+            metrics=[("clicks", DataType.LONG), ("cost", DataType.DOUBLE)],
+        )
+        queries = [
+            "SELECT SUM(clicks) FROM ads WHERE city = 'nyc'",
+            "SELECT COUNT(*) FROM ads WHERE city IN ('sf', 'la')",
+            "SELECT SUM(cost) FROM ads WHERE clicks BETWEEN 10 AND 90",
+            "SELECT COUNT(*) FROM ads WHERE clicks > 5 AND city = 'mia'",
+            "SELECT city, tier, SUM(clicks), COUNT(*) FROM ads "
+            "GROUP BY city, tier",
+            "SELECT tier, city, COUNT(*), SUM(clicks) FROM ads "
+            "GROUP BY tier, city",
+            "SELECT COUNT(*) FROM ads WHERE REGEXP_LIKE(url, 'checkout')",
+        ]
+        from pinot_tpu.controller.controller import Controller
+        import tempfile
+
+        controller = Controller(registry, tempfile.mkdtemp())
+        rec = controller.recommend_config(schema, queries, qps=200)
+        idx = rec["indexing"]
+        assert "city" in idx.inverted_index_columns
+        assert rec["sorted_column"] == "city"  # most-filtered dimension
+        assert "clicks" in idx.range_index_columns
+        assert "url" in idx.fst_index_columns
+        assert len(idx.star_tree_configs) == 1
+        st = idx.star_tree_configs[0]
+        assert sorted(st.dimensions_split_order) == ["city", "tier"]
+        assert "SUM__clicks" in st.function_column_pairs
+        assert rec["rationale"]  # human-readable reasons present
+
+    def test_unparsable_queries_skipped(self):
+        import tempfile
+
+        registry = ClusterRegistry()
+        controller = Controller(registry, tempfile.mkdtemp())
+        schema = Schema.build(name="t", dimensions=[("k", DataType.STRING)],
+                              metrics=[("v", DataType.INT)])
+        rec = controller.recommend_config(schema, ["NOT SQL AT ALL"], qps=10)
+        assert rec["indexing"].inverted_index_columns == []
+
+
+class TestTuner:
+    def test_tuner_grows_config_from_segment_stats(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("s0", registry, str(tmp_path / "srv"),
+                                device_executor=None)
+        server.start()
+        self._run(tmp_path, registry, controller, server)
+
+    def _run(self, tmp_path, registry, controller, server):
+        schema = Schema.build(
+            name="t",
+            dimensions=[("low", DataType.STRING), ("high", DataType.STRING)],
+            metrics=[("v", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="t")
+        controller.add_table(cfg, schema)
+        n = 5000
+        rng = np.random.default_rng(3)
+        d = str(tmp_path / "seg")
+        build_segment(schema, {
+            "low": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+            "high": np.asarray([f"id_{i}" for i in range(n)]),
+            "v": rng.integers(0, 10, n).astype(np.int32)}, d, cfg, "s0")
+        controller.upload_segment("t", d)
+        out = controller.tune_table("t")
+        assert "low" in out["indexing"].inverted_index_columns
+        assert "high" in out["indexing"].bloom_filter_columns
+        assert out["changes"]
+        # persisted: registry carries the tuned config
+        stored = registry.table_config("t_OFFLINE")
+        assert "low" in stored.indexing.inverted_index_columns
+        # idempotent second run
+        again = controller.tune_table("t")
+        assert again["changes"] == []
+        server.stop()
