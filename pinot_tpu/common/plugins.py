@@ -89,6 +89,9 @@ class PluginRegistry:
 
         self.register("fs", "s3", _s3fs.S3FS)  # gated on boto3 at init
         self.register("fs", "gs", _gcsfs.GcsFS)  # gated on google-cloud
+        from pinot_tpu.storage import adlsfs as _adlsfs
+
+        self.register("fs", "abfss", _adlsfs.AdlsFS)  # gated on azure sdk
         for name, cls in _stream._FACTORIES.items():
             self.register("stream", name, cls)
         for name, fn in _stream._DECODERS.items():

@@ -27,6 +27,9 @@ class StarTreeIndexConfig:
     function_column_pairs: list[str]  # e.g. ["SUM__revenue", "COUNT__*"]
     max_leaf_records: int = 10_000
     skip_star_node_creation: list[str] = dataclasses.field(default_factory=list)
+    # PERCENTILETDIGEST__col pairs: digest compression the cube is built
+    # with (queries at a different compression fall back to the scan path)
+    tdigest_compression: float = 100.0
 
 
 @dataclasses.dataclass

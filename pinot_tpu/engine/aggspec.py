@@ -485,6 +485,36 @@ class PercentileTDigestSpec(PercentileSpec):
     compression = 100.0
 
 
+class TDigestMergeSpec(PercentileSpec):
+    """TDIGESTMERGE(state_col, p, compression): re-merge pre-aggregated
+    t-digest blobs (one serialized digest per cube row) into the same
+    canonical {"means","weights"} partial the percentile family produces —
+    the star-tree execution rewrite of PERCENTILE/PERCENTILETDIGEST over
+    the cube's digest column (reference PercentileTDigestValueAggregator,
+    pinot-segment-local/.../aggregator/)."""
+
+    name = "tdigestmerge"
+
+    def host_groups(self, arg_values, group_idx, n):
+        means = _obj_array(n, list)
+        weights = _obj_array(n, list)
+        digests: dict = {}
+        for g, blob in zip(np.asarray(group_idx).tolist(),
+                           np.asarray(arg_values[0]).tolist()):
+            m2, w2 = qd.digest_from_bytes(blob)
+            if not len(m2):
+                continue
+            if g in digests:
+                m1, w1 = digests[g]
+                digests[g] = qd.merge(m1, w1, m2, w2, self.compression)
+            else:
+                digests[g] = (m2, w2)
+        for g, (m, w) in digests.items():
+            means[g] = np.asarray(m).tolist()
+            weights[g] = np.asarray(w).tolist()
+        return {"means": means, "weights": weights}
+
+
 class ModeSpec(AggSpec):
     name = "mode"
 
@@ -850,6 +880,7 @@ _SPECS = {
     "segmentpartitioneddistinctcount": DistinctCountSpec,
     "distinctcounthll": DistinctCountHLLSpec,
     "hllmerge": HllMergeSpec,
+    "tdigestmerge": TDigestMergeSpec,
     "distinctcountthetasketch": DistinctCountThetaSketchSpec,
     "distinctcountrawthetasketch": DistinctCountThetaSketchSpec,
     "percentile": PercentileSpec,

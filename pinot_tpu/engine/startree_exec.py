@@ -25,7 +25,8 @@ from pinot_tpu.query.context import Expression, QueryContext
 from pinot_tpu.storage.startree import load_star_trees, pair_column, parse_pair
 
 _REWRITABLE = {"count", "sum", "min", "max", "avg", "minmaxrange",
-               "distinctcounthll"}
+               "distinctcounthll", "percentiletdigest", "percentile",
+               "percentileest"}
 
 
 def _q2_expr(fn: str, col: str, meta: dict) -> Expression:
@@ -36,6 +37,14 @@ def _q2_expr(fn: str, col: str, meta: dict) -> Expression:
         return Expression.function(
             "hllmerge", Expression.identifier(col),
             Expression.literal(int(meta["hll_log2m"])),
+        )
+    if fn == "tdigestmerge":
+        # p is irrelevant at merge time (the ORIGINAL agg finalizes);
+        # compression governs re-merge compaction
+        return Expression.function(
+            "tdigestmerge", Expression.identifier(col),
+            Expression.literal(0.5),
+            Expression.literal(float(meta["tdigest_compression"])),
         )
     return Expression.function(fn, Expression.identifier(col))
 
@@ -107,6 +116,21 @@ def fit(q: QueryContext, meta: dict) -> Optional[list]:
                 return None
             mapping.append(
                 [("hllmerge", pair_column("distinctcounthll", col), "state")])
+            continue
+        if name in ("percentiletdigest", "percentile", "percentileest"):
+            # digest pair: cube rows carry serialized t-digests, re-merged
+            # by TDIGESTMERGE — only when the digest compression matches
+            # the query's (a mismatch would silently change the error
+            # bound). All three names share the digest algebra here.
+            from pinot_tpu.engine.aggspec import make_spec
+
+            if ("percentiletdigest", col) not in pairs:
+                return None
+            if meta.get("tdigest_compression") != make_spec(a).compression:
+                return None
+            mapping.append(
+                [("tdigestmerge", pair_column("percentiletdigest", col),
+                  "state")])
             continue
         need = {
             "sum": [("sum", col, "sum")],

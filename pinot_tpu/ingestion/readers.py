@@ -132,12 +132,30 @@ class AvroRecordReader(RecordReader):
         return read_container(path)
 
 
+class ProtobufRecordReader(RecordReader):
+    """Length-delimited protobuf files (pinot-protobuf analog), gated on
+    the google.protobuf runtime. Props: ``descriptor_file`` (compiled
+    FileDescriptorSet from protoc --descriptor_set_out) and
+    ``message_name``."""
+
+    def read_rows(self, path: str) -> list:
+        from pinot_tpu.ingestion.protobuf_io import read_delimited
+
+        desc = self.props.get("descriptor_file", "")
+        msg = self.props.get("message_name", "")
+        if not desc or not msg:
+            raise ValueError(
+                "protobuf input needs descriptor_file + message_name props")
+        return read_delimited(path, desc, msg)
+
+
 _READERS = {
     "csv": CSVRecordReader,
     "json": JSONRecordReader,
     "parquet": ParquetRecordReader,
     "orc": ORCRecordReader,
     "avro": AvroRecordReader,
+    "protobuf": ProtobufRecordReader,
 }
 
 

@@ -107,3 +107,26 @@ def quantile(means, weights, q: float) -> float:
     c0, c1 = centers[j - 1], centers[j]
     t = 0.0 if c1 == c0 else (target - c0) / (c1 - c0)
     return float(m[j - 1] + t * (m[j] - m[j - 1]))
+
+
+# ---- binary ser/de (ObjectSerDeUtils TDigest blob role) -------------------
+# Layout: uint32 centroid count, then count f64 means, then count f64
+# weights, all little-endian. Trailing padding bytes (fixed-width BYTES
+# column storage) are ignored thanks to the count header.
+
+
+def digest_to_bytes(means, weights) -> bytes:
+    m = np.asarray(means, dtype=np.float64)
+    w = np.asarray(weights, dtype=np.float64)
+    head = np.asarray([len(m)], dtype=np.uint32)
+    return head.tobytes() + m.tobytes() + w.tobytes()
+
+
+def digest_from_bytes(blob) -> tuple:
+    b = bytes(blob)
+    if len(b) < 4:
+        return np.empty(0), np.empty(0)
+    n = int(np.frombuffer(b[:4], dtype=np.uint32)[0])
+    m = np.frombuffer(b[4: 4 + 8 * n], dtype=np.float64)
+    w = np.frombuffer(b[4 + 8 * n: 4 + 16 * n], dtype=np.float64)
+    return m.copy(), w.copy()

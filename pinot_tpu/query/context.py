@@ -135,8 +135,9 @@ AGGREGATION_FUNCTIONS = frozenset(
         "percentilemv",
         "percentileestmv",
         "percentiletdigestmv",
-        # internal: star-tree sketch-state re-merge (engine/startree_exec.py)
+        # internal: star-tree sketch-state re-merges (engine/startree_exec.py)
         "hllmerge",
+        "tdigestmerge",
     }
 )
 

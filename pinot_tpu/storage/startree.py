@@ -35,7 +35,8 @@ META_FILE = "startree_meta.json"
 # function-column pair name separator (reference: AggregationFunctionColumnPair)
 SEP = "__"
 
-SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max", "distinctcounthll"}
+SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max", "distinctcounthll",
+                       "percentiletdigest"}
 
 
 def parse_pair(pair: str):
@@ -77,6 +78,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
             meta = segment.column_metadata(d)
             dim_specs.append((d, meta.data_type))
         hll_log2m = None
+        tdigest_compression = None
         for fn, col in pairs:
             name = pair_column(fn, col)
             if fn == "count":
@@ -100,6 +102,39 @@ def build_star_trees(segment, star_tree_configs) -> None:
                 m = 1 << hll_log2m
                 acc = np.ascontiguousarray(
                     regs.astype(np.uint8)).view(f"S{m}").reshape(n_groups)
+                metric_specs.append((name, DataType.BYTES))
+            elif fn == "percentiletdigest":
+                # digest pre-aggregation (PercentileTDigestValueAggregator):
+                # one serialized t-digest per cube row, re-merged at query
+                # time by TDIGESTMERGE. Pre-agg digests are approximate
+                # like the reference's — cube and scan answers agree within
+                # the digest's rank-error bound, not bit-exactly.
+                from pinot_tpu.ops import quantile_digest as qd
+
+                tdigest_compression = float(cfg.tdigest_compression)
+                if tdigest_compression <= 0:
+                    raise ValueError(
+                        f"tdigest_compression must be > 0, got "
+                        f"{cfg.tdigest_compression}")
+                v = np.asarray(segment.values(col), dtype=np.float64)
+                per_group = {}
+                if len(v):
+                    order = np.argsort(ginv, kind="stable")
+                    gs = np.asarray(ginv)[order]
+                    vs = v[order]
+                    bounds = np.flatnonzero(np.diff(gs)) + 1
+                    starts = np.concatenate([[0], bounds])
+                    ends = np.concatenate([bounds, [len(gs)]])
+                    for s, e in zip(starts, ends):
+                        m, w = qd.add_values([], [], vs[s:e],
+                                             tdigest_compression)
+                        per_group[int(gs[s])] = qd.digest_to_bytes(m, w)
+                empty = qd.digest_to_bytes([], [])
+                blobs = [per_group.get(g, empty) for g in range(n_groups)]
+                width = max((len(b) for b in blobs), default=len(empty))
+                acc = np.asarray(
+                    [b.ljust(width, b"\x00") for b in blobs],
+                    dtype=f"S{width}")
                 metric_specs.append((name, DataType.BYTES))
             else:
                 v = np.asarray(segment.values(col), dtype=np.float64)
@@ -132,6 +167,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
                     "function_column_pairs": list(cfg.function_column_pairs),
                     "max_leaf_records": cfg.max_leaf_records,
                     "hll_log2m": hll_log2m,
+                    "tdigest_compression": tdigest_compression,
                 },
                 f,
             )

@@ -132,6 +132,17 @@ def get_decoder(name: str, stream_config: StreamConfig) -> Callable:
                 "avro decoder needs the writer schema in stream "
                 "properties['avro.schema']")
         return binary_decoder_for(schema_json)
+    if name == "protobuf":
+        # one serialized message per payload (ProtoBufMessageDecoder)
+        from pinot_tpu.ingestion.protobuf_io import binary_decoder_for
+
+        desc = stream_config.properties.get("protobuf.descriptor_file", "")
+        msg = stream_config.properties.get("protobuf.message_name", "")
+        if not desc or not msg:
+            raise KeyError(
+                "protobuf decoder needs stream properties "
+                "'protobuf.descriptor_file' + 'protobuf.message_name'")
+        return binary_decoder_for(desc, msg)
     try:
         return _DECODERS[name]
     except KeyError:

@@ -1,0 +1,134 @@
+"""ADLS (abfss) PinotFS plugin against a faked azure-storage-blob
+(pinot-adls analog): segment lifecycle + gating error without the SDK."""
+
+import sys
+import types
+
+import pytest
+
+_STORE: dict = {}  # (container, name) -> bytes
+
+
+class _FakeDownload:
+    def __init__(self, data):
+        self._data = data
+
+    def readall(self):
+        return self._data
+
+
+class _FakeBlobClient:
+    def __init__(self, container, name):
+        self.container = container
+        self.name = name
+
+    @property
+    def url(self):
+        return f"https://fake/{self.container}/{self.name}"
+
+
+class _FakeNotFound(Exception):
+    pass
+
+
+_FakeNotFound.__name__ = "ResourceNotFoundError"
+
+
+class _FakeContainerClient:
+    def __init__(self, name):
+        self.name = name
+
+    def list_blobs(self, name_starts_with=""):
+        return [types.SimpleNamespace(name=n)
+                for (c, n) in sorted(_STORE)
+                if c == self.name and n.startswith(name_starts_with)]
+
+    def upload_blob(self, key, f, overwrite=False):
+        _STORE[(self.name, key)] = f.read()
+
+    def download_blob(self, key):
+        return _FakeDownload(_STORE[(self.name, key)])
+
+    def delete_blob(self, key):
+        if (self.name, key) not in _STORE:
+            raise _FakeNotFound(f"404 {key}")
+        del _STORE[(self.name, key)]
+
+    def get_blob_client(self, key):
+        bc = _FakeBlobClient(self.name, key)
+        container = self
+
+        def start_copy(url):
+            src_c, src_k = url.removeprefix("https://fake/").split("/", 1)
+            _STORE[(container.name, key)] = _STORE[(src_c, src_k)]
+
+        bc.start_copy_from_url = start_copy
+        return bc
+
+
+class _FakeService:
+    @classmethod
+    def from_connection_string(cls, conn):
+        return cls()
+
+    def get_container_client(self, name):
+        return _FakeContainerClient(name)
+
+
+@pytest.fixture()
+def fake_azure(monkeypatch):
+    blob_mod = types.ModuleType("azure.storage.blob")
+    blob_mod.BlobServiceClient = _FakeService
+    storage_mod = types.ModuleType("azure.storage")
+    storage_mod.blob = blob_mod
+    azure_mod = types.ModuleType("azure")
+    azure_mod.storage = storage_mod
+    monkeypatch.setitem(sys.modules, "azure", azure_mod)
+    monkeypatch.setitem(sys.modules, "azure.storage", storage_mod)
+    monkeypatch.setitem(sys.modules, "azure.storage.blob", blob_mod)
+    _STORE.clear()
+    yield
+    _STORE.clear()
+
+
+class TestAdlsFS:
+    def test_gating_error_without_sdk(self, monkeypatch):
+        monkeypatch.setitem(sys.modules, "azure", None)
+        monkeypatch.setitem(sys.modules, "azure.storage", None)
+        from pinot_tpu.storage.adlsfs import AdlsFS
+
+        with pytest.raises(RuntimeError, match="azure-storage-blob"):
+            AdlsFS()
+
+    def test_scheme_registered(self, fake_azure):
+        from pinot_tpu.storage.fs import create_fs
+
+        assert type(create_fs("abfss://cont/x")).__name__ == "AdlsFS"
+
+    def test_segment_lifecycle_and_sibling_isolation(self, fake_azure, tmp_path):
+        from pinot_tpu.storage.adlsfs import AdlsFS
+
+        a = tmp_path / "seg_1"
+        b = tmp_path / "seg_10"
+        (a / "sub").mkdir(parents=True)
+        b.mkdir()
+        (a / "m.json").write_text("{}")
+        (a / "sub" / "x.bin").write_bytes(b"X")
+        (b / "b.bin").write_bytes(b"B")
+
+        fs = AdlsFS()
+        fs.copy(str(a), "abfss://cont/t/seg_1")
+        fs.copy(str(b), "abfss://cont/t/seg_10")
+        assert fs.list_files("abfss://cont/t") == ["seg_1", "seg_10"]
+
+        d = tmp_path / "dl"
+        fs.copy("abfss://cont/t/seg_1", str(d))
+        assert (d / "m.json").read_text() == "{}"
+        assert (d / "sub" / "x.bin").read_bytes() == b"X"
+
+        # remote copy + delete; sibling prefix (seg_1 vs seg_10) untouched
+        fs.copy("abfss://cont/t/seg_1", "abfss://cont/t2/seg_1")
+        assert fs.exists("abfss://cont/t2/seg_1")
+        fs.delete("abfss://cont/t/seg_1")
+        assert not fs.exists("abfss://cont/t/seg_1")
+        assert fs.exists("abfss://cont/t/seg_10")

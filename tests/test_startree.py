@@ -42,6 +42,7 @@ def engines(tmp_path_factory):
         function_column_pairs=[
             "SUM__revenue", "COUNT__*", "MIN__revenue", "MAX__revenue",
             "SUM__quantity", "DISTINCTCOUNTHLL__quantity",
+            "PERCENTILETDIGEST__revenue",
         ],
     )
     cfg = TableConfig(
@@ -134,6 +135,36 @@ def test_unfit_queries_fall_through(engines):
     assert opt["resultTable"]["rows"] == plain_engine.execute(
         "SELECT SUM(revenue) FROM ssb WHERE d_region = 'ASIA'"
     )["resultTable"]["rows"]
+
+
+def test_tdigest_pre_aggregation(engines):
+    """Digest pair: cube answers within the documented rank-error bound of
+    the scan path (pre-agg digests are approximate like the reference's —
+    NOT bit-identical), and the cube is actually consulted."""
+    st_engine, plain_engine, cols = engines
+    sql = ("SELECT d_year, PERCENTILETDIGEST(revenue, 90) FROM ssb "
+           "GROUP BY d_year ORDER BY d_year")
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert not a.get("exceptions"), a
+    assert a["numDocsScanned"] < b["numDocsScanned"] / 3, (
+        a["numDocsScanned"], b["numDocsScanned"])
+    spread = float(cols["revenue"].max() - cols["revenue"].min())
+    for ra, rb in zip(a["resultTable"]["rows"], b["resultTable"]["rows"]):
+        assert ra[0] == rb[0]
+        # both are digest approximations of the same data: within ~2% of
+        # the value spread of each other (rank error ~1.5/delta each side)
+        assert abs(ra[1] - rb[1]) < 0.02 * spread, (ra, rb)
+
+
+def test_tdigest_compression_mismatch_falls_through(engines):
+    st_engine, plain_engine, _ = engines
+    sql = "SELECT PERCENTILETDIGEST(revenue, 50, 400) FROM ssb"
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert not a.get("exceptions"), a
+    assert a["numDocsScanned"] == b["numDocsScanned"]  # scan on both
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
 
 
 def test_hll_pre_aggregation_used(engines):
