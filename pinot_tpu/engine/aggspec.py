@@ -834,6 +834,15 @@ class PercentileTDigestMVSpec(_MVEntrySpec, PercentileTDigestSpec):
     sv_base = PercentileTDigestSpec
 
 
+class RawDigestPercentileMVSpec(_MVEntrySpec, RawDigestPercentileSpec):
+    """PERCENTILERAWEST_MV / PERCENTILERAWTDIGEST_MV: serialized digest
+    over MV entry values (the last two names of the reference's
+    AggregationFunctionType enum missing here)."""
+
+    name = "percentilerawtdigestmv"
+    sv_base = RawDigestPercentileSpec
+
+
 class RawHLLMVSpec(_MVEntrySpec, RawHLLSpec):
     name = "distinctcountrawhllmv"
     sv_base = RawHLLSpec
@@ -910,6 +919,8 @@ _SPECS = {
     "percentilemv": PercentileMVSpec,
     "percentileestmv": PercentileMVSpec,
     "percentiletdigestmv": PercentileTDigestMVSpec,
+    "percentilerawestmv": RawDigestPercentileMVSpec,
+    "percentilerawtdigestmv": RawDigestPercentileMVSpec,
 }
 
 

@@ -135,6 +135,8 @@ AGGREGATION_FUNCTIONS = frozenset(
         "percentilemv",
         "percentileestmv",
         "percentiletdigestmv",
+        "percentilerawestmv",
+        "percentilerawtdigestmv",
         # internal: star-tree sketch-state re-merges (engine/startree_exec.py)
         "hllmerge",
         "tdigestmerge",

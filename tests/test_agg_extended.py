@@ -92,6 +92,19 @@ class TestExtendedAggs:
         d = json.loads(base64.b64decode(got[0][0]))
         assert d["means"] and d["weights"]
 
+    def test_raw_tdigest_mv_blob(self, engine):
+        """PERCENTILERAWEST_MV / PERCENTILERAWTDIGEST_MV — the last two
+        reference AggregationFunctionType enum names: serialized digest
+        over MV entry values."""
+        eng, cols = engine
+        for fn in ("PERCENTILERAWTDIGESTMV", "PERCENTILERAWESTMV"):
+            got = rows(eng, f"SELECT {fn}(scores, 50) FROM t")
+            d = json.loads(base64.b64decode(got[0][0]))
+            assert d["means"] and d["weights"]
+            # digest totals count every MV ENTRY, not every doc
+            n_entries = sum(len(r) for r in cols["scores"])
+            assert abs(sum(d["weights"]) - n_entries) < 1e-6
+
     def test_st_union_multipoint(self, engine):
         eng, _ = engine
         got = rows(eng, "SELECT STUNION(ST_POINT(lon, lat)) FROM t "
