@@ -204,6 +204,7 @@ class Broker:
         tracer = None
         try:
             q = optimize_query(compile_query(sql))
+            q = self._resolve_table_case(q)
             if q.explain:
                 from pinot_tpu.engine.explain import explain_plan
 
@@ -237,6 +238,26 @@ class Broker:
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
         self.metrics.time_ms("query", resp["timeUsedMs"])
         return resp
+
+    def _resolve_table_case(self, q: QueryContext) -> QueryContext:
+        """Case-insensitive table resolution against the registry
+        (BaseBrokerRequestHandler.java:245-254 / TableCache's
+        ignore-case lookup): FROM mytable matches a registered MyTable.
+        Exact matches win; ambiguous case-folds keep the literal name."""
+        raw = q.table_name
+        names = set(self.registry.tables())
+        candidates = {raw, f"{raw}_OFFLINE", f"{raw}_REALTIME"}
+        if candidates & names:
+            return q
+        low = raw.lower()
+        # physical-name fold first (FROM sAlEs_OFFLINE → sales_OFFLINE),
+        # then the base-name fold (FROM SALES → sales)
+        physical = {n for n in names if n.lower() == low}
+        base = {QueryQuotaManager._base_name(n) for n in names}
+        matches = physical or {b for b in base if b.lower() == low}
+        if len(matches) != 1:
+            return q
+        return dataclasses.replace(q, table_name=matches.pop())
 
     def _expand_star(self, q: QueryContext) -> QueryContext:
         """SELECT * resolves against the registry schema (looked up via the

@@ -93,6 +93,12 @@ class TestOfflineCluster:
         assert r["numServersResponded"] >= 1
         # every segment counted exactly once despite replication
         assert r["numSegmentsQueried"] == 4
+        # case-insensitive table resolution at the broker
+        # (BaseBrokerRequestHandler.java:245-254 / TableCache ignore-case)
+        for variant in ("SALES", "Sales", "sAlEs_OFFLINE"):
+            r2 = broker.execute(f"SELECT COUNT(*) FROM {variant}")
+            assert not r2["exceptions"], (variant, r2)
+            assert r2["resultTable"]["rows"][0][0] == 8000
 
     def test_group_by_through_broker(self, cluster, tmp_path):
         registry, controller, servers, broker = cluster
