@@ -213,10 +213,13 @@ SSB_QUERIES = {
     ),
     # 4. NYC-taxi shape: high-cardinality group-by + HLL (cube-eligible:
     # the lo_suppkey star-tree pre-aggregates COUNT/SUM/HLL planes)
+    # lo_suppkey tiebreaker: groups tied on COUNT(*) at the LIMIT boundary
+    # must order identically on the cube and scan plans or the exactness
+    # gate below flakes on tied data
     "q4_highcard_hll": (
         "SELECT lo_suppkey, COUNT(*), AVG(lo_quantity), "
         "DISTINCTCOUNTHLL(lo_custkey) FROM lineorder "
-        "GROUP BY lo_suppkey ORDER BY COUNT(*) DESC LIMIT 10"
+        "GROUP BY lo_suppkey ORDER BY COUNT(*) DESC, lo_suppkey LIMIT 10"
     ),
     # 4b. the same shape forced onto the raw scan path (regression guard for
     # the non-pre-aggregated frontier)
@@ -224,7 +227,7 @@ SSB_QUERIES = {
         "SET useStarTree = false; "
         "SELECT lo_suppkey, COUNT(*), AVG(lo_quantity), "
         "DISTINCTCOUNTHLL(lo_custkey) FROM lineorder "
-        "GROUP BY lo_suppkey ORDER BY COUNT(*) DESC LIMIT 10"
+        "GROUP BY lo_suppkey ORDER BY COUNT(*) DESC, lo_suppkey LIMIT 10"
     ),
     # 5. SSB Q4.x shape: star-tree 3-dim pre-aggregated group-by
     "q5_startree": (

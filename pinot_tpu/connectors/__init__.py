@@ -19,6 +19,24 @@ import math
 from typing import Optional
 
 
+def _quote_ident(name: str) -> str:
+    """SQL-quote an identifier ("" escaping) unless it is already a plain
+    (possibly dotted, db.table-style) identifier; a table/column name
+    containing a quote must not rewrite the query it is interpolated into.
+    Dotted names pass through unquoted so the parser's last-segment
+    resolution (sql/parser.py parse_table_name) keeps working."""
+    import re
+
+    if re.fullmatch(r"[A-Za-z_$][\w$]*(\.[A-Za-z_$][\w$]*)*", name):
+        return name
+    return '"' + name.replace('"', '""') + '"'
+
+
+def _quote_literal(value: str) -> str:
+    """SQL string literal with '' escaping (the parser's string grammar)."""
+    return "'" + str(value).replace("'", "''") + "'"
+
+
 def query_df(source, sql: str):
     """One SQL query → pandas DataFrame. ``source``: a Broker, an engine,
     a DB-API Connection, or a broker URL string."""
@@ -43,7 +61,8 @@ def read_table(source, table: str, columns=None, where: Optional[str] = None,
     every request bounded by batch_rows instead of one giant LIMIT."""
     import pandas as pd
 
-    cols = ", ".join(columns) if columns else "*"
+    cols = ", ".join(_quote_ident(c) for c in columns) if columns else "*"
+    table = _quote_ident(table)
     base_where = f"({where}) AND " if where else ""
     # page over each segment's RAW doc-id range (MAX($docId)+1), not its
     # matching-row count — a filter would otherwise shrink the page span
@@ -69,7 +88,7 @@ def read_table(source, table: str, columns=None, where: Optional[str] = None,
         for page in range(max(1, math.ceil(int(n) / batch_rows))):
             lo, hi = page * batch_rows, (page + 1) * batch_rows
             sql = (f"SELECT {cols} FROM {table} WHERE {base_where}"
-                   f"$segmentName = '{seg_name}' AND "
+                   f"$segmentName = {_quote_literal(seg_name)} AND "
                    f"$docId >= {lo} AND $docId < {hi} LIMIT {batch_rows}")
             frames.append(query_df(source, sql))
     return pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()

@@ -49,6 +49,10 @@ def _read_long(buf: io.BytesIO) -> int:
         if not byte & 0x80:
             break
         shift += 7
+        if shift > 63:
+            # Avro longs are 64-bit: an endless 0x80 run in a corrupt file
+            # must fail fast, not grow a bigint unboundedly
+            raise ValueError("varint exceeds 64 bits (corrupt avro data)")
     return (acc >> 1) ^ -(acc & 1)
 
 

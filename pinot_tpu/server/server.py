@@ -152,29 +152,24 @@ class ServerInstance:
 
     # ---- query path ------------------------------------------------------
     @staticmethod
-    def _request_timeout_s(sql: str):
-        """Per-query SET timeoutMs, read pre-compile so the scheduler's
-        ADMISSION wait honors it: a query whose budget elapsed queueing
+    def _request_timeout_s(q):
+        """Per-query SET timeoutMs from the compiled options, honored by the
+        scheduler's ADMISSION wait: a query whose budget elapsed queueing
         must not start and burn a worker the broker already abandoned
         (the server-side half of the reference's timeoutMs option)."""
-        import re as _re
-
-        m = _re.search(r"SET\s+timeoutMs\s*=\s*([0-9.]+)", sql, _re.IGNORECASE)
-        return max(0.001, float(m.group(1)) / 1000.0) if m else None
+        v = q.options_ci().get("timeoutms")
+        return max(0.001, float(v) / 1000.0) if v is not None else None
 
     @staticmethod
-    def _scheduler_group(sql: str) -> str:
-        """Tenant key for token-bucket priority: the table name
-        (TableBasedGroupMapper analog), extracted cheaply pre-compile.
-        Normalized (lowercase, physical-type suffix stripped) so spelling
-        variants of one table share ONE bucket — distinct raw strings
-        would each mint a fresh full-burst group and defeat fairness."""
-        import re as _re
-
-        m = _re.search(r"\bFROM\s+([A-Za-z_][\w.]*)", sql, _re.IGNORECASE)
-        if not m:
-            return "default"
-        name = m.group(1).lower()
+    def _scheduler_group(q, req: dict) -> str:
+        """Tenant key for token-bucket priority: the COMPILED table name
+        (TableBasedGroupMapper analog) — a regex over raw SQL would let a
+        literal containing " FROM x" misattribute the query to the wrong
+        bucket. Normalized (lowercase, physical-type suffix stripped) so
+        offline/realtime halves of one table share ONE bucket — distinct
+        raw strings would each mint a fresh full-burst group and defeat
+        fairness."""
+        name = (req.get("table") or q.table_name or "default").lower()
         for suffix in ("_offline", "_realtime"):
             if name.endswith(suffix):
                 name = name[: -len(suffix)]
@@ -183,14 +178,18 @@ class ServerInstance:
     def _handle_submit(self, request: bytes) -> bytes:
         req = parse_instance_request(request)
         try:
+            # compile BEFORE admission: the scheduler group and timeout come
+            # from the compiled context, and a parse error must not burn a
+            # concurrency slot
+            q = optimize_query(compile_query(req["sql"]))
             # NOTE: the latency timer lives inside _handle_submit_inner —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
             acct: dict = {}
             return self.scheduler.run(
-                lambda: self._handle_submit_inner(req, acct),
-                queue_timeout_s=self._request_timeout_s(req["sql"]),
-                group=self._scheduler_group(req["sql"]),
+                lambda: self._handle_submit_inner(req, q, acct),
+                queue_timeout_s=self._request_timeout_s(q),
+                group=self._scheduler_group(q, req),
                 stats_out=acct)
         except SchedulerSaturated as e:
             # admission rejection is a query-level error: the server is
@@ -201,7 +200,7 @@ class ServerInstance:
             self.metrics.count("queryErrors")
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
-    def _handle_submit_inner(self, req: dict, acct: dict = None) -> bytes:
+    def _handle_submit_inner(self, req: dict, q, acct: dict = None) -> bytes:
         import time as _time
 
         from pinot_tpu.common import trace
@@ -211,7 +210,6 @@ class ServerInstance:
         self.metrics.count("queries")
         timer = self.metrics.timed("query")
         timer.__enter__()
-        q = optimize_query(compile_query(req["sql"]))
         tracer = trace.start_trace() if q.options_ci().get("trace") else None
         try:
             q = _apply_request_overrides(q, req)
@@ -264,9 +262,11 @@ class ServerInstance:
         early — selection without ORDER BY is any-subset semantics."""
         req = parse_instance_request(request)
         try:
+            q = optimize_query(compile_query(req["sql"]))
             yield from self.scheduler.run(
-                lambda: self._stream_blocks(req),
-                group=self._scheduler_group(req["sql"]),
+                lambda: self._stream_blocks(req, q),
+                queue_timeout_s=self._request_timeout_s(q),
+                group=self._scheduler_group(q, req),
             )
         except SchedulerSaturated as e:
             self.metrics.count("queriesRejected")
@@ -275,10 +275,12 @@ class ServerInstance:
             self.metrics.count("queryErrors")
             yield encode_error("query_error", f"{type(e).__name__}: {e}")
 
-    def _stream_blocks(self, req: dict):
+    def _stream_blocks(self, req: dict, q):
         """Materialize the block list under the scheduler slot (bounded by
-        the row budget), releasing the slot before slow network drain."""
-        q = optimize_query(compile_query(req["sql"]))
+        the row budget), releasing the slot before slow network drain.
+        Returning a LIST (not a generator) is load-bearing: the scheduler
+        charges wall time and holds the concurrency slot for the duration
+        of fn(), so block production stays inside both."""
         q = _apply_request_overrides(q, req)
         if q.aggregations() or q.distinct or q.order_by:
             raise ValueError(

@@ -79,3 +79,22 @@ def test_query_df_error_surfaces(cluster):
     registry, controller, broker = cluster
     with pytest.raises(RuntimeError, match="query failed"):
         query_df(broker, "SELECT * FROM does_not_exist")
+
+
+def test_read_table_quotes_segment_names(cluster):
+    """A segment name containing a single quote must round-trip: read_table
+    interpolates it as a SQL literal, which needs '' escaping (advisor
+    finding: string-built SQL broke on quoted identifiers/literals)."""
+    registry, controller, broker = cluster
+    schema = Schema.build(name="qt",
+                          dimensions=[("k", DataType.STRING)],
+                          metrics=[("v", DataType.LONG)])
+    controller.add_table(TableConfig(table_name="qt"), schema)
+    df = pd.DataFrame({"k": ["a", "b"] * 50, "v": np.arange(100, dtype=np.int64)})
+    names = write_table(df, schema, "qt", controller,
+                        segment_prefix="o'brien")
+    assert any("'" in n for n in names)
+    assert _wait_count(broker, "qt", 100)
+    back = read_table(broker, "qt", batch_rows=30)
+    assert len(back) == 100
+    assert back["v"].sum() == df["v"].sum()
