@@ -552,22 +552,73 @@ class ModeSpec(AggSpec):
 
 
 class FirstLastWithTimeSpec(AggSpec):
+    """FIRSTWITHTIME/LASTWITHTIME(valueCol, timeCol[, 'dataType']): the
+    value carried by the earliest/latest time per group — the argmin/
+    argmax-by-time combine family
+    (pinot-core/.../function/FirstWithTimeAggregationFunction.java:1,
+    LastWithTimeAggregationFunction.java:1).
+
+    Deliberate divergence: ties on the winning time break toward the
+    LARGEST value (the reference keeps whichever replica/segment merged
+    last — stream-order-dependent). A deterministic, associative rule is
+    required here so host scatter, device scatter, and the mesh's
+    pmin/pmax-pair combine (parallel/mesh.py) all agree bit-for-bit.
+
+    State: per-group (val, time); numeric values ride float64 arrays,
+    STRING dataType rides an object array (host path only — the device
+    path is numeric)."""
+
+    _T_MAX = np.iinfo(np.int64).max
+    _T_MIN = np.iinfo(np.int64).min
+
     def __init__(self, expr: Expression, is_first: bool):
         super().__init__(expr)
         self.is_first = is_first
         self.name = "firstwithtime" if is_first else "lastwithtime"
-        # args: (valueCol, timeCol, 'dataType')
+        if len(expr.args) < 2:
+            raise ValueError(
+                f"{self.name.upper()}(valueCol, timeCol[, 'dataType']) "
+                "requires value and time expressions")
+        self.data_type = "DOUBLE"
+        if len(expr.args) >= 3 and expr.args[2].is_literal:
+            self.data_type = str(expr.args[2].value).upper()
+        # args: (valueCol, timeCol[, 'dataType'])
         self.args = expr.args[:2]
 
+    @property
+    def _sentinel(self):
+        return self._T_MAX if self.is_first else self._T_MIN
+
+    @staticmethod
+    def _val_gt(a, b):
+        """Tie-break comparison with None = -inf (empty slot loses)."""
+        if b is None:
+            return a is not None
+        if a is None:
+            return False
+        try:
+            if np.isnan(b):
+                return True
+            if np.isnan(a):
+                return False
+        except TypeError:
+            pass  # strings
+        return a > b
+
     def host_groups(self, arg_values, group_idx, n):
-        v = np.asarray(arg_values[0], dtype=np.float64)
+        v = np.asarray(arg_values[0])
+        numeric = v.dtype.kind in "biuf"
+        if numeric:
+            v = v.astype(np.float64)
+            val = np.full(n, np.nan)
+        else:
+            val = np.empty(n, dtype=object)
+            val[:] = None
         t = np.asarray(arg_values[1], dtype=np.int64)
-        val = np.full(n, np.nan)
-        tim = np.full(n, np.iinfo(np.int64).max if self.is_first else np.iinfo(np.int64).min,
-                      dtype=np.int64)
-        for g, vv, tt in zip(group_idx, v, t):
+        tim = np.full(n, self._sentinel, dtype=np.int64)
+        for g, vv, tt in zip(group_idx, v.tolist(), t):
             better = tt < tim[g] if self.is_first else tt > tim[g]
-            if better:
+            if better or (tt == tim[g] and self._val_gt(vv, val[g])):
                 tim[g] = tt
                 val[g] = vv
         return {"val": val, "time": tim}
@@ -575,20 +626,64 @@ class FirstLastWithTimeSpec(AggSpec):
     def empty(self, n):
         return {
             "val": np.full(n, np.nan),
-            "time": np.full(n, np.iinfo(np.int64).max if self.is_first else np.iinfo(np.int64).min,
-                            dtype=np.int64),
+            "time": np.full(n, self._sentinel, dtype=np.int64),
         }
 
     def scatter_merge(self, acc, idx, part):
+        pv = np.asarray(part["val"])
+        if pv.dtype == object and acc["val"].dtype != object:
+            # string-valued partials arriving into a fresh numeric-empty
+            # accumulator: promote (one value type per query — segments of
+            # one column can't mix string and numeric)
+            promoted = np.empty(len(acc["val"]), dtype=object)
+            for j, x in enumerate(acc["val"]):
+                promoted[j] = None if (isinstance(x, float) and np.isnan(x)) else x
+            acc["val"] = promoted
         for i, g in enumerate(idx):
             tt = part["time"][i]
             better = tt < acc["time"][g] if self.is_first else tt > acc["time"][g]
-            if better:
+            vv = pv[i]
+            if isinstance(vv, list):
+                # wire artifact: an ALL-None object val array round-trips
+                # as empty lists (datatable list fallback) — restore None
+                vv = vv[0] if vv else None
+            if isinstance(vv, float) and np.isnan(vv) and tt == self._sentinel:
+                continue  # empty slot in the partial
+            if better or (tt == acc["time"][g] and self._val_gt(vv, acc["val"][g])):
                 acc["time"][g] = tt
-                acc["val"][g] = part["val"][i]
+                acc["val"][g] = vv
 
     def finalize(self, part):
-        return part["val"]
+        out = np.asarray(part["val"])
+        # the declared dataType shapes the output (result typing is
+        # runtime-dtype-based, reduce._np_type_name): an integral
+        # declaration renders LONG/INT unless empty groups force NaN
+        # (NULL) into the column
+        if self.data_type in ("INT", "LONG", "BOOLEAN", "TIMESTAMP") \
+                and out.dtype.kind == "f" and len(out) \
+                and not np.isnan(out).any():
+            return out.astype(np.int64)
+        return out
+
+    def result_type(self):
+        if self.data_type in ("INT", "LONG", "FLOAT", "DOUBLE", "STRING",
+                              "BOOLEAN", "TIMESTAMP"):
+            return self.data_type
+        return "DOUBLE"
+
+
+class FirstWithTimeSpec(FirstLastWithTimeSpec):
+    name = "firstwithtime"
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr, is_first=True)
+
+
+class LastWithTimeSpec(FirstLastWithTimeSpec):
+    name = "lastwithtime"
+
+    def __init__(self, expr: Expression):
+        super().__init__(expr, is_first=False)
 
 
 class _MVEntrySpec(AggSpec):
@@ -899,6 +994,8 @@ _SPECS = {
     "percentilerawest": RawDigestPercentileSpec,
     "percentilerawtdigest": RawDigestPercentileSpec,
     "mode": ModeSpec,
+    "firstwithtime": FirstWithTimeSpec,
+    "lastwithtime": LastWithTimeSpec,
     "sumprecision": SumPrecisionSpec,
     "idset": IdSetSpec,
     "distinctcountsmarthll": SmartHLLSpec,
@@ -926,10 +1023,6 @@ _SPECS = {
 
 def make_spec(expr: Expression) -> AggSpec:
     name = expr.name
-    if name == "firstwithtime":
-        return FirstLastWithTimeSpec(expr, is_first=True)
-    if name == "lastwithtime":
-        return FirstLastWithTimeSpec(expr, is_first=False)
     cls = _SPECS.get(name)
     if cls is None:
         raise KeyError(f"unsupported aggregation function: {name}")

@@ -93,6 +93,16 @@ def _flatten_obj(name: str, arr: np.ndarray, arrays: dict, meta: dict) -> None:
         arrays[f"{name}__values"] = np.asarray([str(x) for x in arr],
                                                dtype=np.str_)
         meta[name] = {"obj": "exact_scalar"}
+    elif isinstance(first, str):
+        # scalar strings with empty slots (FIRSTWITHTIME/LASTWITHTIME over
+        # a STRING column): one value per group + a presence flag so a
+        # genuinely-empty slot (None) survives the round trip distinct
+        # from the empty string
+        arrays[f"{name}__values"] = np.asarray(
+            [x if x is not None else "" for x in arr], dtype=np.str_)
+        arrays[f"{name}__flags"] = np.asarray(
+            [x is not None for x in arr], dtype=np.int8)
+        meta[name] = {"obj": "scalar_str"}
     elif isinstance(first, tuple) and len(first) == 2 and \
             first[0] in ("set", "hll"):
         # SmartHLL tagged union: flag per group + set entries or registers
@@ -139,6 +149,13 @@ def _unflatten_obj(name: str, spec: dict, arrays: dict) -> np.ndarray:
         for i, s in enumerate(vals.tolist()):
             out[i] = int(s) if "." not in s and "E" not in s.upper() \
                 else decimal.Decimal(s)
+        return out
+    if spec["obj"] == "scalar_str":
+        vals = arrays[f"{name}__values"]
+        flags = arrays[f"{name}__flags"]
+        out = np.empty(len(flags), dtype=object)
+        for i, (s, f) in enumerate(zip(vals.tolist(), flags.tolist())):
+            out[i] = s if f else None
         return out
     if spec["obj"] == "smart_hll":
         offsets = arrays[f"{name}__offsets"]

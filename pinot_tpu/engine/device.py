@@ -47,6 +47,7 @@ DEVICE_AGGS = {
     "distinctcount", "distinctcountbitmap", "distinctcounthll",
     "segmentpartitioneddistinctcount",
     "hllmerge",  # star-tree sketch-state re-merge (engine/startree_exec.py)
+    "firstwithtime", "lastwithtime",  # argmax-by-time combine family
 }
 
 MAX_DENSE_GROUPS = 1 << 22        # ARRAY_BASED regime guard (~4M groups)
@@ -273,6 +274,25 @@ def _finalize_sketch_outs(outs, agg_tpls):
             else:
                 outs[f"{k}_est"] = hll_ops.estimate_jnp(regs)
     return outs
+
+
+def _with_time_partial(name: str, outs: dict, k: str, present):
+    """Device (time, value) outputs → the canonical {"val","time"} partial
+    of FirstLastWithTimeSpec; empty groups keep the time sentinel and a
+    NaN value (the device's -inf fill is a kernel artifact, not a value)."""
+    first = name == "firstwithtime"
+    suff = "tmin" if first else "tmax"
+    t = np.asarray(outs[f"{k}_{suff}"]).reshape(-1)
+    v = np.asarray(outs[f"{k}_v{suff}"], dtype=np.float64).reshape(-1)
+    if present is not None:
+        t, v = t[present], v[present]
+    t = t.astype(np.int64)
+    sentinel = np.iinfo(np.int64).max if first else np.iinfo(np.int64).min
+    # -inf is the kernel's "no non-NaN winner" encoding (all-NaN winner
+    # rows), kept as -inf through the mesh pmax so it stays associative;
+    # it becomes NaN only here at the canonical boundary
+    return {"val": np.where((t == sentinel) | np.isneginf(v), np.nan, v),
+            "time": t}
 
 
 def _is_f64(dt) -> bool:
@@ -535,6 +555,14 @@ def build_pipeline(template, mm_mode: str = "auto"):
                     regs = jnp.zeros((num_groups + 1, m), dtype=jnp.int32)
                     regs = regs.at[gid2].max(planes.reshape(-1, m))
                     outs[f"{k}_regs"] = regs[:num_groups]
+                elif name in ("firstwithtime", "lastwithtime"):
+                    v = _eval_expr(argt[0], cols, params)
+                    t = _eval_expr(argt[1], cols, params)
+                    first = name == "firstwithtime"
+                    tb, vb = agg_ops.group_arg_time(gid, v, t, num_groups, first)
+                    suff = "tmin" if first else "tmax"
+                    outs[f"{k}_{suff}"] = tb
+                    outs[f"{k}_v{suff}"] = vb
             return outs
 
         # scalar aggregation shape
@@ -570,6 +598,14 @@ def build_pipeline(template, mm_mode: str = "auto"):
                 planes = cols["bp::" + argt].astype(jnp.int32)
                 outs[f"{k}_regs"] = jnp.max(
                     jnp.where(mask[..., None], planes, 0), axis=(0, 1))
+            elif name in ("firstwithtime", "lastwithtime"):
+                v = _eval_expr(argt[0], cols, params)
+                t = _eval_expr(argt[1], cols, params)
+                first = name == "firstwithtime"
+                tb, vb = agg_ops.agg_arg_time(v, t, mask, first)
+                suff = "tmin" if first else "tmax"
+                outs[f"{k}_{suff}"] = tb
+                outs[f"{k}_v{suff}"] = vb
         return outs
 
     return pipeline  # caller jits (single-device) or shard_maps (mesh)
@@ -679,6 +715,13 @@ class DeviceExecutor:
                 raise DeviceUnsupported(
                     f"hllmerge plane width {width} != m {spec.m}")
             return ("hllmerge", arg.name, spec.log2m)
+        if name in ("firstwithtime", "lastwithtime"):
+            # value + time expression pair; STRING dataType can't ride the
+            # float64 value plane — build_expr already rejects non-numeric
+            # dict columns, sending those to the host path
+            vt = build_expr(a.args[0], ctx, params, counter)
+            tt = build_expr(a.args[1], ctx, params, counter)
+            return (name, (vt, tt), "pair")
         # numeric-arg aggregations
         argt = build_expr(a.args[0], ctx, params, counter)
         rpb = None
@@ -812,6 +855,9 @@ class DeviceExecutor:
                 needed.add("hh::" + argt)
             elif name == "hllmerge":
                 needed.add("bp::" + argt)
+            elif name in ("firstwithtime", "lastwithtime"):
+                needed |= self._needed_columns(argt[0])
+                needed |= self._needed_columns(argt[1])
             elif argt is not None:
                 needed |= self._needed_columns(argt)
         cols = {}
@@ -985,6 +1031,8 @@ class DeviceExecutor:
             if f"{k}_est" in outs:  # terminal: estimated on device
                 return {"est": np.asarray([outs[f"{k}_est"]], dtype=np.int64)}
             return {"regs": outs[f"{k}_regs"].reshape(1, -1)}
+        if name in ("firstwithtime", "lastwithtime"):
+            return _with_time_partial(name, outs, k, None)
         raise AssertionError(name)
 
     def _group_partial(self, i, tpl, outs, ctx, present):
@@ -1021,4 +1069,6 @@ class DeviceExecutor:
             if f"{k}_est" in outs:  # terminal: estimated on device
                 return {"est": outs[f"{k}_est"][present].astype(np.int64)}
             return {"regs": outs[f"{k}_regs"][present]}
+        if name in ("firstwithtime", "lastwithtime"):
+            return _with_time_partial(name, outs, k, present)
         raise AssertionError(name)

@@ -67,6 +67,26 @@ def agg_max(values, mask):
     return jnp.max(jnp.where(mask, values, small))
 
 
+def agg_arg_time(values, times, mask, is_first: bool):
+    """FIRSTWITHTIME/LASTWITHTIME scalar shape: (best_time, best_value)
+    where best_time = min (first) / max (last) over matched rows and
+    best_value = max value among rows carrying best_time — the same
+    deterministic tie-break as the host spec and the mesh combine
+    (engine/aggspec.py FirstLastWithTimeSpec)."""
+    t = times.astype(jnp.int64)
+    v = values.astype(jnp.float64)
+    if is_first:
+        fill = jnp.iinfo(jnp.int64).max
+        tb = jnp.min(jnp.where(mask, t, fill))
+    else:
+        fill = jnp.iinfo(jnp.int64).min
+        tb = jnp.max(jnp.where(mask, t, fill))
+    # NaN values never win (host _val_gt rule); -inf encodes "no non-NaN
+    # winner" and is restored to NaN host-side (_with_time_partial)
+    vb = jnp.max(jnp.where(mask & (t == tb) & ~jnp.isnan(v), v, NEG_INF))
+    return tb, vb
+
+
 # ---- dense group-by scatter ----------------------------------------------
 # gids: int32 (S, L) global group ids; invalid/padded docs carry gid = G
 # (one overflow slot, sliced off afterwards) so no branch is needed.
@@ -126,6 +146,32 @@ def group_max(gids, values, num_groups: int):
         init = NEG_INF
     out = jnp.full(num_groups + 1, init, dtype=v.dtype).at[flat].max(v)
     return out[:num_groups]
+
+
+def group_arg_time(gids, values, times, num_groups: int, is_first: bool):
+    """Dense-group FIRSTWITHTIME/LASTWITHTIME: per-group (best_time,
+    best_value) via two scatters — extremal time, then max value among
+    rows whose time equals their group's winner (deterministic tie-break
+    matching the host spec). Masked rows carry gid = num_groups (overflow
+    slot, sliced off)."""
+    flat_g = gids.reshape(-1)
+    t = times.reshape(-1).astype(jnp.int64)
+    v = values.reshape(-1).astype(jnp.float64)
+    if is_first:
+        fill = jnp.iinfo(jnp.int64).max
+        tb = jnp.full(num_groups + 1, fill, dtype=jnp.int64).at[flat_g].min(t)
+    else:
+        fill = jnp.iinfo(jnp.int64).min
+        tb = jnp.full(num_groups + 1, fill, dtype=jnp.int64).at[flat_g].max(t)
+    # NaN values never win the value tie-break (host _val_gt rule): mask
+    # them to -inf so the scatter-max ignores them; a group whose winning
+    # rows are ALL NaN keeps -inf, which the host conversion restores to
+    # NaN (_with_time_partial). Known edge: a literal -inf data value that
+    # is a group's only winner also renders NaN.
+    winner = (t == tb[flat_g]) & ~jnp.isnan(v)
+    vm = jnp.where(winner, v, NEG_INF)
+    vb = jnp.full(num_groups + 1, NEG_INF).at[flat_g].max(vm)
+    return tb[:num_groups], vb[:num_groups]
 
 
 def group_ids_combine(per_col_gids, cardinalities, mask, num_groups: int):

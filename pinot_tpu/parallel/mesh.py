@@ -44,12 +44,35 @@ def _combine_out(key: str, v):
     blocking-queue merge."""
     if key == "seg_matched":
         return v  # stays per-shard; out_spec P(SEG_AXIS) reassembles (S,)
-    if key.endswith("_min"):
+    if key.endswith(("_min", "_tmin")):
         return jax.lax.pmin(v, SEG_AXIS)
-    if key.endswith(("_max", "_pres", "_regs")):
+    if key.endswith(("_max", "_tmax", "_pres", "_regs")):
         return jax.lax.pmax(v, SEG_AXIS)
     # doc_count, gcount, *_sum, counts
     return jax.lax.psum(v, SEG_AXIS)
+
+
+def _combine_outs(outs: dict) -> dict:
+    """Combine a pipeline's outputs across shards. Most keys combine
+    independently (_combine_out); the FIRSTWITHTIME/LASTWITHTIME value
+    planes (``*_vtmin`` / ``*_vtmax``) combine as an argmin/argmax-by-time
+    PAIR with their ``*_tmin`` / ``*_tmax`` sibling: resolve the global
+    winning time with pmin/pmax, mask each shard's values to rows that
+    carry it, then pmax the values — associative, deterministic (ties on
+    time break toward the largest value, matching
+    engine/aggspec.py FirstLastWithTimeSpec)."""
+    combined = {}
+    for k, v in outs.items():
+        if k.endswith("_vtmin") or k.endswith("_vtmax"):
+            tkey = k[:-6] + ("_tmin" if k.endswith("_vtmin") else "_tmax")
+            t = outs[tkey]
+            tg = jax.lax.pmin(t, SEG_AXIS) if k.endswith("_vtmin") \
+                else jax.lax.pmax(t, SEG_AXIS)
+            combined[k] = jax.lax.pmax(
+                jnp.where(t == tg, v, -jnp.inf), SEG_AXIS)
+        else:
+            combined[k] = _combine_out(k, v)
+    return combined
 
 
 def shard_pipeline(pipeline_fn, mesh: Mesh):
@@ -64,7 +87,7 @@ def shard_pipeline(pipeline_fn, mesh: Mesh):
 
     def sharded(cols, n_docs, params):
         outs = pipeline_fn(cols, n_docs, params)
-        return {k: _combine_out(k, v) for k, v in outs.items()}
+        return _combine_outs(outs)
 
     # global-id design: every param (literals, (C,) LUTs) is batch-wide and
     # replicated; only columns and n_docs carry the segment axis. The "ps"

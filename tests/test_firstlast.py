@@ -1,0 +1,216 @@
+"""FIRSTWITHTIME / LASTWITHTIME: the argmax-by-time combine family.
+
+Reference: pinot-core/.../query/aggregation/function/
+FirstWithTimeAggregationFunction.java:1, LastWithTimeAggregationFunction.java.
+Tie-break divergence (largest value wins on equal times) is documented on
+FirstLastWithTimeSpec; the oracle here implements the same rule.
+"""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.engine.datatable import decode, encode
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+
+
+def _oracle(df, is_first):
+    """Per-key (best value): min/max time, ties -> max value."""
+    out = {}
+    for k, grp in df.groupby("k"):
+        t = grp["ts"]
+        best_t = t.min() if is_first else t.max()
+        out[k] = grp.loc[t == best_t, "v"].max()
+    return out
+
+
+@pytest.fixture(scope="module")
+def segments(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fwt")
+    schema = Schema.build(
+        name="t", dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("ts", DataType.LONG)])
+    rng = np.random.default_rng(7)
+    # deliberate time ties: ts drawn from a SMALL range so most (k, ts)
+    # pairs collide and the tie-break rule is actually exercised
+    df = pd.DataFrame({
+        "k": np.array(["a", "b", "c", "d"])[rng.integers(0, 4, 5000)],
+        "v": rng.integers(-50, 50, 5000).astype(np.int64),
+        "ts": rng.integers(0, 40, 5000).astype(np.int64),
+    })
+    segs = []
+    for i in range(3):
+        part = df.iloc[i * 1700: (i + 1) * 1700]
+        segs.append(build_segment(
+            schema, {c: part[c].to_numpy() for c in part},
+            os.path.join(str(tmp), f"s{i}"), segment_name=f"s{i}"))
+    return df, segs
+
+
+def _engine(segs, device):
+    eng = QueryEngine(device_executor="auto" if device else None)
+    for s in segs:
+        eng.add_segment("t", s)
+    return eng
+
+
+@pytest.mark.parametrize("device", [False, True])
+@pytest.mark.parametrize("is_first", [False, True])
+def test_group_by_matches_oracle(segments, device, is_first):
+    df, segs = segments
+    fn = "FIRSTWITHTIME" if is_first else "LASTWITHTIME"
+    eng = _engine(segs, device)
+    r = eng.execute(
+        f"SELECT k, {fn}(v, ts, 'LONG') FROM t GROUP BY k ORDER BY k")
+    assert not r.get("exceptions"), r
+    want = _oracle(df, is_first)
+    got = {row[0]: row[1] for row in r["resultTable"]["rows"]}
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == want[k], (k, got[k], want[k])
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_scalar_and_filtered(segments, device):
+    df, segs = segments
+    eng = _engine(segs, device)
+    r = eng.execute("SELECT LASTWITHTIME(v, ts, 'LONG'), "
+                    "FIRSTWITHTIME(v, ts, 'LONG') FROM t WHERE k = 'b'")
+    assert not r.get("exceptions"), r
+    sub = df[df.k == "b"]
+    want_last = sub.loc[sub.ts == sub.ts.max(), "v"].max()
+    want_first = sub.loc[sub.ts == sub.ts.min(), "v"].max()
+    assert r["resultTable"]["rows"][0] == [want_last, want_first]
+
+
+def test_device_host_identical(segments):
+    """Bit-for-bit agreement between backends (the deterministic tie-break
+    is what makes this assertable)."""
+    _, segs = segments
+    sql = ("SELECT k, LASTWITHTIME(v, ts, 'LONG'), "
+           "FIRSTWITHTIME(v, ts, 'LONG') FROM t GROUP BY k ORDER BY k")
+    r_host = _engine(segs, False).execute(sql)
+    r_dev = _engine(segs, True).execute(sql)
+    assert r_host["resultTable"]["rows"] == r_dev["resultTable"]["rows"]
+
+
+def test_mesh_combine(segments):
+    """8-way CPU mesh shard + pmin/pmax-pair combine == single device ==
+    host (the combine family VERDICT r4 flagged as missing)."""
+    from pinot_tpu.engine.device import DeviceExecutor
+    from pinot_tpu.parallel.mesh import make_mesh
+
+    _, segs = segments
+    sql = ("SELECT k, LASTWITHTIME(v, ts, 'LONG'), "
+           "FIRSTWITHTIME(v, ts, 'LONG') FROM t GROUP BY k ORDER BY k")
+    eng = QueryEngine(device_executor=DeviceExecutor(mesh=make_mesh(8),
+                                                     mm_mode="interpret"))
+    for s in segs:
+        eng.add_segment("t", s)
+    r_mesh = eng.execute(sql)
+    assert not r_mesh.get("exceptions"), r_mesh
+    r_host = _engine(segs, False).execute(sql)
+    assert r_mesh["resultTable"]["rows"] == r_host["resultTable"]["rows"]
+
+
+def test_string_values_host(tmp_path):
+    """STRING dataType (host path: the device rejects non-numeric value
+    columns and falls back)."""
+    schema = Schema.build(
+        name="s", dimensions=[("k", DataType.STRING),
+                              ("who", DataType.STRING)],
+        metrics=[("ts", DataType.LONG)])
+    df = pd.DataFrame({
+        "k": ["x", "x", "y", "y", "y"],
+        "who": ["ann", "bob", "cat", "dan", "eve"],
+        "ts": np.array([5, 9, 2, 7, 7], dtype=np.int64),
+    })
+    seg = build_segment(schema, {c: df[c].to_numpy() for c in df},
+                        str(tmp_path / "s0"))
+    eng = QueryEngine(device_executor=None)
+    eng.add_segment("s", seg)
+    r = eng.execute("SELECT k, LASTWITHTIME(who, ts, 'STRING') FROM s "
+                    "GROUP BY k ORDER BY k")
+    assert not r.get("exceptions"), r
+    # x: latest ts=9 -> bob; y: tie at ts=7 -> max('dan','eve') = 'eve'
+    assert r["resultTable"]["rows"] == [["x", "bob"], ["y", "eve"]]
+    r2 = eng.execute("SELECT FIRSTWITHTIME(who, ts, 'STRING') FROM s")
+    assert r2["resultTable"]["rows"][0][0] == "cat"
+
+
+def test_partial_wire_roundtrip(segments):
+    """Server partials (val,time states, incl. string values) survive the
+    DataTable encode/decode."""
+    from pinot_tpu.engine import aggspec
+    from pinot_tpu.engine.host import HostExecutor
+    from pinot_tpu.query.context import Expression
+
+    _, segs = segments
+    eng = _engine(segs, False)
+    from pinot_tpu.sql.compiler import compile_query
+
+    q = compile_query("SELECT k, LASTWITHTIME(v, ts, 'LONG') FROM t GROUP BY k")
+    res = eng.execute_segments(q, list(eng.tables["t"].segments.values()))
+    back = decode(encode(res))
+    p0, p1 = res.agg_partials[0], back.agg_partials[0]
+    np.testing.assert_array_equal(p0["time"], p1["time"])
+    np.testing.assert_array_equal(p0["val"], p1["val"])
+    # string-valued state round-trip (scalar_str wire kind)
+    sval = np.empty(3, dtype=object)
+    sval[:] = ["zed", None, "amy"]
+    arr = {}
+    meta = {}
+    from pinot_tpu.engine.datatable import _flatten_obj, _unflatten_obj
+
+    _flatten_obj("x", sval, arr, meta)
+    out = _unflatten_obj("x", meta["x"], arr)
+    assert list(out) == ["zed", None, "amy"]
+
+
+def test_empty_groups_and_no_match(segments):
+    _, segs = segments
+    for device in (False, True):
+        eng = _engine(segs, device)
+        r = eng.execute("SELECT LASTWITHTIME(v, ts, 'LONG') FROM t "
+                        "WHERE k = 'zzz_not_there'")
+        assert not r.get("exceptions"), r
+        val = r["resultTable"]["rows"][0][0]
+        assert val is None or (isinstance(val, float) and np.isnan(val)) \
+            or val == "null", val
+
+
+def test_nan_values_lose_ties(tmp_path):
+    """NaN values never win the tie-break on ANY backend (review finding:
+    XLA max propagates NaN; the kernels mask it out)."""
+    schema = Schema.build(
+        name="n", dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.DOUBLE), ("ts", DataType.LONG)])
+    df = pd.DataFrame({
+        "k": ["a", "a", "a", "b"],
+        "v": [np.nan, 5.0, 1.0, np.nan],
+        "ts": np.array([7, 7, 3, 9], dtype=np.int64),
+    })
+    seg_dir = str(tmp_path / "s0")
+    seg = build_segment(schema, {c: df[c].to_numpy() for c in df}, seg_dir)
+    sql = ("SELECT k, LASTWITHTIME(v, ts, 'DOUBLE') FROM n "
+           "GROUP BY k ORDER BY k")
+    rows = {}
+    for device in (False, True):
+        eng = QueryEngine(device_executor="auto" if device else None)
+        eng.add_segment("n", seg)
+        r = eng.execute(sql)
+        assert not r.get("exceptions"), r
+        rows[device] = r["resultTable"]["rows"]
+    # a: ts tie at 7, NaN loses -> 5.0; b: only value is NaN -> NaN
+    # (group-by rows keep NaN like every other aggregation over NaN data)
+    assert rows[False][0] == ["a", 5.0]
+    assert rows[False][1][0] == "b"
+    bval = rows[False][1][1]
+    assert bval is None or (isinstance(bval, float) and np.isnan(bval))
+    assert rows[False][0] == rows[True][0]
+    assert str(rows[False][1]) == str(rows[True][1])  # NaN != NaN
