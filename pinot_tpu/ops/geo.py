@@ -180,3 +180,68 @@ def st_contains(poly_wkt, points) -> np.ndarray:
 def st_within(points, poly_wkt) -> np.ndarray:
     """Point within polygon — flipped argument order (StWithinFunction)."""
     return st_contains(poly_wkt, points)
+
+
+_WKT_TYPES = {
+    "POINT": "Point", "LINESTRING": "LineString", "POLYGON": "Polygon",
+    "MULTIPOINT": "MultiPoint", "MULTILINESTRING": "MultiLineString",
+    "MULTIPOLYGON": "MultiPolygon",
+    "GEOMETRYCOLLECTION": "GeometryCollection",
+}
+
+
+def st_geometry_type(geo) -> np.ndarray:
+    """JTS Geometry.getGeometryType() analog: the WKT type token in JTS
+    capitalization (StGeometryTypeFunction.java:74)."""
+    s = _as_str_array(geo)
+    out = np.empty(len(s), dtype=object)
+    for i, w in enumerate(s):
+        tok = str(w).strip().split("(")[0].strip().split()[0].upper() \
+            if str(w).strip() else ""
+        out[i] = _WKT_TYPES.get(tok, tok.title() if tok else "")
+    return out
+
+
+def _normalize_wkt(w: str) -> str:
+    return " ".join(str(w).upper().replace("(", " ( ").replace(")", " ) ")
+                    .replace(",", " , ").split())
+
+
+def st_equals(a, b) -> np.ndarray:
+    """Geometry equality (StEqualsFunction role): POINT pairs compare by
+    coordinates; other WKT pairs by normalized text — sufficient for the
+    point/polygon geometry model this build carries (ops/geo.py)."""
+    aa, bb = _as_str_array(a), _as_str_array(b)
+    aa, bb = np.broadcast_arrays(aa, bb)
+    lon_a, lat_a = parse_points(aa)
+    lon_b, lat_b = parse_points(bb)
+    out = np.zeros(len(aa), dtype=bool)
+    for i in range(len(aa)):
+        if not np.isnan(lon_a[i]) and not np.isnan(lon_b[i]):
+            out[i] = lon_a[i] == lon_b[i] and lat_a[i] == lat_b[i]
+        else:
+            out[i] = _normalize_wkt(aa[i]) == _normalize_wkt(bb[i])
+    return out
+
+
+def grid_cell(lon, lat, resolution) -> np.ndarray:
+    """geoToH3's role on this build's grid scheme (storage/geoindex.py):
+    pack (floor(lat/res_deg), floor(lon/res_deg)) into an int64 cell id
+    with the resolution in the top byte, so ids from different resolutions
+    never collide (like H3's resolution-tagged indexes). res_deg halves
+    per resolution step: res 0 = 360 deg, res r = 360/2^r deg.
+    NaN coordinates yield -1 (no cell)."""
+    lon = np.atleast_1d(np.asarray(lon, dtype=np.float64))
+    lat = np.atleast_1d(np.asarray(lat, dtype=np.float64))
+    res = np.atleast_1d(np.asarray(resolution, dtype=np.int64))
+    lon, lat, res = np.broadcast_arrays(lon, lat, res)
+    # at res r, cj spans 2^r values and ci 2^(r-1): both must fit their
+    # packed fields (27 / 26 bits), so 27 is the finest resolution
+    # (~0.3m cells) before indices would alias across the planet
+    res = np.clip(res, 0, 27)
+    res_deg = 360.0 / (np.int64(1) << res)
+    ci = np.floor(lat / res_deg).astype(np.int64)
+    cj = np.floor(lon / res_deg).astype(np.int64)
+    cell = (res.astype(np.int64) << 54) | ((ci & 0x3FFFFFF) << 27) \
+        | (cj & 0x7FFFFFF)
+    return np.where(np.isnan(lon) | np.isnan(lat), np.int64(-1), cell)

@@ -641,3 +641,175 @@ def _datetrunc(unit, millis):
 
 
 _reg("datetrunc", _datetrunc, min_args=2, max_args=2)
+
+
+# ---- transform-enum tail (TransformFunctionType.java) ---------------------
+# QUARTER / WEEK_OF_YEAR / DAY_OF_YEAR / YEAR_OF_WEEK / MILLISECOND
+# (DateTimeFunctions.java, UTC like the other datetime fns here),
+# ATAN2 / COT / ROUND_DECIMAL / TRUNCATE (ArithmeticFunctions.java),
+# JSONEXTRACTKEY, INIDSET, GEOTOH3 (grid-scheme role), ST_EQUALS,
+# ST_GEOMETRY_TYPE.
+
+
+def _epoch_days(millis):
+    ms = np.asarray(millis, dtype=np.int64)
+    return ms.astype("datetime64[ms]").astype("datetime64[D]").astype(np.int64)
+
+
+def _iso_week_fields(millis):
+    """(weekOfYear, yearOfWeek) under ISO-8601 week numbering (joda
+    ISOChronology, DateTimeFunctions.weekOfYear/yearOfWeek): a week
+    belongs to the year containing its Thursday."""
+    D = _epoch_days(millis)
+    wd = (D + 3) % 7                       # 0 = Monday (1970-01-01 was Thu)
+    thu = D - wd + 3
+    thu_dt = thu.astype("datetime64[D]")
+    iso_year = thu_dt.astype("datetime64[Y]").astype(np.int64) + 1970
+    jan1 = (iso_year - 1970).astype("datetime64[Y]").astype(
+        "datetime64[D]").astype(np.int64)
+    week = (thu - jan1) // 7 + 1
+    return week.astype(np.int64), iso_year
+
+
+def _quarter(millis):
+    return (np.asarray(_dtfield(millis, "month"), dtype=np.int64) - 1) // 3 + 1
+
+
+def _day_of_year(millis):
+    dt = np.asarray(millis, dtype=np.int64).astype("datetime64[ms]")
+    D = dt.astype("datetime64[D]")
+    Y = dt.astype("datetime64[Y]")
+    return (D - Y.astype("datetime64[D]")).astype(np.int64) + 1
+
+
+def _millisecond(millis):
+    # joda millisOfSecond: non-negative even for pre-epoch instants
+    return np.mod(np.asarray(millis, dtype=np.int64), 1000)
+
+
+_reg("quarter", _quarter)
+_reg("weekofyear", lambda a: _iso_week_fields(a)[0])
+_reg("week", lambda a: _iso_week_fields(a)[0])
+_reg("yearofweek", lambda a: _iso_week_fields(a)[1])
+_reg("yow", lambda a: _iso_week_fields(a)[1])
+_reg("dayofyear", _day_of_year)
+_reg("doy", _day_of_year)
+_reg("millisecond", _millisecond)
+
+_reg("atan2", np.arctan2, (lambda a, b: jnp.arctan2(a, b)), 2)
+_reg("cot", lambda a: _np_div(1.0, np.tan(np.asarray(a, dtype=np.float64))),
+     (lambda a: 1.0 / jnp.tan(a)), 1)
+
+
+def _round_decimal(a, scale=None):
+    """BigDecimal HALF_UP rounding (ArithmeticFunctions.roundDecimal) —
+    np.round is half-EVEN, which differs on exact .5 boundaries."""
+    v = np.asarray(a, dtype=np.float64)
+    if scale is None:
+        return np.floor(v + 0.5)  # Math.round
+    s = 10.0 ** int(np.asarray(scale).item())
+    return np.sign(v) * np.floor(np.abs(v) * s + 0.5) / s
+
+
+def _truncate(a, scale=None):
+    """Truncate toward zero to ``scale`` decimals (RoundingMode.DOWN)."""
+    v = np.asarray(a, dtype=np.float64)
+    if scale is None:
+        return np.sign(v) * np.floor(np.abs(v))
+    s = 10.0 ** int(np.asarray(scale).item())
+    return np.sign(v) * np.floor(np.abs(v) * s) / s
+
+
+_reg("rounddecimal", _round_decimal, None, 1, 2)
+_reg("round_decimal", _round_decimal, None, 1, 2)
+_reg("truncate", _truncate, None, 1, 2)
+
+
+def _json_extract_key(col, path):
+    """jsonExtractKey(jsonCol, 'jsonPath') → STRING_MV of the jayway-style
+    paths matching the expression (JsonExtractKeyTransformFunction's
+    AS_PATH_LIST contract). Scalar paths plus one trailing wildcard
+    (``$.a.*`` / ``$.a[*]``) are supported — the subset the engine's json
+    navigation models."""
+    import json as _json
+
+    p = str(np.asarray(path).item())
+    wildcard = p.endswith(".*") or p.endswith("[*]")
+    base = p[:-2] if p.endswith(".*") else (p[:-3] if p.endswith("[*]") else p)
+    steps = _json_path_steps(base)
+
+    def jay(parts):
+        return "$" + "".join(
+            f"[{s}]" if isinstance(s, int) else f"['{s}']" for s in parts)
+
+    vals = np.asarray(col)
+    if vals.ndim == 0:
+        vals = vals[None]
+    out = np.empty(len(vals), dtype=object)
+    for i, s in enumerate(vals.tolist()):
+        try:
+            obj = _json_nav(_json.loads(str(s)), steps)
+        except (ValueError, TypeError):
+            obj = None
+        paths = []
+        if wildcard:
+            if isinstance(obj, dict):
+                paths = [jay(steps + [k]) for k in obj]
+            elif isinstance(obj, list):
+                paths = [jay(steps + [j]) for j in range(len(obj))]
+        elif obj is not None:
+            paths = [jay(steps)]
+        out[i] = paths
+    return out
+
+
+_reg("jsonextractkey", _json_extract_key, min_args=2, max_args=2)
+_reg("json_extract_key", _json_extract_key, min_args=2, max_args=2)
+
+
+def _in_id_set(col, idset_b64):
+    """inIdSet(col, 'serialized-idset') → BOOLEAN membership against an
+    IDSET aggregation result (engine/aggspec.py IdSetSpec rendering:
+    base64(gzip(json(sorted values))))."""
+    import base64
+    import gzip
+    import json as _json
+
+    blob = str(np.asarray(idset_b64).item())
+    try:
+        ids = set(_json.loads(gzip.decompress(
+            base64.b64decode(blob)).decode("utf-8")))
+    except Exception as e:  # noqa: BLE001 — malformed literal is a user error
+        raise ValueError(f"inIdSet: malformed idset literal: {e}") from None
+    vals = np.asarray(col)
+    if vals.ndim == 0:
+        vals = vals[None]
+    out = np.zeros(len(vals), dtype=bool)
+    for i, v in enumerate(vals.tolist()):
+        out[i] = v in ids or str(v) in ids
+    return out
+
+
+_reg("inidset", _in_id_set, min_args=2, max_args=2, returns_bool=True)
+_reg("in_id_set", _in_id_set, min_args=2, max_args=2, returns_bool=True)
+
+
+def _geo_to_cell(*args):
+    """geoToH3's two reference signatures on the grid scheme:
+    geoToH3(point, res) or geoToH3(lon, lat, res) (GeoToH3Function.java:
+    38-39). Returns grid cell ids, not H3 ids — this build's geo index is
+    the 2-D lat/lon grid (storage/geoindex.py), documented in PARITY.md."""
+    from pinot_tpu.ops import geo as _g
+
+    if len(args) == 2:
+        lon, lat = _g.parse_points(args[0])
+        return _g.grid_cell(lon, lat, args[1])
+    return _g.grid_cell(args[0], args[1], args[2])
+
+
+_reg("geotoh3", _geo_to_cell, min_args=2, max_args=3)
+_reg("gridcell", _geo_to_cell, min_args=2, max_args=3)
+
+_reg("st_equals", lambda a, b: _geo("st_equals")(a, b), min_args=2,
+     max_args=2, returns_bool=True)
+_reg("st_geometrytype", lambda g: _geo("st_geometry_type")(g), min_args=1)
