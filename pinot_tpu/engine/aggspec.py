@@ -319,6 +319,47 @@ class HllMergeSpec(DistinctCountHLLSpec):
         return {"regs": acc}
 
 
+def set_to_bytes(values) -> bytes:
+    """Serialize a distinct-value set for a star-tree cube row
+    (DistinctCountBitmapValueAggregator's serialized-RoaringBitmap role):
+    json of the sorted values. JSON round-trips ints, floats, and strings
+    exactly; trailing-NUL padding of the fixed-width BYTES column is safe
+    because json text never ends in NUL."""
+    import json
+
+    return json.dumps(sorted(values, key=lambda x: (str(type(x)), x))).encode()
+
+
+def set_from_bytes(blob) -> set:
+    import json
+
+    if not blob:
+        return set()
+    return set(json.loads(bytes(blob).rstrip(b"\x00").decode("utf-8")))
+
+
+class BitmapMergeSpec(DistinctCountSpec):
+    """BITMAPMERGE(state_col): union pre-aggregated distinct-value sets
+    (one serialized set per cube row) into DistinctCountSpec's canonical
+    {"sets"} partial — the star-tree execution rewrite of DISTINCTCOUNT /
+    DISTINCTCOUNTBITMAP over the cube's state column (reference
+    DistinctCountBitmapValueAggregator,
+    pinot-segment-local/.../aggregator/DistinctCountBitmapValueAggregator.java:1).
+
+    The state holds VALUES (not dict ids): cube segments from different
+    parent segments have different dictionaries, so id-space planes could
+    not merge across segments."""
+
+    name = "bitmapmerge"
+
+    def host_groups(self, arg_values, group_idx, n):
+        sets = _obj_array(n, set)
+        for g, blob in zip(np.asarray(group_idx).tolist(),
+                           np.asarray(arg_values[0]).tolist()):
+            sets[g] |= set_from_bytes(blob)
+        return {"sets": sets}
+
+
 class RawHLLSpec(DistinctCountHLLSpec):
     """DISTINCTCOUNTRAWHLL: serialized registers (base64) instead of the
     estimate, like the reference's serialized HyperLogLog blob."""
@@ -769,6 +810,33 @@ class SumPrecisionSpec(AggSpec):
         return "STRING"
 
 
+class SumPrecisionMergeSpec(SumPrecisionSpec):
+    """SUMPRECISIONMERGE(state_col): exact re-sum of pre-aggregated
+    decimal-string partial sums (one per cube row) — the star-tree rewrite
+    of SUMPRECISION (reference SumPrecisionValueAggregator,
+    pinot-segment-local/.../aggregator/SumPrecisionValueAggregator.java:1)."""
+
+    name = "sumprecisionmerge"
+
+    @staticmethod
+    def _parse(blob):
+        import decimal
+
+        s = (bytes(blob).rstrip(b"\x00").decode("ascii")
+             if isinstance(blob, (bytes, bytearray)) else str(blob))
+        if not s:
+            return 0
+        return int(s) if ("." not in s and "E" not in s.upper()) \
+            else decimal.Decimal(s)
+
+    def host_groups(self, arg_values, group_idx, n):
+        sums = _obj_array(n, int)
+        for g, blob in zip(np.asarray(group_idx).tolist(),
+                           np.asarray(arg_values[0]).tolist()):
+            sums[g] = sums[g] + self._parse(blob)
+        return {"psum": sums}
+
+
 class IdSetSpec(DistinctCountSpec):
     """IDSET: serialized set of ids (IdSetAggregationFunction analog) —
     base64(gzip(json(sorted values))) instead of a RoaringBitmap blob.
@@ -985,6 +1053,8 @@ _SPECS = {
     "distinctcounthll": DistinctCountHLLSpec,
     "hllmerge": HllMergeSpec,
     "tdigestmerge": TDigestMergeSpec,
+    "bitmapmerge": BitmapMergeSpec,
+    "sumprecisionmerge": SumPrecisionMergeSpec,
     "distinctcountthetasketch": DistinctCountThetaSketchSpec,
     "distinctcountrawthetasketch": DistinctCountThetaSketchSpec,
     "percentile": PercentileSpec,

@@ -22,11 +22,12 @@ import numpy as np
 
 from pinot_tpu.engine.result import IntermediateResult
 from pinot_tpu.query.context import Expression, QueryContext
-from pinot_tpu.storage.startree import load_star_trees, pair_column, parse_pair
+from pinot_tpu.storage.startree import SEP, load_star_trees, pair_column, parse_pair
 
 _REWRITABLE = {"count", "sum", "min", "max", "avg", "minmaxrange",
                "distinctcounthll", "percentiletdigest", "percentile",
-               "percentileest"}
+               "percentileest", "distinctcount", "distinctcountbitmap",
+               "sumprecision"}
 
 
 def _q2_expr(fn: str, col: str, meta: dict) -> Expression:
@@ -40,11 +41,16 @@ def _q2_expr(fn: str, col: str, meta: dict) -> Expression:
         )
     if fn == "tdigestmerge":
         # p is irrelevant at merge time (the ORIGINAL agg finalizes);
-        # compression governs re-merge compaction
+        # compression governs re-merge compaction. The state column's PAIR
+        # FUNCTION (exact match on the name half, not a prefix) identifies
+        # which pair built the digests, hence which compression.
+        pair_fn = col.split(SEP, 1)[0]
+        comp = meta["tdigest_compression"] if pair_fn == "percentiletdigest" \
+            else meta["percentileest_compression"]
         return Expression.function(
             "tdigestmerge", Expression.identifier(col),
             Expression.literal(0.5),
-            Expression.literal(float(meta["tdigest_compression"])),
+            Expression.literal(float(comp)),
         )
     return Expression.function(fn, Expression.identifier(col))
 
@@ -118,18 +124,40 @@ def fit(q: QueryContext, meta: dict) -> Optional[list]:
                 [("hllmerge", pair_column("distinctcounthll", col), "state")])
             continue
         if name in ("percentiletdigest", "percentile", "percentileest"):
-            # digest pair: cube rows carry serialized t-digests, re-merged
-            # by TDIGESTMERGE — only when the digest compression matches
-            # the query's (a mismatch would silently change the error
-            # bound). All three names share the digest algebra here.
+            # digest pairs: cube rows carry serialized t-digests, re-merged
+            # by TDIGESTMERGE — only when a pair's digest compression
+            # matches the query's (a mismatch would silently change the
+            # error bound). All three names share the digest algebra; the
+            # PERCENTILETDIGEST pair serves compression-100-family queries
+            # and the PERCENTILEEST pair the PERCENTILE/EST default.
             from pinot_tpu.engine.aggspec import make_spec
 
-            if ("percentiletdigest", col) not in pairs:
-                return None
-            if meta.get("tdigest_compression") != make_spec(a).compression:
+            want = make_spec(a).compression
+            if ("percentiletdigest", col) in pairs \
+                    and meta.get("tdigest_compression") == want:
+                src = "percentiletdigest"
+            elif ("percentileest", col) in pairs \
+                    and meta.get("percentileest_compression") == want:
+                src = "percentileest"
+            else:
                 return None
             mapping.append(
-                [("tdigestmerge", pair_column("percentiletdigest", col),
+                [("tdigestmerge", pair_column(src, col), "state")])
+            continue
+        if name in ("distinctcount", "distinctcountbitmap"):
+            # exact distinct pair: serialized value sets per cube row,
+            # re-unioned by BITMAPMERGE (DistinctCountBitmapValueAggregator)
+            if ("distinctcountbitmap", col) not in pairs:
+                return None
+            mapping.append(
+                [("bitmapmerge", pair_column("distinctcountbitmap", col),
+                  "state")])
+            continue
+        if name == "sumprecision":
+            if ("sumprecision", col) not in pairs:
+                return None
+            mapping.append(
+                [("sumprecisionmerge", pair_column("sumprecision", col),
                   "state")])
             continue
         need = {

@@ -140,6 +140,8 @@ AGGREGATION_FUNCTIONS = frozenset(
         # internal: star-tree sketch-state re-merges (engine/startree_exec.py)
         "hllmerge",
         "tdigestmerge",
+        "bitmapmerge",
+        "sumprecisionmerge",
     }
 )
 

@@ -36,7 +36,8 @@ META_FILE = "startree_meta.json"
 SEP = "__"
 
 SUPPORTED_FUNCTIONS = {"sum", "count", "min", "max", "distinctcounthll",
-                       "percentiletdigest"}
+                       "percentiletdigest", "distinctcountbitmap",
+                       "percentileest", "sumprecision"}
 
 
 def parse_pair(pair: str):
@@ -79,6 +80,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
             dim_specs.append((d, meta.data_type))
         hll_log2m = None
         tdigest_compression = None
+        percentileest_compression = None
         for fn, col in pairs:
             name = pair_column(fn, col)
             if fn == "count":
@@ -103,7 +105,40 @@ def build_star_trees(segment, star_tree_configs) -> None:
                 acc = np.ascontiguousarray(
                     regs.astype(np.uint8)).view(f"S{m}").reshape(n_groups)
                 metric_specs.append((name, DataType.BYTES))
-            elif fn == "percentiletdigest":
+            elif fn == "distinctcountbitmap":
+                # exact distinct-set pre-aggregation
+                # (DistinctCountBitmapValueAggregator.java:1): one
+                # serialized VALUE set per cube row (values, not dict ids —
+                # planes in local id space could not merge across
+                # segments), re-merged at query time by BITMAPMERGE
+                from pinot_tpu.engine.aggspec import set_to_bytes
+
+                v = np.asarray(segment.values(col))
+                per_group = [set() for _ in range(n_groups)]
+                for g, x in zip(ginv.tolist(), v.tolist()):
+                    per_group[g].add(x)
+                blobs = [set_to_bytes(s) for s in per_group]
+                width = max((len(b) for b in blobs), default=2)
+                acc = np.asarray(
+                    [b.ljust(width, b"\x00") for b in blobs],
+                    dtype=f"S{width}")
+                metric_specs.append((name, DataType.BYTES))
+            elif fn == "sumprecision":
+                # exact arbitrary-precision partial sums
+                # (SumPrecisionValueAggregator.java:1): one decimal string
+                # per cube row, re-summed by SUMPRECISIONMERGE
+                from pinot_tpu.engine.aggspec import SumPrecisionSpec
+
+                v = np.asarray(segment.values(col))
+                sums = [0] * n_groups
+                for g, x in zip(ginv.tolist(), v.tolist()):
+                    sums[g] = sums[g] + SumPrecisionSpec._exact(x)
+                strs = [str(s).encode("ascii") for s in sums]
+                width = max((len(s) for s in strs), default=1)
+                acc = np.asarray(
+                    [s.ljust(width, b"\x00") for s in strs], dtype=f"S{width}")
+                metric_specs.append((name, DataType.BYTES))
+            elif fn in ("percentiletdigest", "percentileest"):
                 # digest pre-aggregation (PercentileTDigestValueAggregator):
                 # one serialized t-digest per cube row, re-merged at query
                 # time by TDIGESTMERGE. Pre-agg digests are approximate
@@ -111,11 +146,19 @@ def build_star_trees(segment, star_tree_configs) -> None:
                 # the digest's rank-error bound, not bit-exactly.
                 from pinot_tpu.ops import quantile_digest as qd
 
-                tdigest_compression = float(cfg.tdigest_compression)
-                if tdigest_compression <= 0:
-                    raise ValueError(
-                        f"tdigest_compression must be > 0, got "
-                        f"{cfg.tdigest_compression}")
+                if fn == "percentiletdigest":
+                    tdigest_compression = float(cfg.tdigest_compression)
+                    if tdigest_compression <= 0:
+                        raise ValueError(
+                            f"tdigest_compression must be > 0, got "
+                            f"{cfg.tdigest_compression}")
+                    compression = tdigest_compression
+                else:
+                    # PERCENTILEEST pair: the PERCENTILE/PERCENTILEEST
+                    # family's default digest resolution
+                    # (PercentileEstValueAggregator's QuantileDigest role)
+                    percentileest_compression = float(qd.DEFAULT_COMPRESSION)
+                    compression = percentileest_compression
                 v = np.asarray(segment.values(col), dtype=np.float64)
                 per_group = {}
                 if len(v):
@@ -126,8 +169,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
                     starts = np.concatenate([[0], bounds])
                     ends = np.concatenate([bounds, [len(gs)]])
                     for s, e in zip(starts, ends):
-                        m, w = qd.add_values([], [], vs[s:e],
-                                             tdigest_compression)
+                        m, w = qd.add_values([], [], vs[s:e], compression)
                         per_group[int(gs[s])] = qd.digest_to_bytes(m, w)
                 empty = qd.digest_to_bytes([], [])
                 blobs = [per_group.get(g, empty) for g in range(n_groups)]
@@ -168,6 +210,7 @@ def build_star_trees(segment, star_tree_configs) -> None:
                     "max_leaf_records": cfg.max_leaf_records,
                     "hll_log2m": hll_log2m,
                     "tdigest_compression": tdigest_compression,
+                    "percentileest_compression": percentileest_compression,
                 },
                 f,
             )

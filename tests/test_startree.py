@@ -43,6 +43,8 @@ def engines(tmp_path_factory):
             "SUM__revenue", "COUNT__*", "MIN__revenue", "MAX__revenue",
             "SUM__quantity", "DISTINCTCOUNTHLL__quantity",
             "PERCENTILETDIGEST__revenue",
+            "DISTINCTCOUNTBITMAP__quantity", "PERCENTILEEST__revenue",
+            "SUMPRECISION__revenue",
         ],
     )
     cfg = TableConfig(
@@ -200,3 +202,49 @@ def test_metadata_only_path(engines):
     ]
     # zero entries scanned: straight off metadata
     assert r["numEntriesScannedPostFilter"] == 0
+
+
+def test_bitmap_pair_exact(engines):
+    """DISTINCTCOUNTBITMAP / DISTINCTCOUNT pair: EXACT cube==scan equality
+    (DistinctCountBitmapValueAggregator analog), cube actually consulted."""
+    st_engine, plain_engine, _ = engines
+    for fn in ("DISTINCTCOUNTBITMAP", "DISTINCTCOUNT"):
+        sql = (f"SELECT d_year, {fn}(quantity) FROM ssb "
+               "WHERE d_region != 'AFRICA' GROUP BY d_year ORDER BY d_year")
+        a = st_engine.execute(sql)
+        b = plain_engine.execute(sql)
+        assert not a.get("exceptions"), a
+        assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
+        assert a["numDocsScanned"] < b["numDocsScanned"] / 3, (
+            fn, a["numDocsScanned"], b["numDocsScanned"])
+
+
+def test_sumprecision_pair_exact(engines):
+    """SUMPRECISION pair: exact decimal re-sum equals the scan path."""
+    st_engine, plain_engine, _ = engines
+    sql = ("SELECT d_region, SUMPRECISION(revenue) FROM ssb "
+           "GROUP BY d_region ORDER BY d_region")
+    a = st_engine.execute(sql)
+    b = plain_engine.execute(sql)
+    assert not a.get("exceptions"), a
+    assert a["resultTable"]["rows"] == b["resultTable"]["rows"]
+    assert a["numDocsScanned"] < b["numDocsScanned"] / 3
+
+
+def test_percentileest_pair(engines):
+    """PERCENTILEEST / PERCENTILE route through the second digest pair
+    (PercentileEstValueAggregator role) at the family default compression;
+    answers agree with the scan path within the digest error bound."""
+    st_engine, plain_engine, cols = engines
+    spread = float(cols["revenue"].max() - cols["revenue"].min())
+    for fn in ("PERCENTILEEST", "PERCENTILE"):
+        sql = (f"SELECT d_year, {fn}(revenue, 75) FROM ssb "
+               "GROUP BY d_year ORDER BY d_year")
+        a = st_engine.execute(sql)
+        b = plain_engine.execute(sql)
+        assert not a.get("exceptions"), a
+        assert a["numDocsScanned"] < b["numDocsScanned"] / 3, (
+            fn, a["numDocsScanned"], b["numDocsScanned"])
+        for ra, rb in zip(a["resultTable"]["rows"], b["resultTable"]["rows"]):
+            assert ra[0] == rb[0]
+            assert abs(ra[1] - rb[1]) < 0.02 * spread, (fn, ra, rb)
