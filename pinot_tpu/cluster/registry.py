@@ -132,6 +132,7 @@ class ClusterRegistry:
             "assignment": {},
             "external_view": {},
             "partition_assignment": {},
+            "leases": {},
         }
 
     # ---- tx plumbing (overridden by FileRegistry) ------------------------
@@ -164,6 +165,16 @@ class ClusterRegistry:
 
         self._tx(fn)
 
+    def expire_heartbeat(self, instance_id: str) -> None:
+        """Drop an instance from every liveness window immediately (clean
+        quorum exit: peers re-quota without waiting out the TTL)."""
+
+        def fn(s):
+            if instance_id in s["instances"]:
+                s["instances"][instance_id].last_heartbeat_ms = 0
+
+        self._tx(fn)
+
     def instances(self, role: Optional[str] = None, live_ttl_ms: Optional[int] = None):
         def fn(s):
             out = list(s["instances"].values())
@@ -173,6 +184,80 @@ class ClusterRegistry:
                 now = int(time.time() * 1000)
                 out = [i for i in out if now - i.last_heartbeat_ms <= live_ttl_ms]
             return out
+
+        return self._tx_read(fn)
+
+    # ---- leases (controller HA: Helix leader-election role) --------------
+    def try_acquire_lease(self, name: str, holder: str, ttl_ms: int) -> dict:
+        """Atomically acquire or renew a named lease: granted when free,
+        expired, or already held by ``holder``. Returns the current lease
+        ``{"holder", "expires_ms"}`` either way — callers check
+        ``lease["holder"] == holder``. This is the whole election
+        protocol: the registry tx IS the arbiter (the role ZK ephemeral
+        nodes play for Helix leader election,
+        pinot-controller/.../LeadControllerManager.java:1)."""
+        now = int(time.time() * 1000)
+
+        def fn(s):
+            leases = s.setdefault("leases", {})
+            cur = leases.get(name)
+            if cur is None or cur["holder"] == holder \
+                    or now > cur["expires_ms"]:
+                leases[name] = {"holder": holder, "expires_ms": now + ttl_ms}
+            return dict(leases[name])
+
+        return self._tx(fn)
+
+    def lease_tick(self, holder: str, wanted: list, max_held: int,
+                   ttl_ms: int, heartbeat: bool = True) -> set:
+        """ONE transaction per HA tick (N separate renewal txs would churn
+        the flock + section version once per lease): walk ``wanted`` in
+        order, renewing/acquiring until ``max_held`` leases are held, and
+        RELEASE any of ``wanted`` held beyond that — the fair-share yield
+        that lets live controllers actually split the lead partitions.
+        Callers list currently-held names first so renewal is stable.
+        Returns the names now held."""
+        now = int(time.time() * 1000)
+
+        def fn(s):
+            leases = s.setdefault("leases", {})
+            held = set()
+            for name in wanted:
+                cur = leases.get(name)
+                mine = cur is not None and cur["holder"] == holder
+                if len(held) >= max_held:
+                    if mine:
+                        leases.pop(name)  # yield the excess
+                    continue
+                if cur is None or mine or now > cur["expires_ms"]:
+                    leases[name] = {"holder": holder,
+                                    "expires_ms": now + ttl_ms}
+                    held.add(name)
+            if heartbeat and holder in s["instances"]:
+                s["instances"][holder].last_heartbeat_ms = now
+            return held
+
+        return self._tx(fn)
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Voluntary release (clean shutdown hands leadership over without
+        waiting out the TTL)."""
+
+        def fn(s):
+            cur = s.setdefault("leases", {}).get(name)
+            if cur is not None and cur["holder"] == holder:
+                s["leases"].pop(name)
+
+        self._tx(fn)
+
+    def lease_holder(self, name: str) -> Optional[str]:
+        now = int(time.time() * 1000)
+
+        def fn(s):
+            cur = s.setdefault("leases", {}).get(name)
+            if cur is None or now > cur["expires_ms"]:
+                return None
+            return cur["holder"]
 
         return self._tx_read(fn)
 
@@ -691,7 +776,7 @@ class ClusterRegistry:
 _SECTIONS = (
     "instances", "tables", "schemas", "segments", "assignment",
     "external_view", "partition_assignment", "segment_completion",
-    "tasks", "task_metadata", "segment_lineage",
+    "tasks", "task_metadata", "segment_lineage", "leases",
 )
 
 

@@ -127,7 +127,113 @@ class Controller:
         self.fs = create_fs(deep_store_dir)
         self.fs.mkdir(deep_store_dir)
         self.assigner = SegmentAssigner(registry)
+        self.controller_id = controller_id
         registry.register_instance(InstanceInfo(controller_id, Role.CONTROLLER))
+        # HA state (start_ha): which lead partitions this controller holds.
+        # HA never started → is_lead_for() says yes to everything (the
+        # single-controller deployment needs no election); HA STOPPED is a
+        # tombstone that leads NOTHING — a drained controller whose
+        # periodic loop hasn't been torn down yet must not fall back to
+        # "I lead everything" and split-brain with the survivor.
+        self._ha_thread: Optional[threading.Thread] = None
+        self._ha_stopped = False
+        self._held_partitions: set = set()
+
+    # ---- HA: lease-based leader election + lead-controller partitioning --
+    # The reference runs N controllers with Helix leader election and
+    # per-table lead-controller partitioning (pinot-controller/.../
+    # LeadControllerManager.java:1, lead-controller resource). Here the
+    # registry's atomic lease tx is the arbiter: tables hash onto
+    # LEAD_PARTITIONS lease slots; each live controller (re)acquires what
+    # it can every tick, so slots of a dead controller expire and
+    # survivors absorb them within one lease TTL. Client-initiated calls
+    # (add_table, upload_segment, rebalance) stay valid on ANY controller,
+    # exactly like the reference's REST surface — only background duties
+    # are partitioned.
+
+    LEAD_PARTITIONS = 4
+
+    @staticmethod
+    def _lead_lease_name(p: int) -> str:
+        return f"controller/lead/{p}"
+
+    def start_ha(self, lease_ttl_ms: int = 3000,
+                 interval_s: float = 0.5) -> None:
+        """Join the controller quorum: acquire/renew lead-partition leases
+        on a timer. Safe to call on every controller process; they split
+        the partitions and fail over on lease expiry."""
+        if self._ha_thread is not None:
+            return
+        self._ha_ttl_ms = lease_ttl_ms
+        self._ha_stopped = False
+        self._ha_stop = threading.Event()
+
+        def loop():
+            while not self._ha_stop.wait(interval_s):
+                try:
+                    self._ha_tick()
+                except Exception:
+                    log.exception("HA lease tick failed")
+
+        self._ha_tick()  # hold leases before the thread's first wait
+        self._ha_thread = threading.Thread(
+            target=loop, name=f"ha-{self.controller_id}", daemon=True)
+        self._ha_thread.start()
+
+    def _ha_tick(self) -> None:
+        # fair share: live controllers split the partitions (ceil so every
+        # slot has an eligible holder); a dead peer's heartbeat stales out
+        # of the live set, its quota-raised survivors absorb the expired
+        # leases. One registry tx renews/acquires/yields + heartbeats.
+        live = {i.instance_id for i in self.registry.instances(
+            Role.CONTROLLER, live_ttl_ms=max(3 * self._ha_ttl_ms, 2000))}
+        live.add(self.controller_id)
+        quota = -(-self.LEAD_PARTITIONS // len(live))
+        order = sorted(range(self.LEAD_PARTITIONS),
+                       key=lambda p: (p not in self._held_partitions, p))
+        held_names = self.registry.lease_tick(
+            self.controller_id, [self._lead_lease_name(p) for p in order],
+            quota, self._ha_ttl_ms)
+        held = {p for p in range(self.LEAD_PARTITIONS)
+                if self._lead_lease_name(p) in held_names}
+        if held != self._held_partitions:
+            log.info("controller %s lead partitions: %s -> %s",
+                     self.controller_id, sorted(self._held_partitions),
+                     sorted(held))
+        self._held_partitions = held
+
+    def stop_ha(self, release: bool = True) -> None:
+        """``release=False`` models a crash: leases stay until TTL expiry,
+        which is exactly what a standby's takeover test needs."""
+        if self._ha_thread is None:
+            return
+        self._ha_stop.set()
+        self._ha_thread.join(5)
+        self._ha_thread = None
+        self._ha_stopped = True  # tombstone: lead NOTHING from now on
+        if release:
+            for p in list(self._held_partitions):
+                self.registry.release_lease(
+                    self._lead_lease_name(p), self.controller_id)
+            # leave the quorum's liveness window too, so survivors
+            # re-quota immediately instead of waiting out the TTL
+            self.registry.expire_heartbeat(self.controller_id)
+        self._held_partitions = set()
+
+    def _ha_active(self) -> bool:
+        return self._ha_thread is not None or self._ha_stopped
+
+    def is_lead_for(self, table: str) -> bool:
+        """Does this controller own the background duties for ``table``?"""
+        if not self._ha_active():
+            return True  # HA never started: single controller leads all
+        p = zlib.crc32(table.encode("utf-8")) % self.LEAD_PARTITIONS
+        return p in self._held_partitions
+
+    def _leads_global(self) -> bool:
+        """Cluster-wide (non-table-scoped) duties run on the partition-0
+        holder only."""
+        return not self._ha_active() or 0 in self._held_partitions
 
     # ---- table lifecycle -------------------------------------------------
     def add_table(self, config: TableConfig, schema: Schema) -> None:
@@ -211,6 +317,8 @@ class Controller:
         )
         changed = {}
         for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA partitioning)
             cfg = self.registry.table_config(table)
             if cfg is None or cfg.stream is None:
                 continue
@@ -261,6 +369,8 @@ class Controller:
             if dead:
                 self.registry.scrub_instances(dead)
                 for table in self.registry.tables():
+                    if not self.is_lead_for(table):
+                        continue  # another controller leads this table (HA partitioning)
                     assign = self.registry.assignment(table)
                     if not any(dead & set(v) for v in assign.values()):
                         continue
@@ -351,6 +461,9 @@ class Controller:
         # later unwind of the OLD entry must never race with (or delete
         # segments belonging to) the new attempt.
         reverted = []
+        # no per-table lead guard here: task generation/repair run as ONE
+        # cluster-wide duty on the partition-0 holder (periodic loop), so
+        # the stale-task sweep and the lineage unwind can't split brains
         for table in self.registry.tables():
             for lid, entry in self.registry.stale_in_progress_lineage(
                     table, stale_ms).items():
@@ -385,6 +498,8 @@ class Controller:
                 by_tag.setdefault(t, []).append(i.instance_id)
         moved: dict = {}
         for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA partitioning)
             cfg = self.registry.table_config(table)
             tiers = getattr(cfg, "tiers", None) if cfg else None
             if not tiers:
@@ -464,10 +579,15 @@ class Controller:
 
         def loop():
             while not self._periodic_stop.wait(interval_s):
-                for step in (self.run_retention, self.run_realtime_repair,
-                             self.run_dim_table_replication,
-                             self.run_segment_relocation,
-                             self.run_task_generation, self.run_task_repair):
+                # table-scoped duties filter per table (is_lead_for inside
+                # their loops); cluster-wide duties run on the partition-0
+                # holder only
+                steps = [self.run_retention, self.run_realtime_repair,
+                         self.run_dim_table_replication,
+                         self.run_segment_relocation]
+                if self._leads_global():
+                    steps += [self.run_task_generation, self.run_task_repair]
+                for step in steps:
                     try:
                         step()
                     except Exception:
@@ -492,6 +612,8 @@ class Controller:
         live = {i.instance_id for i in self.assigner._live_servers()}
         fixed = []
         for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA partitioning)
             cfg = self.registry.table_config(table)
             if cfg is None or not cfg.is_dim_table:
                 continue
@@ -509,6 +631,8 @@ class Controller:
         now_ms = now_ms or int(time.time() * 1000)
         dropped = []
         for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA partitioning)
             cfg = self.registry.table_config(table)
             if cfg is None or cfg.retention_days is None:
                 continue

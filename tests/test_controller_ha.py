@@ -1,0 +1,206 @@
+"""Controller HA: lease-based leader election + lead-controller
+partitioning over the FileRegistry.
+
+Reference: N controllers with Helix leader election + per-table lead
+partitioning (pinot-controller/.../LeadControllerManager.java:1). The
+VERDICT r4 scenario: the lead controller dies MID-CONSUME; a standby
+promotes on lease expiry; the next segment still commits; broker/server
+sessions survive the failover.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import FileRegistry, Role, SegmentState
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.stream.memory_stream import TopicRegistry
+
+
+def wait_until(cond, timeout=12.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_lease_acquire_renew_expire(tmp_path):
+    reg = FileRegistry(str(tmp_path / "reg"))
+    a = reg.try_acquire_lease("x", "A", 400)
+    assert a["holder"] == "A"
+    # B cannot steal an unexpired lease
+    assert reg.try_acquire_lease("x", "B", 400)["holder"] == "A"
+    # A renews (expiry extends)
+    a2 = reg.try_acquire_lease("x", "A", 400)
+    assert a2["holder"] == "A" and a2["expires_ms"] >= a["expires_ms"]
+    time.sleep(0.5)
+    # expired: B takes it
+    assert reg.try_acquire_lease("x", "B", 400)["holder"] == "B"
+    assert reg.lease_holder("x") == "B"
+    # voluntary release frees it immediately
+    reg.release_lease("x", "B")
+    assert reg.lease_holder("x") is None
+    # release by a non-holder is a no-op
+    reg.try_acquire_lease("x", "A", 400)
+    reg.release_lease("x", "B")
+    assert reg.lease_holder("x") == "A"
+
+
+def test_partition_split_and_clean_handover(tmp_path):
+    """Two LIVE controllers split the lead partitions (fair-share quota,
+    not a monopoly); a clean shutdown hands the rest over without waiting
+    out the TTL."""
+    reg = FileRegistry(str(tmp_path / "reg"))
+    a = Controller(reg, str(tmp_path / "dsA"), controller_id="ctrl_a")
+    b = Controller(reg, str(tmp_path / "dsB"), controller_id="ctrl_b")
+    a.start_ha(lease_ttl_ms=1200, interval_s=0.1)
+    b.start_ha(lease_ttl_ms=1200, interval_s=0.1)
+    everything = set(range(Controller.LEAD_PARTITIONS))
+
+    def split_evenly():
+        return (a._held_partitions | b._held_partitions == everything
+                and not (a._held_partitions & b._held_partitions)
+                and len(a._held_partitions) == len(b._held_partitions) == 2)
+
+    assert wait_until(split_evenly, timeout=3), (
+        a._held_partitions, b._held_partitions)
+    # every table has exactly ONE lead
+    for t in ("t1", "t2", "some_table_REALTIME"):
+        assert a.is_lead_for(t) != b.is_lead_for(t)
+    a.stop_ha(release=True)  # clean handover: leases released, not expired
+    assert wait_until(lambda: b._held_partitions == everything, timeout=3)
+    assert b.is_lead_for("any_table")
+    # the drained controller is a tombstone, NOT back to lead-everything
+    # (split-brain guard): its duty loops skip every table
+    assert not a.is_lead_for("any_table") and not a._leads_global()
+    b.stop_ha()
+
+
+def test_failover_mid_consume(tmp_path):
+    """The full VERDICT scenario on a durable FileRegistry."""
+    TopicRegistry.delete("ha_clicks")
+    topic = TopicRegistry.create("ha_clicks", 1)
+    reg = FileRegistry(str(tmp_path / "reg"))
+    lead = Controller(reg, str(tmp_path / "ds"), controller_id="ctrl_lead")
+    standby = Controller(reg, str(tmp_path / "ds"), controller_id="ctrl_standby")
+    lead.start_ha(lease_ttl_ms=800, interval_s=0.1)
+    standby.start_ha(lease_ttl_ms=800, interval_s=0.1)
+    lead.start_periodic_tasks(interval_s=0.3)
+    standby.start_periodic_tasks(interval_s=0.3)
+    server = ServerInstance("srv0", reg, str(tmp_path / "srv0"),
+                            device_executor=None)
+    server.start()
+    broker = Broker(reg, timeout_s=10.0)
+    try:
+        schema = Schema.build(name="ha_clicks",
+                              dimensions=[("page", DataType.STRING)],
+                              metrics=[("n", DataType.INT)])
+        cfg = TableConfig(
+            table_name="ha_clicks", table_type=TableType.REALTIME,
+            stream=StreamConfig(
+                stream_type="memory", topic="ha_clicks", decoder="json",
+                segment_flush_threshold_rows=50,
+                segment_flush_threshold_seconds=3600,
+            ),
+        )
+        lead.add_table(cfg, schema)
+        # live controllers split the partitions; exactly one leads the table
+        assert wait_until(
+            lambda: lead._held_partitions | standby._held_partitions
+            == set(range(Controller.LEAD_PARTITIONS)), timeout=3)
+        assert lead.is_lead_for("ha_clicks_REALTIME") \
+            != standby.is_lead_for("ha_clicks_REALTIME")
+
+        def publish(n0, n1):
+            for i in range(n0, n1):
+                topic.publish_json({"page": f"p{i % 4}", "n": 1}, partition=0)
+
+        def broker_count():
+            r = broker.execute("SELECT COUNT(*) FROM ha_clicks")
+            return -1 if r.get("exceptions") else r["resultTable"]["rows"][0][0]
+
+        def online_segments():
+            return sum(1 for rec in reg.segments("ha_clicks_REALTIME").values()
+                       if rec.state == SegmentState.ONLINE)
+
+        # consume begins; one segment commits under the original lead
+        publish(0, 80)
+        assert wait_until(lambda: broker_count() == 80), broker_count()
+        assert wait_until(lambda: online_segments() >= 1)
+
+        # the lead crashes MID-CONSUME (no lease release, no cleanup)
+        lead.stop_ha(release=False)
+        lead.stop_periodic_tasks()
+
+        # the standby absorbs every partition within ~one TTL
+        assert wait_until(
+            lambda: standby._held_partitions
+            == set(range(Controller.LEAD_PARTITIONS)), timeout=5), \
+            standby._held_partitions
+        assert standby.is_lead_for("ha_clicks_REALTIME")
+
+        # the NEXT segment still commits after the failover
+        before = online_segments()
+        publish(80, 200)
+        assert wait_until(lambda: broker_count() == 200, timeout=15), \
+            broker_count()
+        assert wait_until(lambda: online_segments() > before, timeout=15)
+
+        # broker + server sessions survived: full query path still green
+        r = broker.execute("SELECT page, COUNT(*) FROM ha_clicks "
+                           "GROUP BY page ORDER BY page")
+        assert not r.get("exceptions"), r
+        assert [row[1] for row in r["resultTable"]["rows"]] == [50] * 4
+
+        # background duties run under the new lead (retention sweep works)
+        assert standby.run_retention() == []
+    finally:
+        broker.close()
+        server.stop()
+        standby.stop_periodic_tasks()
+        standby.stop_ha()
+        TopicRegistry.delete("ha_clicks")
+
+
+def test_duties_partition_between_live_controllers(tmp_path):
+    """With HA on, a controller that leads NO partition of a table skips
+    its background duties for it (lead-controller partitioning, not just
+    failover)."""
+    reg = FileRegistry(str(tmp_path / "reg"))
+    a = Controller(reg, str(tmp_path / "dsA"), controller_id="ctrl_a")
+    a.start_ha(lease_ttl_ms=2000, interval_s=0.2)
+    # ctrl_b never ticks: it holds nothing, so its duty loops are no-ops
+    b = Controller(reg, str(tmp_path / "dsB"), controller_id="ctrl_b")
+    b._ha_thread = object()  # HA "on" without a tick loop → leads nothing
+    try:
+        schema = Schema.build(name="old", dimensions=[("k", DataType.STRING)],
+                              metrics=[("v", DataType.INT)])
+        a.add_table(TableConfig(table_name="old", retention_days=1), schema)
+        import numpy as np
+
+        from pinot_tpu.storage.creator import build_segment
+
+        d = str(tmp_path / "seg")
+        build_segment(schema, {"k": np.array(["x"]),
+                               "v": np.array([1], dtype=np.int32)}, d,
+                      segment_name="old_s0")
+        # no servers: upload only records the segment + location
+        reg.add_segment_record = getattr(reg, "add_segment_record", None)
+        from pinot_tpu.cluster.registry import SegmentRecord
+
+        reg.add_segment(SegmentRecord(
+            name="old_s0", table="old_OFFLINE", n_docs=1, location=d,
+            state=SegmentState.ONLINE, start_time=0, end_time=1), [])
+        assert b.run_retention() == []  # not the lead: skips the table
+        assert ("old_OFFLINE", "old_s0") in a.run_retention()
+    finally:
+        b._ha_thread = None
+        a.stop_ha()
