@@ -121,6 +121,7 @@ class RealtimePartitionManager:
         fetch_timeout_ms: int = 100,
         idle_sleep_s: float = 0.02,
         completion=None,  # SegmentCompletionClient for multi-replica commit
+        peer_fetch=None,  # (segment_name, dest_dir) -> path; deep-store-down fallback
     ):
         self.table = table
         self.schema = schema
@@ -143,6 +144,7 @@ class RealtimePartitionManager:
         self.fetch_timeout_ms = fetch_timeout_ms
         self.idle_sleep_s = idle_sleep_s
         self.completion = completion
+        self.peer_fetch = peer_fetch
         self.adoptions = 0
 
         stream = table_config.stream
@@ -328,7 +330,17 @@ class RealtimePartitionManager:
         from pinot_tpu.realtime.completion import adopt_segment
         from pinot_tpu.storage.segment import ImmutableSegment
 
-        local = adopt_segment(entry, self.segment_dir)
+        try:
+            local = adopt_segment(entry, self.segment_dir)
+        except OSError:
+            # the winner's published location is unreachable (deep store /
+            # shared FS down): fetch from a serving replica over the data
+            # plane instead (PeerServerSegmentFinder role, server/peer.py)
+            if self.peer_fetch is None:
+                raise
+            local = self.peer_fetch(
+                entry["segment"],
+                os.path.join(self.segment_dir, entry["segment"]))
         sealed = ImmutableSegment(local)
         self._offset = StreamPartitionMsgOffset.from_string(entry["offset"])
         self.checkpoint.record_commit(
@@ -346,7 +358,8 @@ class RealtimeTableDataManager:
     immediately queryable."""
 
     def __init__(self, schema: Schema, table_config: TableConfig,
-                 engine_table, data_dir: str, completion_client=None):
+                 engine_table, data_dir: str, completion_client=None,
+                 peer_fetch=None):
         if table_config.stream is None:
             raise ValueError("realtime table needs a stream config")
         self.schema = schema
@@ -360,6 +373,7 @@ class RealtimeTableDataManager:
         self._factory = create_consumer_factory(table_config.stream)
         self._decoder = get_decoder(table_config.stream.decoder, table_config.stream)
         self.completion = completion_client  # multi-replica commit FSM
+        self.peer_fetch = peer_fetch  # deep-store-down adopt fallback
         self._on_commit_cb = None
         self._on_consuming_cb = None
 
@@ -401,6 +415,7 @@ class RealtimeTableDataManager:
             on_committed_segment=self._on_committed,
             upsert_manager=upsert,
             completion=self.completion,
+            peer_fetch=self.peer_fetch,
         )
         self.partition_managers[p] = mgr
         mgr.start()

@@ -90,12 +90,16 @@ class ServerInstance:
             from pinot_tpu.common.tls import TlsConfig
 
             tls = TlsConfig.from_config()
+        from pinot_tpu.server.peer import serve_segment_tar
+
         self.transport = QueryServerTransport(
             self._handle_submit, host=host, port=port,
             max_workers=max_concurrent_queries + max_queued_queries + 2,
             submit_streaming_fn=self._handle_submit_streaming,
+            fetch_segment_fn=lambda req: serve_segment_tar(self, req),
             tls=tls,
         )
+        self._tls = tls
         self.sync_interval_s = sync_interval_s
         if scheduler_name is None:
             # config-selected like the reference's
@@ -376,7 +380,19 @@ class ServerInstance:
             shutil.copytree(src, tmp)
         except Exception:
             shutil.rmtree(tmp, ignore_errors=True)
-            raise
+            if os.path.isdir(src):
+                # source readable → the failure is LOCAL (disk full,
+                # permissions): surface it loudly instead of
+                # misdiagnosing it as deep-store-down and re-failing
+                # the same way after a network download
+                raise
+            # deep store unreachable: fall back to a serving replica
+            # (PeerServerSegmentFinder role — server/peer.py); the peer's
+            # tar lands in the same CRC-versioned dir the copy would have
+            from pinot_tpu.server.peer import peer_download
+
+            return peer_download(self.registry, table, rec.name, local,
+                                 self.instance_id, tls=self._tls)
         if os.path.isdir(local):  # another loader won the copy race
             shutil.rmtree(tmp, ignore_errors=True)
         else:
@@ -495,6 +511,8 @@ class ServerInstance:
                     completion_client=SegmentCompletionClient(
                         self.registry, table, self.instance_id
                     ),
+                    peer_fetch=lambda seg, dest, _t=table:
+                        self._peer_fetch(_t, seg, dest),
                 )
                 # callbacks publish under the PHYSICAL registry key
                 # (clicks_REALTIME), not the raw table name the manager carries
@@ -511,6 +529,23 @@ class ServerInstance:
                         mgr.add_partition(p)
                 for p in current - set(mine):
                     mgr.stop_partition(p)
+
+    def _peer_fetch(self, table: str, segment_name: str, dest_dir: str) -> str:
+        """Adopt-path fallback when the winner's published location is
+        unreachable: download from a serving replica. Retries briefly —
+        the external view can lag the winner's publish by a sync tick."""
+        from pinot_tpu.server.peer import peer_download
+
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                return peer_download(self.registry, table, segment_name,
+                                     dest_dir, self.instance_id,
+                                     tls=self._tls)
+            except Exception:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.3)
 
     def _publish_consuming(self, table: str, partition: int, segment) -> None:
         """Consuming segments are routable (brokers send them queries while

@@ -24,6 +24,10 @@ import grpc
 
 SUBMIT_METHOD = "/pinot.PinotQueryServer/Submit"
 SUBMIT_STREAMING_METHOD = "/pinot.PinotQueryServer/SubmitStreaming"
+# peer segment download (PeerServerSegmentFinder role): a server streams a
+# tar of a segment dir it serves to a replica whose deep-store copy is
+# unreachable
+FETCH_SEGMENT_METHOD = "/pinot.PinotQueryServer/FetchSegment"
 
 
 def make_instance_request(sql: str, segments: list, request_id: int,
@@ -51,9 +55,11 @@ def parse_instance_request(data: bytes) -> dict:
 
 class _BytesHandler(grpc.GenericRpcHandler):
     def __init__(self, submit_fn: Callable[[bytes], bytes],
-                 submit_streaming_fn: Optional[Callable] = None):
+                 submit_streaming_fn: Optional[Callable] = None,
+                 fetch_segment_fn: Optional[Callable] = None):
         self._submit = submit_fn
         self._submit_streaming = submit_streaming_fn
+        self._fetch_segment = fetch_segment_fn
 
     def service(self, handler_call_details):
         if handler_call_details.method == SUBMIT_METHOD:
@@ -71,6 +77,14 @@ class _BytesHandler(grpc.GenericRpcHandler):
                 request_deserializer=None,
                 response_serializer=None,
             )
+        if (handler_call_details.method == FETCH_SEGMENT_METHOD
+                and self._fetch_segment is not None):
+            # server-streaming tar chunks of a hosted segment dir
+            return grpc.unary_stream_rpc_method_handler(
+                lambda req, ctx: self._fetch_segment(req),
+                request_deserializer=None,
+                response_serializer=None,
+            )
         return None
 
 
@@ -79,10 +93,12 @@ class QueryServerTransport:
 
     def __init__(self, submit_fn: Callable[[bytes], bytes],
                  host: str = "127.0.0.1", port: int = 0, max_workers: int = 8,
-                 submit_streaming_fn: Optional[Callable] = None, tls=None):
+                 submit_streaming_fn: Optional[Callable] = None, tls=None,
+                 fetch_segment_fn: Optional[Callable] = None):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
-            handlers=(_BytesHandler(submit_fn, submit_streaming_fn),),
+            handlers=(_BytesHandler(submit_fn, submit_streaming_fn,
+                                    fetch_segment_fn),),
         )
         if tls is not None:
             # TlsConfig (common/tls.py) — the reference's Netty/gRPC TLS
@@ -124,9 +140,17 @@ class QueryRouterChannel:
             SUBMIT_STREAMING_METHOD, request_serializer=None,
             response_deserializer=None,
         )
+        self._fetch_segment = self._channel.unary_stream(
+            FETCH_SEGMENT_METHOD, request_serializer=None,
+            response_deserializer=None,
+        )
 
     def submit(self, request: bytes, timeout_s: float) -> bytes:
         return self._submit(request, timeout=timeout_s)
+
+    def fetch_segment(self, request: bytes, timeout_s: float):
+        """Peer segment download: iterator of tar chunks."""
+        return self._fetch_segment(request, timeout=timeout_s)
 
     def submit_streaming(self, request: bytes, timeout_s: float):
         """Returns the gRPC response iterator (also a Call: the consumer

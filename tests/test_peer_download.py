@@ -1,0 +1,166 @@
+"""Peer segment download when the deep store is unreachable.
+
+Reference: PeerServerSegmentFinder
+(pinot-core/.../util/PeerServerSegmentFinder.java:1) +
+PeerDownloadLLCRealtimeClusterIntegrationTest (deep-store-less commit).
+"""
+
+import os
+import shutil
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry, SegmentState
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.stream.memory_stream import TopicRegistry
+
+
+def wait_until(cond, timeout=12.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_offline_download_falls_back_to_peer(tmp_path):
+    """A replica whose deep-store copy vanished loads the segment from the
+    serving peer over the FetchSegment data plane."""
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    a = ServerInstance("srv_a", registry, str(tmp_path / "a"),
+                       device_executor=None)
+    a.start()
+    broker = Broker(registry, timeout_s=10.0)
+    b = None
+    try:
+        schema = Schema.build(name="ev", dimensions=[("k", DataType.STRING)],
+                              metrics=[("v", DataType.INT)])
+        cfg = TableConfig(table_name="ev", replication=2)
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(2)
+        cols = {"k": np.array(["x", "y"])[rng.integers(0, 2, 5000)],
+                "v": rng.integers(0, 9, 5000).astype(np.int32)}
+        d = str(tmp_path / "up")
+        build_segment(schema, cols, d, cfg, "ev_s0")
+        controller.upload_segment("ev", d)
+        assert wait_until(
+            lambda: "ev_s0" in a.engine.tables.get("ev_OFFLINE",
+                                                   _Empty()).segments)
+
+        # the deep store burns down AFTER server A loaded its copy
+        rec = registry.segments("ev_OFFLINE")["ev_s0"]
+        shutil.rmtree(rec.location)
+        assert not os.path.isdir(rec.location)
+
+        # a second replica joins: its deep-store copy MUST fail, and the
+        # peer path must serve the segment from A
+        b = ServerInstance("srv_b", registry, str(tmp_path / "b"),
+                           device_executor=None)
+        b.start()
+        controller.rebalance("ev")
+        assert wait_until(
+            lambda: "ev_s0" in b.engine.tables.get("ev_OFFLINE",
+                                                   _Empty()).segments,
+            timeout=15), registry.assignment("ev_OFFLINE")
+
+        # stop A: the peer-downloaded copy on B answers alone
+        a.stop()
+        assert wait_until(lambda: _count(broker) == 5000, timeout=10), \
+            _count(broker)
+    finally:
+        broker.close()
+        for s in (a, b):
+            if s is not None:
+                try:
+                    s.stop()
+                except Exception:
+                    pass
+
+
+class _Empty:
+    segments: dict = {}
+
+
+def _count(broker):
+    r = broker.execute("SELECT COUNT(*) FROM ev")
+    return -1 if r.get("exceptions") else r["resultTable"]["rows"][0][0]
+
+
+def test_realtime_adopt_falls_back_to_peer(tmp_path, monkeypatch):
+    """The commit-loser replica adopts via peer download when the winner's
+    published location is unreachable (deep store down mid-commit)."""
+    import pinot_tpu.realtime.completion as completion_mod
+
+    TopicRegistry.delete("pd_clicks")
+    topic = TopicRegistry.create("pd_clicks", 1)
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    servers = [ServerInstance(f"s{i}", registry, str(tmp_path / f"srv{i}"),
+                              device_executor=None) for i in range(2)]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=10.0)
+
+    # deep store down: every direct copy of a committed segment dir fails,
+    # so the loser MUST ride the peer data plane
+    def broken_adopt(entry, dest_dir):
+        raise OSError("deep store unreachable (fault injection)")
+
+    monkeypatch.setattr(completion_mod, "adopt_segment", broken_adopt)
+    try:
+        schema = Schema.build(name="pd_clicks",
+                              dimensions=[("page", DataType.STRING)],
+                              metrics=[("n", DataType.INT)])
+        cfg = TableConfig(
+            table_name="pd_clicks", table_type=TableType.REALTIME,
+            replication=2,
+            stream=StreamConfig(
+                stream_type="memory", topic="pd_clicks", decoder="json",
+                segment_flush_threshold_rows=60,
+                segment_flush_threshold_seconds=3600,
+            ),
+        )
+        controller.add_table(cfg, schema)
+
+        def adoption_counts():
+            total = 0
+            for s in servers:
+                mgr = s._realtime_managers.get("pd_clicks_REALTIME")
+                if mgr:
+                    total += sum(pm.adoptions
+                                 for pm in mgr.partition_managers.values())
+            return total
+
+        def count():
+            r = broker.execute("SELECT COUNT(*) FROM pd_clicks")
+            return -1 if r.get("exceptions") else r["resultTable"]["rows"][0][0]
+
+        # two waves → two commit rounds; each round's loser can only adopt
+        # through the peer data plane (direct adopt is fault-injected)
+        for wave in (1, 2):
+            for i in range(150):
+                topic.publish_json({"page": f"p{i % 3}", "n": 1}, partition=0)
+            assert wait_until(lambda: adoption_counts() >= wave, timeout=20), \
+                (wave, adoption_counts())
+            assert wait_until(lambda: count() == 150 * wave, timeout=10), \
+                (wave, count())
+        assert any(rec.state == SegmentState.ONLINE
+                   for rec in registry.segments("pd_clicks_REALTIME").values())
+    finally:
+        broker.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        TopicRegistry.delete("pd_clicks")
