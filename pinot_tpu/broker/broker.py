@@ -201,6 +201,29 @@ class Broker:
 
         t0 = time.time()
         self.metrics.count("queries")
+        if sql.strip().rstrip(";").strip().upper() == "SHOW TABLES":
+            # catalog surface for standards clients (the JDBC driver's
+            # DatabaseMetaData.getTables role, backed by the controller's
+            # /tables REST in the reference): logical names, type suffix
+            # stripped, hybrid halves collapsed
+            names = sorted({
+                t[: -len(suffix)] if t.endswith(suffix) else t
+                for t in self.registry.tables()
+                for suffix in ("_OFFLINE", "_REALTIME")
+                if t.endswith(suffix)
+            } | {t for t in self.registry.tables()
+                 if not t.endswith(("_OFFLINE", "_REALTIME"))})
+            return {
+                "resultTable": {
+                    "dataSchema": {"columnNames": ["tableName"],
+                                   "columnDataTypes": ["STRING"]},
+                    "rows": [[n] for n in names],
+                },
+                "exceptions": [],
+                "numDocsScanned": 0,
+                "totalDocs": 0,
+                "timeUsedMs": round((time.time() - t0) * 1000, 3),
+            }
         tracer = None
         try:
             q = optimize_query(compile_query(sql))

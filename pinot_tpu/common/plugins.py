@@ -92,6 +92,9 @@ class PluginRegistry:
         from pinot_tpu.storage import adlsfs as _adlsfs
 
         self.register("fs", "abfss", _adlsfs.AdlsFS)  # gated on azure sdk
+        from pinot_tpu.storage import hdfsfs as _hdfsfs
+
+        self.register("fs", "hdfs", _hdfsfs.HdfsFS)  # WebHDFS REST (stdlib)
         for name, cls in _stream._FACTORIES.items():
             self.register("stream", name, cls)
         for name, fn in _stream._DECODERS.items():

@@ -132,6 +132,27 @@ def get_decoder(name: str, stream_config: StreamConfig) -> Callable:
                 "avro decoder needs the writer schema in stream "
                 "properties['avro.schema']")
         return binary_decoder_for(schema_json)
+    if name == "thrift":
+        # TBinaryProtocol struct records (ThriftRecordReader role); the
+        # field-id → column map plays the generated class's part
+        from pinot_tpu.ingestion.thrift_io import binary_decoder_for as thrift_for
+
+        fmap = stream_config.properties.get("thrift.field.map", "")
+        return thrift_for(fmap)
+    if name == "confluent-avro":
+        # magic byte + schema-registry id framing
+        # (KafkaConfluentSchemaRegistryAvroMessageDecoder role)
+        from pinot_tpu.ingestion.confluent_avro import ConfluentAvroDecoder
+
+        inline = {
+            k[len("schema.registry.schemas."):]: v
+            for k, v in stream_config.properties.items()
+            if k.startswith("schema.registry.schemas.")
+        }
+        return ConfluentAvroDecoder(
+            registry_url=stream_config.properties.get(
+                "schema.registry.url", ""),
+            inline_schemas=inline or None)
     if name == "protobuf":
         # one serialized message per payload (ProtoBufMessageDecoder)
         from pinot_tpu.ingestion.protobuf_io import binary_decoder_for
@@ -172,6 +193,8 @@ def create_consumer_factory(config: StreamConfig) -> StreamConsumerFactory:
         from pinot_tpu.stream import kafka_stream  # noqa: F401  (registers)
     if config.stream_type == "kinesis" and "kinesis" not in _FACTORIES:
         from pinot_tpu.stream import kinesis_stream  # noqa: F401  (registers)
+    if config.stream_type == "pulsar" and "pulsar" not in _FACTORIES:
+        from pinot_tpu.stream import pulsar_stream  # noqa: F401  (registers)
     try:
         cls = _FACTORIES[config.stream_type]
     except KeyError:

@@ -1,0 +1,524 @@
+"""Plugin/client tail: pulsar stream (faked client), thrift +
+confluent-avro input formats, WebHDFS filesystem (faked REST), the
+SQLAlchemy dialect (faked sqlalchemy), and SHOW TABLES.
+
+Reference analogs: pinot-plugins/pinot-stream-ingestion/pinot-pulsar,
+pinot-input-format/pinot-thrift + pinot-confluent-avro,
+pinot-file-system/pinot-hdfs, pinot-clients/pinot-jdbc-client.
+"""
+
+import json
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.table_config import StreamConfig
+
+
+# ---------------------------------------------------------------------------
+# thrift input format
+# ---------------------------------------------------------------------------
+
+
+def test_thrift_roundtrip():
+    from pinot_tpu.ingestion.thrift_io import (
+        binary_decoder_for,
+        encode_record,
+        parse_field_map,
+    )
+
+    fmap = parse_field_map("1:name, 2:age, 3:score, 4:tags")
+    assert fmap == {1: ("name", False), 2: ("age", False),
+                    3: ("score", False), 4: ("tags", False)}
+    row = {"name": "ann", "age": 41, "score": 2.5, "tags": ["x", "y"]}
+    payload = encode_record(row, fmap)
+    decode = binary_decoder_for("1:name,2:age,3:score,4:tags")
+    assert decode(payload) == row
+
+
+def test_thrift_binary_annotation_is_type_stable():
+    """#bytes-annotated fields stay bytes even when the payload happens to
+    be valid UTF-8 (content-dependent str-or-bytes would be type-unstable
+    within one column)."""
+    from pinot_tpu.ingestion.thrift_io import binary_decoder_for, encode_record
+
+    payload = encode_record({"s": "text", "b": b"abc"}, {1: "s", 2: "b"})
+    out = binary_decoder_for("1:s,2:b#bytes")(payload)
+    assert out == {"s": "text", "b": b"abc"}
+    assert isinstance(out["b"], bytes) and isinstance(out["s"], str)
+
+
+def test_thrift_skips_undeclared_fields():
+    from pinot_tpu.ingestion.thrift_io import binary_decoder_for, encode_record
+
+    payload = encode_record({"a": 1, "b": "keep", "c": 9.5},
+                            {1: "a", 2: "b", 3: "c"})
+    # decoder only declares field 2: others are consumed, not surfaced
+    assert binary_decoder_for("2:b")(payload) == {"b": "keep"}
+
+
+def test_thrift_stream_decoder_registration():
+    from pinot_tpu.stream.spi import get_decoder
+
+    cfg = StreamConfig(stream_type="memory", topic="t", decoder="thrift",
+                       properties={"thrift.field.map": "1:k,2:v"})
+    from pinot_tpu.ingestion.thrift_io import encode_record
+
+    d = get_decoder("thrift", cfg)
+    assert d(encode_record({"k": "a", "v": 7}, {1: "k", 2: "v"})) \
+        == {"k": "a", "v": 7}
+
+
+def test_thrift_truncated_raises():
+    from pinot_tpu.ingestion.thrift_io import binary_decoder_for, encode_record
+
+    payload = encode_record({"a": "hello"}, {1: "a"})
+    with pytest.raises(EOFError):
+        binary_decoder_for("1:a")(payload[:-3])
+
+
+# ---------------------------------------------------------------------------
+# confluent-avro input format
+# ---------------------------------------------------------------------------
+
+SCHEMA = {"type": "record", "name": "r", "fields": [
+    {"name": "k", "type": "string"}, {"name": "v", "type": "long"}]}
+
+
+def test_confluent_avro_inline_schema():
+    from pinot_tpu.ingestion.confluent_avro import (
+        ConfluentAvroDecoder,
+        encode_confluent,
+    )
+
+    dec = ConfluentAvroDecoder(inline_schemas={7: json.dumps(SCHEMA)})
+    msg = encode_confluent(7, SCHEMA, {"k": "x", "v": 42})
+    assert dec(msg) == {"k": "x", "v": 42}
+    with pytest.raises(ValueError):
+        dec(b"\x01junk")  # wrong magic
+    with pytest.raises(KeyError):
+        dec(encode_confluent(8, SCHEMA, {"k": "x", "v": 1}))  # unknown id
+
+
+def test_confluent_avro_registry_fetch(monkeypatch):
+    import urllib.request
+
+    from pinot_tpu.ingestion import confluent_avro as ca
+
+    class FakeResp:
+        def __init__(self, body):
+            self.body = body
+
+        def read(self):
+            return json.dumps(self.body).encode()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    calls = []
+
+    def fake_urlopen(url, timeout=None):
+        calls.append(url)
+        return FakeResp({"schema": json.dumps(SCHEMA)})
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    dec = ca.ConfluentAvroDecoder(registry_url="http://reg:8081")
+    msg = ca.encode_confluent(11, SCHEMA, {"k": "y", "v": 5})
+    assert dec(msg) == {"k": "y", "v": 5}
+    assert dec(msg) == {"k": "y", "v": 5}  # cached: one fetch only
+    assert calls == ["http://reg:8081/schemas/ids/11"]
+
+
+def test_confluent_decoder_registration():
+    from pinot_tpu.stream.spi import get_decoder
+
+    cfg = StreamConfig(
+        stream_type="memory", topic="t", decoder="confluent-avro",
+        properties={"schema.registry.schemas.3": json.dumps(SCHEMA)})
+    from pinot_tpu.ingestion.confluent_avro import encode_confluent
+
+    d = get_decoder("confluent-avro", cfg)
+    assert d(encode_confluent(3, SCHEMA, {"k": "z", "v": 9})) \
+        == {"k": "z", "v": 9}
+
+
+# ---------------------------------------------------------------------------
+# pulsar stream plugin (faked pulsar module)
+# ---------------------------------------------------------------------------
+
+
+class FakeMessageId:
+    earliest = "EARLIEST"
+
+    def __init__(self, partition, ledger, entry, batch):
+        self._l, self._e, self._b = ledger, entry, batch
+
+    def ledger_id(self):
+        return self._l
+
+    def entry_id(self):
+        return self._e
+
+    def batch_index(self):
+        return self._b
+
+
+class FakeMsg:
+    def __init__(self, mid, payload):
+        self._mid, self._payload = mid, payload
+
+    def message_id(self):
+        return self._mid
+
+    def data(self):
+        return self._payload
+
+    def partition_key(self):
+        return ""
+
+    def publish_timestamp(self):
+        return 1234
+
+
+class FakeReader:
+    """Reads from the LIVE FakeClient.msgs list (a real pulsar reader
+    streams messages published after it was created)."""
+
+    def __init__(self, start, inclusive):
+        from pinot_tpu.stream.pulsar_stream import pack_message_id
+
+        if start == "EARLIEST":
+            self._lo = -1
+        else:
+            self._lo = pack_message_id(start._l, start._e, start._b)
+            if inclusive:
+                self._lo -= 1
+
+    def read_next(self, timeout_millis=None):
+        from pinot_tpu.stream.pulsar_stream import pack_message_id
+
+        pending = sorted(
+            (pack_message_id(m.message_id()._l, m.message_id()._e,
+                             m.message_id()._b), m)
+            for m in FakeClient.msgs
+            if pack_message_id(m.message_id()._l, m.message_id()._e,
+                               m.message_id()._b) > self._lo)
+        if not pending:
+            raise TimeoutError("no more")
+        packed, m = pending[0]
+        self._lo = packed
+        return m
+
+    def close(self):
+        pass
+
+
+class FakeClient:
+    msgs: list = []
+
+    def __init__(self, url, **kw):
+        pass
+
+    def get_topic_partitions(self, topic):
+        return [topic]
+
+    def create_reader(self, topic, start, start_message_id_inclusive=False):
+        return FakeReader(start, start_message_id_inclusive)
+
+    def close(self):
+        pass
+
+
+def test_pulsar_plugin(monkeypatch):
+    fake = types.ModuleType("pulsar")
+    fake.Client = FakeClient
+    fake.MessageId = FakeMessageId
+    monkeypatch.setitem(sys.modules, "pulsar", fake)
+
+    from pinot_tpu.stream.pulsar_stream import (
+        PulsarConsumerFactory,
+        pack_message_id,
+        unpack_message_id,
+    )
+    from pinot_tpu.stream.spi import StreamPartitionMsgOffset
+
+    # packing round-trips and orders like MessageId comparison
+    assert unpack_message_id(pack_message_id(5, 100, 2)) == (5, 100, 2)
+    assert unpack_message_id(pack_message_id(5, 100, -1)) == (5, 100, -1)
+    assert pack_message_id(5, 100, -1) < pack_message_id(5, 100, 0)
+    assert pack_message_id(5, 999, 3) < pack_message_id(6, 0, -1)
+
+    FakeClient.msgs = [
+        FakeMsg(FakeMessageId(-1, 1, i, -1), json.dumps({"i": i}).encode())
+        for i in range(5)
+    ]
+    cfg = StreamConfig(stream_type="pulsar", topic="t", decoder="json")
+    factory = PulsarConsumerFactory(cfg)
+    assert factory.partition_count() == 1
+    consumer = factory.create_partition_consumer(0)
+    batch = consumer.fetch_messages(StreamPartitionMsgOffset(0), 100)
+    assert len(batch) == 5
+    # resume from next_offset: nothing new
+    again = consumer.fetch_messages(batch.next_offset, 100)
+    assert len(again) == 0
+    # publish more; resume picks up only the new ones
+    FakeClient.msgs.append(
+        FakeMsg(FakeMessageId(-1, 2, 0, -1), b'{"i": 99}'))
+    more = consumer.fetch_messages(batch.next_offset, 100)
+    assert len(more) == 1 and json.loads(more.messages[0].payload)["i"] == 99
+
+
+def test_pulsar_gating_error():
+    import builtins
+
+    real_import = builtins.__import__
+
+    def no_pulsar(name, *a, **k):
+        if name == "pulsar":
+            raise ImportError("nope")
+        return real_import(name, *a, **k)
+
+    sys.modules.pop("pulsar", None)
+    builtins.__import__ = no_pulsar
+    try:
+        from pinot_tpu.stream.pulsar_stream import PulsarConsumerFactory
+
+        cfg = StreamConfig(stream_type="pulsar", topic="t", decoder="json")
+        with pytest.raises(RuntimeError, match="pulsar-client"):
+            PulsarConsumerFactory(cfg).partition_count()
+    finally:
+        builtins.__import__ = real_import
+
+
+# ---------------------------------------------------------------------------
+# WebHDFS filesystem (faked REST endpoints)
+# ---------------------------------------------------------------------------
+
+
+def test_hdfs_fs(monkeypatch, tmp_path):
+    import urllib.error
+    import urllib.request
+
+    from pinot_tpu.storage.hdfsfs import HdfsFS
+
+    store: dict = {}  # hdfs path -> bytes (files) | None (dirs)
+
+    class Resp:
+        def __init__(self, body=b"{}"):
+            self.body = body
+            self.headers = {}
+            self._pos = 0
+
+        def read(self, n=None):
+            if n is None:
+                out, self._pos = self.body[self._pos:], len(self.body)
+            else:
+                out = self.body[self._pos: self._pos + n]
+                self._pos += len(out)
+            return out
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    def fake_urlopen(req, timeout=None):
+        url = req.full_url if hasattr(req, "full_url") else req
+        method = req.get_method() if hasattr(req, "get_method") else "GET"
+        path, _, qs = url.partition("?")
+        path = path.split("/webhdfs/v1", 1)[1]
+        op = [p.split("=", 1)[1] for p in qs.split("&")
+              if p.startswith("op=")][0]
+        if op == "MKDIRS":
+            store[path] = None
+            return Resp(b'{"boolean": true}')
+        if op == "DELETE":
+            for k in [k for k in store if k == path
+                      or k.startswith(path.rstrip("/") + "/")]:
+                store.pop(k)
+            return Resp(b'{"boolean": true}')
+        if op == "GETFILESTATUS":
+            if path in store:
+                t = "DIRECTORY" if store[path] is None else "FILE"
+                return Resp(json.dumps(
+                    {"FileStatus": {"type": t, "pathSuffix": ""}}).encode())
+            # real HDFS materializes parent dirs implicitly on CREATE
+            if any(k.startswith(path.rstrip("/") + "/") for k in store):
+                return Resp(json.dumps({"FileStatus": {
+                    "type": "DIRECTORY", "pathSuffix": ""}}).encode())
+            raise urllib.error.HTTPError(url, 404, "nf", {}, None)
+        if op == "LISTSTATUS":
+            pfx = path.rstrip("/") + "/"
+            names = {}
+            for k, v in store.items():
+                if k.startswith(pfx):
+                    top = k[len(pfx):].split("/", 1)[0]
+                    deeper = "/" in k[len(pfx):]
+                    names[top] = "DIRECTORY" if (deeper or (
+                        store.get(pfx + top, b"") is None)) else "FILE"
+            return Resp(json.dumps({"FileStatuses": {"FileStatus": [
+                {"pathSuffix": n, "type": t} for n, t in names.items()
+            ]}}).encode())
+        if op == "CREATE":
+            if "dn=1" not in qs:
+                # model the namenode's two-step protocol: 307 to a datanode
+                raise urllib.error.HTTPError(
+                    url, 307, "redirect",
+                    {"Location": f"{url}&dn=1"}, None)
+            d = req.data
+            if hasattr(d, "read"):  # streamed file-like PUT body
+                d = d.read()
+            store[path] = d if d is not None else b""
+            return Resp(b"")
+        if op == "OPEN":
+            if path not in store or store[path] is None:
+                raise urllib.error.HTTPError(url, 404, "nf", {}, None)
+            return Resp(store[path])
+        raise AssertionError(op)
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    fs = HdfsFS()
+    base = "hdfs://nn:9870/segments/seg_0"
+    assert not fs.exists(base)
+    # upload a directory
+    local = tmp_path / "seg"
+    (local / "sub").mkdir(parents=True)
+    (local / "a.bin").write_bytes(b"AAA")
+    (local / "sub" / "b.bin").write_bytes(b"BB")
+    fs.copy(str(local), base)
+    assert fs.exists(base)
+    assert fs.list_files(base) == ["a.bin", "sub"]
+    # download it back
+    out = tmp_path / "down"
+    fs.copy(base, str(out))
+    assert (out / "a.bin").read_bytes() == b"AAA"
+    assert (out / "sub" / "b.bin").read_bytes() == b"BB"
+    fs.delete(base)
+    assert not fs.exists(base)
+
+
+def test_hdfs_registered():
+    from pinot_tpu.common.plugins import plugin_registry
+
+    assert "hdfs" in plugin_registry.available("fs")
+
+
+# ---------------------------------------------------------------------------
+# SHOW TABLES + SQLAlchemy dialect
+# ---------------------------------------------------------------------------
+
+
+def _mini_cluster(tmp_path):
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import ClusterRegistry
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.storage.creator import build_segment
+
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    server = ServerInstance("s0", registry, str(tmp_path / "srv"),
+                            device_executor=None)
+    server.start()
+    broker = Broker(registry)
+    schema = Schema.build(name="trips", dimensions=[("city", DataType.STRING)],
+                          metrics=[("fare", DataType.LONG)])
+    controller.add_table(TableConfig(table_name="trips"), schema)
+    d = str(tmp_path / "up")
+    build_segment(schema, {"city": np.array(["ny", "sf"] * 50),
+                           "fare": np.arange(100, dtype=np.int64)}, d,
+                  segment_name="trips_s0")
+    controller.upload_segment("trips", d)
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        r = broker.execute("SELECT COUNT(*) FROM trips")
+        if not r.get("exceptions") and r["resultTable"]["rows"][0][0] == 100:
+            break
+        time.sleep(0.05)
+    return broker, server
+
+
+def test_show_tables_and_dbapi_catalog(tmp_path):
+    broker, server = _mini_cluster(tmp_path)
+    try:
+        r = broker.execute("SHOW TABLES")
+        assert r["resultTable"]["rows"] == [["trips"]]
+        from pinot_tpu.client import connect
+
+        conn = connect(broker=broker)
+        cur = conn.cursor()
+        cur.execute("SHOW TABLES;")
+        assert cur.fetchall() == [("trips",)]
+        # LIMIT 0 column probe (the dialect's get_columns path)
+        cur.execute("SELECT * FROM trips LIMIT 0")
+        assert [d[0] for d in cur.description] == ["city", "fare"]
+        assert [d[1] for d in cur.description] == ["STRING", "LONG"]
+    finally:
+        server.stop()
+
+
+def test_sqlalchemy_dialect_with_fake_sa(tmp_path, monkeypatch):
+    """The dialect's surface works against a minimal faked sqlalchemy:
+    connect-args parsing, dbapi hookup, table/column reflection."""
+    sa = types.ModuleType("sqlalchemy")
+    sa_types = types.SimpleNamespace(
+        INTEGER=lambda: "INTEGER", BIGINT=lambda: "BIGINT",
+        FLOAT=lambda: "FLOAT", VARCHAR=lambda: "VARCHAR",
+        BOOLEAN=lambda: "BOOLEAN", TIMESTAMP=lambda: "TIMESTAMP",
+        LargeBinary=lambda: "LargeBinary", JSON=lambda: "JSON",
+        Numeric=lambda: "Numeric")
+    sa.types = sa_types
+    registered = {}
+    sa.dialects = types.SimpleNamespace(registry=types.SimpleNamespace(
+        register=lambda name, mod, attr: registered.update({name: (mod, attr)})))
+    engine_mod = types.ModuleType("sqlalchemy.engine")
+    default_mod = types.ModuleType("sqlalchemy.engine.default")
+
+    class DefaultDialect:
+        def __init__(self, *a, **k):
+            pass
+
+    default_mod.DefaultDialect = DefaultDialect
+    engine_mod.default = default_mod
+    sa.engine = engine_mod
+    monkeypatch.setitem(sys.modules, "sqlalchemy", sa)
+    monkeypatch.setitem(sys.modules, "sqlalchemy.engine", engine_mod)
+    monkeypatch.setitem(sys.modules, "sqlalchemy.engine.default", default_mod)
+
+    from pinot_tpu.client import sqlalchemy_dialect as sd
+
+    cls = sd.register_dialect()
+    assert registered["pinot"] == (
+        "pinot_tpu.client.sqlalchemy_dialect", "dialect")
+    d = cls()
+    assert cls.import_dbapi().apilevel == "2.0"
+    url = types.SimpleNamespace(host="bhost", port=9001)
+    args, kwargs = d.create_connect_args(url)
+    assert args == ["http://bhost:9001"] and kwargs == {}
+
+    # reflection against a real mini-cluster through the DB-API
+    broker, server = _mini_cluster(tmp_path)
+    try:
+        from pinot_tpu.client import connect
+
+        class FakeSAConn:  # sqlalchemy passes a wrapper with .connection
+            connection = connect(broker=broker)
+
+        assert d.get_table_names(FakeSAConn()) == ["trips"]
+        assert d.has_table(FakeSAConn(), "trips")
+        cols = d.get_columns(FakeSAConn(), "trips")
+        assert [c["name"] for c in cols] == ["city", "fare"]
+        assert [c["type"] for c in cols] == ["VARCHAR", "BIGINT"]
+    finally:
+        server.stop()
