@@ -97,11 +97,16 @@ def make_dialect_class():
             return table_name in self.get_table_names(connection, schema)
 
         def get_table_names(self, connection, schema=None, **kw):
+            from pinot_tpu.client import DatabaseError
+
             cur = connection.connection.cursor()
             try:
                 cur.execute("SHOW TABLES")
                 return [r[0] for r in cur.fetchall()]
-            except Exception:  # noqa: BLE001 — older brokers: no catalog op
+            except DatabaseError:
+                # in-band broker error (a broker without the catalog op):
+                # empty catalog. Transport failures PROPAGATE — a down
+                # broker must not reflect as an empty database.
                 return []
 
         def get_columns(self, connection, table_name, schema=None, **kw):

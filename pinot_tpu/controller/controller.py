@@ -76,7 +76,30 @@ class SegmentAssigner:
         if not counts:
             raise RuntimeError("no live servers to assign to")
         ordered = sorted(counts, key=lambda i: counts[i])
-        return ordered[: max(1, min(replication, len(ordered)))]
+        want = max(1, min(replication, len(ordered)))
+        # failure-domain spread (AzureEnvironmentProvider role,
+        # common/environment.py): replicas prefer DISTINCT fd: domains so
+        # one fault boundary can't take out every copy; falls back to
+        # pure least-loaded when domains are absent or too few
+        from pinot_tpu.common.environment import domain_of
+
+        infos = {i.instance_id: i for i in self._live_servers()}
+        picked, seen_fd = [], set()
+        for inst in ordered:
+            fd = domain_of(infos.get(inst))
+            if fd is not None and fd in seen_fd:
+                continue
+            picked.append(inst)
+            if fd is not None:
+                seen_fd.add(fd)
+            if len(picked) >= want:
+                return picked
+        for inst in ordered:  # not enough distinct domains: top up by load
+            if inst not in picked:
+                picked.append(inst)
+                if len(picked) >= want:
+                    break
+        return picked
 
     def rebalance(self, table: str, replication: int,
                   servers: Optional[list] = None) -> dict:

@@ -56,6 +56,7 @@ def run_ingestion_job(spec: IngestionJobSpec, controller) -> list:
     table_cfg = controller.registry.table_config(table)
     if schema is None or table_cfg is None:
         raise KeyError(f"table {spec.table_name!r} not registered")
+    uploader = None
     files = resolve_input_files(spec.input_dir, spec.include_pattern)
     if not files:
         raise FileNotFoundError(
@@ -92,6 +93,15 @@ def run_ingestion_job(spec: IngestionJobSpec, controller) -> list:
         seg_dir = os.path.join(out_root, name)
         build_segment(schema, columns, seg_dir, table_cfg, name)
         if spec.push:
-            controller.upload_segment(table, seg_dir)
+            if uploader is None:
+                # uploader SPI (segment-uploader-default role): retried
+                # with backoff, pluggable via reader_props; one instance
+                # serves the whole job
+                from pinot_tpu.ingestion.uploader import create_uploader
+
+                uploader = create_uploader(
+                    spec.reader_props.get("segment.uploader", "default"),
+                    controller)
+            uploader.upload(table, seg_dir)
         built.append(seg_dir)
     return built

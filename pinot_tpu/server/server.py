@@ -130,10 +130,16 @@ class ServerInstance:
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> None:
         self.transport.start()
+        from pinot_tpu.common.environment import failure_domain_tag
+
+        tags = list(self.tags)
+        fd_tag = failure_domain_tag()
+        if fd_tag and fd_tag not in tags:
+            tags.append(fd_tag)  # assigner spreads replicas across domains
         self.registry.register_instance(
             InstanceInfo(self.instance_id, Role.SERVER,
                          host=self.transport.host, grpc_port=self.transport.port,
-                         tags=list(self.tags))
+                         tags=tags)
         )
         self._sync_once()  # load assigned segments before serving
         self._sync_thread = threading.Thread(

@@ -522,3 +522,60 @@ def test_sqlalchemy_dialect_with_fake_sa(tmp_path, monkeypatch):
         assert [c["type"] for c in cols] == ["VARCHAR", "BIGINT"]
     finally:
         server.stop()
+
+
+# ---------------------------------------------------------------------------
+# environment provider (failure domains) + segment uploader SPI
+# ---------------------------------------------------------------------------
+
+
+def test_failure_domain_spread(tmp_path, monkeypatch):
+    """Replicas spread across DISTINCT failure domains when fd: tags are
+    present (AzureEnvironmentProvider role), topping up by load only when
+    domains run out."""
+    from pinot_tpu.cluster.registry import ClusterRegistry, InstanceInfo, Role
+    from pinot_tpu.common.environment import domain_of, failure_domain_tag
+    from pinot_tpu.controller.controller import SegmentAssigner
+
+    monkeypatch.setenv("PINOT_TPU_FAILURE_DOMAIN", "zone-a")
+    assert failure_domain_tag() == "fd:zone-a"
+
+    reg = ClusterRegistry()
+    import time as _t
+
+    now = int(_t.time() * 1000)
+    for i, fd in enumerate(["a", "a", "b", "b", "c"]):
+        info = InstanceInfo(f"s{i}", Role.SERVER, tags=[f"fd:{fd}"])
+        reg.register_instance(info)
+    assigner = SegmentAssigner(reg)
+    picked = assigner.assign(3)
+    domains = [domain_of(next(x for x in reg.instances() if
+                              x.instance_id == p)) for p in picked]
+    assert len(set(domains)) == 3, (picked, domains)
+    # replication beyond distinct domains: tops up (5 servers, 3 domains)
+    assert len(assigner.assign(4)) == 4
+
+
+def test_segment_uploader_retries(tmp_path):
+    from pinot_tpu.ingestion.uploader import create_uploader
+
+    calls = []
+
+    class FlakyController:
+        def upload_segment(self, table, seg_dir):
+            calls.append(seg_dir)
+            if len(calls) < 3:
+                raise OSError("deep store blip")
+            return "seg_ok"
+
+    up = create_uploader("default", FlakyController(), backoff_s=0.01)
+    assert up.upload("t", "/x") == "seg_ok"
+    assert len(calls) == 3
+
+    class DeadController:
+        def upload_segment(self, table, seg_dir):
+            raise OSError("down")
+
+    with pytest.raises(RuntimeError, match="after 3 attempts"):
+        create_uploader("default", DeadController(),
+                        backoff_s=0.01).upload("t", "/y")
