@@ -267,6 +267,11 @@ def _finalize_sketch_outs(outs, agg_tpls):
         if name == "distinctcount" and f"{k}_pres" in outs:
             pres = outs.pop(f"{k}_pres")
             outs[f"{k}_cnt"] = jnp.sum(pres, axis=-1, dtype=jnp.int64)
+        elif name == "distinctcounthll" and f"{k}_hs" in outs:
+            # sorted register-free build (_hll_sorted_sums): scaled sums →
+            # estimates, bit-identical to the dense-register math
+            sums = outs.pop(f"{k}_hs")
+            outs[f"{k}_est"] = hll_ops.estimate_from_sums_jnp(sums, _extra)
         elif name in ("distinctcounthll", "hllmerge") and f"{k}_regs" in outs:
             regs = outs.pop(f"{k}_regs")
             if regs.ndim == 1:
@@ -274,6 +279,44 @@ def _finalize_sketch_outs(outs, agg_tpls):
             else:
                 outs[f"{k}_est"] = hll_ops.estimate_jnp(regs)
     return outs
+
+
+def _hll_sorted_sums(slot, rho, num_groups, log2m, mm_mode):
+    """TERMINAL-only register-free HLL build for group counts too large
+    for the matmul register kernel: one global sort of packed
+    (slot << 5 | rho) int32 keys dedupes (register, rank) pairs — each
+    slot's run ends at its MAX rho — then three bf16 channels over the
+    boundary rows ride ONE group_sums matmul to per-GROUP scaled sums
+    that recombine to the exact Σ 2^-reg (ops/hll.py
+    estimate_from_sums_jnp). Replaces the 100M-row scatter-max (measured
+    ~665ms on v5e) with sort (~320ms) + matmul (~40ms). NOT mergeable
+    across shards/servers (same slot on two shards would double-count),
+    hence terminal-only; the scatter path remains the mergeable form."""
+    from pinot_tpu.ops import groupby_mm as mm
+
+    m = 1 << log2m
+    rho_max = 33 - log2m
+    split = rho_max // 2
+    key = (slot.reshape(-1).astype(jnp.int32) << 5) \
+        | rho.reshape(-1).astype(jnp.int32)
+    sk = jax.lax.sort(key)
+    slot_s = sk >> 5
+    is_end = jnp.concatenate(
+        [slot_s[1:] != slot_s[:-1], jnp.ones(1, dtype=bool)])
+    valid = slot_s < num_groups * m  # masked rows pack the overflow slot
+    e = is_end & valid
+    rho_s = (sk & 31).astype(jnp.float32)
+    gid_s = jnp.where(valid, slot_s >> log2m, num_groups).astype(jnp.int32)
+    zero = jnp.float32(0)
+    ch1 = jnp.where(e, jnp.float32(1), zero).astype(jnp.bfloat16)
+    ch2 = jnp.where(e & (rho_s <= split),
+                    jnp.exp2(jnp.float32(split) - rho_s),
+                    zero).astype(jnp.bfloat16)
+    ch3 = jnp.where(e & (rho_s > split),
+                    jnp.exp2(jnp.float32(rho_max) - rho_s),
+                    zero).astype(jnp.bfloat16)
+    return mm.group_sums(gid_s, jnp.stack([ch1, ch2, ch3]), num_groups,
+                         interpret=(mm_mode == "interpret"))
 
 
 def _with_time_partial(name: str, outs: dict, k: str, present):
@@ -293,6 +336,26 @@ def _with_time_partial(name: str, outs: dict, k: str, present):
     # it becomes NaN only here at the canonical boundary
     return {"val": np.where((t == sentinel) | np.isneginf(v), np.nan, v),
             "time": t}
+
+
+def amortized_launch_time(timed, base_iters: int = 8,
+                          target_s: float = 0.6, max_iters: int = 32) -> float:
+    """Per-launch device seconds from a ``timed(k)`` closure (k launches +
+    one token fetch). The link's RTT jitter (±10ms on the bench tunnel)
+    contaminates a fixed-iteration estimate for SHORT kernels, so the
+    iteration count adapts until the amortized span dwarfs the jitter."""
+    import time as _time  # noqa: F401 — callers' closures time themselves
+
+    timed(1)  # warm (compile cache hit; steady-state dispatch)
+    t1 = min(timed(1) for _ in range(3))
+    tn = timed(base_iters)
+    per = max(1e-6, (tn - t1) / (base_iters - 1))
+    if (base_iters - 1) * per < target_s:
+        iters = int(min(max_iters, max(base_iters, round(target_s / per))))
+        if iters > base_iters:
+            tn = timed(iters)
+            per = max(0.0, (tn - t1) / (iters - 1))
+    return per
 
 
 def _is_f64(dt) -> bool:
@@ -364,16 +427,19 @@ def _unpack_outs(bufs: dict, layout) -> dict:
     return outs
 
 
-def build_pipeline(template, mm_mode: str = "auto"):
+def build_pipeline(template, mm_mode: str = "auto",
+                   sorted_hll_ok: bool = False):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
     ``mm_mode``: "auto" → the factored one-hot matmul kernel
     (ops/groupby_mm.py) on TPU, scatter elsewhere; "interpret" forces the
     kernel in Pallas interpret mode (CPU tests); "off" forces scatter.
 
-    The trailing ``final`` template field is consumed OUTSIDE this function
-    (``_finalize_sketch_outs``, applied after the mesh combine) — here it
-    only participates in the cache key.
+    The trailing ``final`` template field is mostly consumed OUTSIDE this
+    function (``_finalize_sketch_outs``, applied after the mesh combine);
+    with ``sorted_hll_ok`` (single-device executors only — the sorted
+    sums are not shard-mergeable) a final template routes large-G HLL
+    through the register-free sorted build (_hll_sorted_sums).
     """
     shape, filter_tpl, group_cols, group_cards, aggs, sorted_k, _final = template
     mm_mode = _resolve_mm_mode(mm_mode)
@@ -536,15 +602,27 @@ def build_pipeline(template, mm_mode: str = "auto"):
                     pres = pres.at[gid2.reshape(-1)].max(1)
                     outs[f"{k}_pres"] = pres[: num_groups * card].reshape(num_groups, card)
                 elif name == "distinctcounthll":
+                    from pinot_tpu.ops import groupby_mm as mm
+
                     log2m = extra
                     m = 1 << log2m
                     # per-doc value hashes, gathered host-side at upload
                     h = cols["hh::" + argt]
                     idx, rho = hll_ops.hll_idx_rho(h, log2m)
                     slot = jnp.where(mask, gid * m + idx, num_groups * m)
-                    outs[f"{k}_regs"] = _hll_regs(
-                        slot, rho, num_groups, log2m, mm_mode
-                    )
+                    if (_final and sorted_hll_ok and mm_mode != "off"
+                            and not mm.hll_supported(num_groups, log2m)
+                            and num_groups * m < (1 << 26)
+                            # the 3-channel group_sums launch must fit its
+                            # own VMEM budget too (huge-G shapes keep the
+                            # scatter path)
+                            and mm.mm_supported(num_groups, 3)):
+                        outs[f"{k}_hs"] = _hll_sorted_sums(
+                            slot, rho, num_groups, log2m, mm_mode)
+                    else:
+                        outs[f"{k}_regs"] = _hll_regs(
+                            slot, rho, num_groups, log2m, mm_mode
+                        )
                 elif name == "hllmerge":
                     # cube rows carry whole register planes: scatter-max the
                     # (rows, m) planes into (G, m) — rows ≈ distinct dim
@@ -637,6 +715,45 @@ class DeviceExecutor:
         # cumulative host-link observability (bench reads deltas per query)
         self.fetch_bytes_total = 0
         self.fetch_leaves_total = 0
+        # last-launch capture for kernel profiling (bench breakdown):
+        # (pipeline, cols, n_docs, params, bytes_in). OPT-IN: retaining
+        # the launch pins a whole batch's HBM past the batch cache's
+        # eviction budget, so production executes must not capture it.
+        self.profile_enabled = False
+        self._last_launch = None
+        self.last_get_wait_s = None
+        # NOTE: predicate-literal device caching lives in params._slot —
+        # keyed on host bytes BEFORE upload (keying device arrays here
+        # would cost a blocking device→host read per literal)
+
+    def profile_last_launch(self, iters: int = 8):
+        """Amortized pure-DEVICE time of the last executed pipeline:
+        dispatch the identical launch ``iters`` times and fetch a TINY
+        token that depends on the final launch — on the bench tunnel,
+        ``block_until_ready`` is a no-op (completion is only observable
+        through device_get), and async dispatches pipeline, so
+        (T_iters - T_1) / (iters - 1) isolates per-launch kernel time
+        from the round-trip floor. Returns (kernel_seconds, bytes_read)
+        or None when nothing was captured."""
+        import time as _time
+
+        if self._last_launch is None:
+            return None
+        pipeline, cols, n_docs, params, bytes_in = self._last_launch
+        token = jax.jit(
+            lambda o: sum(jnp.sum(v.reshape(-1)[:1].astype(jnp.float32))
+                          for v in o.values()))
+
+        def timed(k):
+            outs = None
+            t0 = _time.perf_counter()
+            for _ in range(k):
+                outs = pipeline(cols, n_docs, params)
+            jax.device_get(token(outs))
+            return _time.perf_counter() - t0
+
+        kernel_s = amortized_launch_time(timed, iters)
+        return kernel_s, bytes_in
 
     # cheap static check (EXPLAIN backend display)
     def supports(self, q: QueryContext) -> bool:
@@ -826,7 +943,8 @@ class DeviceExecutor:
 
         entry = self._pipelines.get((template, self.mm_mode))
         if entry is None:
-            raw = build_pipeline(template, self.mm_mode)
+            raw = build_pipeline(template, self.mm_mode,
+                                 sorted_hll_ok=(self.mesh is None))
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
 
@@ -894,7 +1012,20 @@ class DeviceExecutor:
         if layout is None:
             layout = _out_layout(jax.eval_shape(inner, cols, n_docs, params))
             layout_cache[lkey] = layout
+        if self.profile_enabled:
+            self._last_launch = (
+                pipeline, cols, n_docs, params,
+                sum(int(np.prod(v.shape, dtype=np.int64)) * v.dtype.itemsize
+                    for v in cols.values()),
+            )
+        import time as _time
+
+        _t_get = _time.perf_counter()
         bufs = jax.device_get(pipeline(cols, n_docs, params))
+        # blocking wait = link round trip + kernel; bench subtracts it from
+        # wall time for a MEASURED host_ms (floor-subtraction overstated
+        # host work by the link's RTT variance)
+        self.last_get_wait_s = _time.perf_counter() - _t_get
         bufs = {k: np.asarray(v) for k, v in bufs.items()}
         # observability: what actually crossed the host link (bench breakdown)
         self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())

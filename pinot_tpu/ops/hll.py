@@ -198,3 +198,32 @@ def estimate(registers: np.ndarray) -> int:
     one row of the batch form, so the correction math lives in exactly one
     np implementation (plus its jnp mirror)."""
     return int(estimate_batch_np(np.asarray(registers)[None, :])[0])
+
+
+def estimate_from_sums_jnp(sums, log2m: int):
+    """(3, G) f64 scaled register sums → (G,) int64 estimates,
+    BIT-IDENTICAL to ``estimate_jnp`` over the dense register planes.
+
+    sums rows (engine/device.py _hll_sorted_sums):
+      [0] count of registers with at least one row (so zeros = m - s0)
+      [1] Σ 2^(split - reg)  over present registers with reg <= split
+      [2] Σ 2^(rho_max - reg) over present registers with reg > split
+    with split = rho_max // 2, rho_max = 33 - log2m. Every term is a
+    power of two (bf16/f32-exact) and each scaled sum stays below 2^24
+    (f32 matmul accumulation exact), so the f64 recombination below is
+    the EXACT value of Σ 2^-reg — the same real number estimate_jnp's
+    f64 summation produces — making the correction branches and the
+    final round bit-identical."""
+    m = 1 << log2m
+    rho_max = 33 - log2m
+    split = rho_max // 2
+    s1, s2, s3 = sums[0], sums[1], sums[2]
+    zeros = m - s1
+    denom = zeros + s2 * (2.0 ** -split) + s3 * (2.0 ** -rho_max)
+    raw = _alpha(m) * m * m / denom
+    small = (raw <= 2.5 * m) & (zeros > 0)
+    lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    big = raw > (1 << 32) / 30.0
+    large = -float(1 << 32) * jnp.log(1.0 - raw / float(1 << 32))
+    est = jnp.where(small, lin, jnp.where(big, large, raw))
+    return jnp.round(est).astype(jnp.int64)

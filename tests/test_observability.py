@@ -108,8 +108,12 @@ class TestFsSpi:
         monkeypatch.setitem(sys.modules, "google.cloud", None)
         with pytest.raises(RuntimeError, match="google-cloud"):
             create_fs("gs://bucket/x")
+        # hdfs is now a real plugin (WebHDFS, stdlib-only — no gating)
+        from pinot_tpu.storage.hdfsfs import HdfsFS
+
+        assert isinstance(create_fs("hdfs://nn:9870/x"), HdfsFS)
         with pytest.raises(KeyError, match="no 'fs' plugin"):
-            create_fs("hdfs://nn/x")
+            create_fs("ipfs://nn/x")
 
 
 class TestPluginRegistry:
