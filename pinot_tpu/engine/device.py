@@ -339,7 +339,7 @@ def _with_time_partial(name: str, outs: dict, k: str, present):
 
 
 def amortized_launch_time(timed, base_iters: int = 8,
-                          target_s: float = 0.6, max_iters: int = 32) -> float:
+                          target_s: float = 0.6, max_iters: int = 256) -> float:
     """Per-launch device seconds from a ``timed(k)`` closure (k launches +
     one token fetch). The link's RTT jitter (±10ms on the bench tunnel)
     contaminates a fixed-iteration estimate for SHORT kernels, so the

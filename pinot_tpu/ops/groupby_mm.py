@@ -49,16 +49,39 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BLK = 2048              # rows per grid step (16 lane-rows of 128)
-NINNER = 32             # steps per superblock: 65536 rows (f32-exact bound)
+BLK = 8192              # rows per grid step (64 lane-rows of 128); larger
+                        # blocks amortize per-step overhead — measured 35.8
+                        # -> 30.3ms for the 4-channel q1 shape at 100M rows
+                        # on v5e (plateau at >=8192)
+NINNER = 8              # steps per superblock: 65536 rows (f32-exact bound)
 SUPERBLOCK = BLK * NINNER
 MM_MIN_ROWS = 1 << 17   # below this the scatter path's fixed cost wins
 MAX_CHANNELS = 15       # + the count channel; bounded by VMEM acc size
 MAX_ACC_CELLS = 1 << 21 # A * hpad * 128 f32 cells (8MB VMEM accumulator;
                         # _launch raises the scoped-vmem limit to cover
                         # acc + double-buffered out block)
-STACK_MAX_M = 2048      # stacked-channel dot cap: chh_all is
-                        # (A*hpad, BLK) bf16 = 8MB at this bound
+STACK_MAX_BYTES = 8 << 20   # stacked-channel operand cap: chh_all is
+                            # (A*hpad, blk) bf16
+TRANSIENT_BUDGET = 24 << 20  # in-kernel bf16 one-hot/channel transients;
+                             # _plan_blk halves blk (floor 2048 = the
+                             # pre-retune value) until they fit
+
+
+def _plan_blk(a_real: int, hpad: int):
+    """(blk, ninner, stacked): per-shape block size. The one-hot and
+    channel transients scale with hpad*blk, so large-hpad shapes (HLL rho
+    mode near its support bound) shrink blk back toward 2048 — the value
+    the VMEM budget was originally calibrated at — while small-hpad
+    group-bys run at 8192 (measured 35.8 -> 30.3ms for the 4-channel
+    G=2000 shape at 100M rows on v5e)."""
+    blk = BLK
+    while True:
+        stacked = a_real * hpad * blk * 2 <= STACK_MAX_BYTES
+        chh_rows = a_real * hpad if stacked else hpad
+        transient = (128 + hpad + chh_rows) * blk * 2
+        if transient <= TRANSIENT_BUDGET or blk <= 2048:
+            return blk, SUPERBLOCK // blk, stacked
+        blk //= 2
 
 _i32 = jnp.int32
 _NT = (((1,), (1,)), ((), ()))  # contract lanes-with-lanes (rows axis)
@@ -74,7 +97,7 @@ def _hpad(num_groups: int) -> int:
 
 
 def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
-            *, ninner, hpad, a_real, blk, rho_mode):
+            *, ninner, hpad, a_real, blk, rho_mode, stacked):
     i = pl.program_id(1)
 
     @pl.when(i == 0)
@@ -102,7 +125,7 @@ def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
                 .astype(jnp.bfloat16)
         return ch_ref[pl.ds(a, 1), :]               # (1, blk) bf16
 
-    if a_real * hpad <= STACK_MAX_M:
+    if stacked:
         # stack every channel's masked hi one-hot into ONE dot: per-channel
         # M=hpad dots underfill the MXU's M tile, so 4 channels cost ~4x one
         # — stacked to M = a_real*hpad they cost ~1x (measured 58.6 -> 27ms
@@ -125,26 +148,40 @@ def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
         out_ref[0] = acc_ref[:]
 
 
-def _launch(ids_lane, ch_operand, ch_spec, *, a_real, hpad, nsuper,
+def _launch(ids_lane, ch_operand, ch_spec_kind, *, a_real, hpad, nsuper,
             rho_mode, interpret):
+    blk, ninner, stacked = _plan_blk(a_real, hpad)
     kern = functools.partial(
-        _kernel, ninner=NINNER, hpad=hpad, a_real=a_real, blk=BLK,
-        rho_mode=rho_mode,
+        _kernel, ninner=ninner, hpad=hpad, a_real=a_real, blk=blk,
+        rho_mode=rho_mode, stacked=stacked,
     )
+    if ch_spec_kind == "channels":
+        ch_spec = pl.BlockSpec(
+            (a_real, blk), lambda s, i: (_i32(0), s * ninner + i),
+            memory_space=pltpu.VMEM)
+    else:  # lane-major rho operand
+        ch_spec = pl.BlockSpec(
+            (blk // 128, 128), lambda s, i: (s * ninner + i, _i32(0)),
+            memory_space=pltpu.VMEM)
     # acc scratch + out block are each a_real*hpad*128 f32; the out block is
     # double-buffered by the pipeline and Mosaic stacks further transient
     # copies. Default scoped-vmem limit is 16MB — raise it for large-G
     # accumulators (v5e has 128MB VMEM). Empirically the compiler's stack
     # peak reaches ~8x the accumulator at 400k groups (measured: 40.2MB at
-    # acc=4.8MB), so budget 8x + headroom; MAX_ACC_CELLS keeps the result
-    # under the 110MB ceiling.
+    # acc=4.8MB), so budget 8x + headroom PLUS the blk-proportional
+    # transients _plan_blk bounded; MAX_ACC_CELLS keeps the result under
+    # the ceiling.
     acc_bytes = a_real * hpad * 128 * 4
-    vmem_limit = max(16 * 2**20, min(110 * 2**20, 8 * acc_bytes + 16 * 2**20))
+    chh_rows = a_real * hpad if stacked else hpad
+    transient_bytes = (128 + hpad + chh_rows) * blk * 2
+    vmem_limit = max(16 * 2**20,
+                     min(110 * 2**20,
+                         8 * acc_bytes + transient_bytes + 16 * 2**20))
     out = pl.pallas_call(
         kern,
-        grid=(nsuper, NINNER),
+        grid=(nsuper, ninner),
         in_specs=[
-            pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
+            pl.BlockSpec((blk // 128, 128), lambda s, i: (s * ninner + i, _i32(0)),
                          memory_space=pltpu.VMEM),
             ch_spec,
         ],
@@ -185,9 +222,7 @@ def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
     ch = jnp.concatenate(
         [channels, jnp.zeros((a_real, n_pad - n), channels.dtype)], axis=1
     )
-    ch_spec = pl.BlockSpec((a_real, BLK), lambda s, i: (_i32(0), s * NINNER + i),
-                           memory_space=pltpu.VMEM)
-    tot = _launch(ids_lane, ch, ch_spec, a_real=a_real, hpad=hpad,
+    tot = _launch(ids_lane, ch, "channels", a_real=a_real, hpad=hpad,
                   nsuper=nsuper, rho_mode=False, interpret=interpret)
     return tot.reshape(a_real, hpad * 128)[:, :num_groups]
 
@@ -210,9 +245,7 @@ def rho_group_counts(slot, rho, num_groups: int, nrho: int, *,
     rho_lane = jnp.concatenate(
         [rho.astype(jnp.int32), jnp.zeros(n_pad - n, dtype=jnp.int32)]
     ).reshape(-1, 128)
-    rho_spec = pl.BlockSpec((BLK // 128, 128), lambda s, i: (s * NINNER + i, _i32(0)),
-                            memory_space=pltpu.VMEM)
-    tot = _launch(ids_lane, rho_lane, rho_spec, a_real=nrho, hpad=hpad,
+    tot = _launch(ids_lane, rho_lane, "rho_lane", a_real=nrho, hpad=hpad,
                   nsuper=nsuper, rho_mode=True, interpret=interpret)
     return tot.reshape(nrho, hpad * 128)[:, :num_groups]
 
