@@ -204,3 +204,54 @@ def test_duties_partition_between_live_controllers(tmp_path):
     finally:
         b._ha_thread = None
         a.stop_ha()
+
+
+@pytest.mark.slow
+def test_failover_across_os_processes(tmp_path):
+    """Two controller PROCESSES contend over one FileRegistry; SIGKILL the
+    lead; the standby absorbs every partition within ~one lease TTL (the
+    closest analog to the reference's multi-JVM Helix leader election)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    reg_path = str(tmp_path / "reg")
+    child = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {os.getcwd()!r})\n"
+        "from pinot_tpu.cluster.registry import FileRegistry\n"
+        "from pinot_tpu.controller.controller import Controller\n"
+        f"reg = FileRegistry({reg_path!r})\n"
+        f"c = Controller(reg, {str(tmp_path / 'ds')!r}, controller_id=sys.argv[1])\n"
+        "c.start_ha(lease_ttl_ms=800, interval_s=0.1)\n"
+        "while True:\n"
+        "    time.sleep(1)\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    lead = subprocess.Popen([sys.executable, "-c", child, "lead"],
+                            stdout=subprocess.DEVNULL, env=env)
+    standby = subprocess.Popen([sys.executable, "-c", child, "standby"],
+                               stdout=subprocess.DEVNULL, env=env)
+    try:
+        # wait until the standby holds its fair share (both alive)
+        reg = FileRegistry(reg_path)
+        assert wait_until(
+            lambda: reg.lease_holder("controller/lead/0") is not None,
+            timeout=20)
+        assert wait_until(lambda: any(
+            reg.lease_holder(f"controller/lead/{p}") == "standby"
+            for p in range(Controller.LEAD_PARTITIONS)), timeout=20)
+        os.kill(lead.pid, signal.SIGKILL)  # hard crash: no lease release
+        assert wait_until(lambda: all(
+            reg.lease_holder(f"controller/lead/{p}") == "standby"
+            for p in range(Controller.LEAD_PARTITIONS)), timeout=10), [
+            reg.lease_holder(f"controller/lead/{p}")
+            for p in range(Controller.LEAD_PARTITIONS)]
+    finally:
+        for p in (lead, standby):
+            try:
+                p.kill()
+                p.wait(timeout=10)  # reap: no zombies across the session
+            except Exception:
+                pass
