@@ -249,3 +249,73 @@ class TestAdminCli:
             assert resp["resultTable"]["rows"] == [[50000]]
         finally:
             server.stop()
+
+
+class TestParallelRunner:
+    def test_parallel_builds_match_sequential(self, tmp_path):
+        """parallelism > 1 fans per-file builds to spawned processes (the
+        hadoop/spark runner role) and produces the same segments as the
+        standalone runner."""
+        import csv
+
+        import numpy as np
+
+        from pinot_tpu.broker.broker import Broker
+        from pinot_tpu.cluster.registry import ClusterRegistry
+        from pinot_tpu.common.datatypes import DataType
+        from pinot_tpu.common.schema import Schema
+        from pinot_tpu.common.table_config import TableConfig
+        from pinot_tpu.controller.controller import Controller
+        from pinot_tpu.ingestion.job import IngestionJobSpec, run_ingestion_job
+        from pinot_tpu.server.server import ServerInstance
+        from pinot_tpu.storage.segment import ImmutableSegment
+
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        server = ServerInstance("s0", registry, str(tmp_path / "srv"),
+                                device_executor=None)
+        server.start()
+        broker = Broker(registry)
+        try:
+            schema = Schema.build(
+                name="pj", dimensions=[("k", DataType.STRING)],
+                metrics=[("v", DataType.LONG)])
+            controller.add_table(TableConfig(table_name="pj"), schema)
+            data_dir = tmp_path / "in"
+            data_dir.mkdir()
+            total = 0
+            for i in range(4):
+                with open(data_dir / f"f{i}.csv", "w", newline="") as f:
+                    w = csv.writer(f)
+                    w.writerow(["k", "v"])
+                    for j in range(200):
+                        w.writerow([f"k{j % 5}", i * 1000 + j])
+                        total += 1
+            spec = IngestionJobSpec(
+                table_name="pj", input_dir=str(data_dir), format="csv",
+                output_dir=str(tmp_path / "segs"), parallelism=3)
+            built = run_ingestion_job(spec, controller)
+            assert len(built) == 4
+            # order preserved: segment i carries file i's rows — file i's
+            # values live in [i*1000, i*1000+200), so the VALUES pin it
+            import numpy as _np
+
+            for i in (0, 3):
+                seg = ImmutableSegment(built[i])
+                vals = _np.asarray(seg.values("v"))
+                assert seg.n_docs == 200
+                assert vals.min() == i * 1000 and vals.max() == i * 1000 + 199
+            import time
+
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                r = broker.execute("SELECT COUNT(*), SUM(v) FROM pj")
+                if not r.get("exceptions") \
+                        and r["resultTable"]["rows"][0][0] == total:
+                    break
+                time.sleep(0.1)
+            want_sum = sum(i * 1000 + j for i in range(4) for j in range(200))
+            assert r["resultTable"]["rows"][0] == [total, want_sum]
+        finally:
+            broker.close()
+            server.stop()
