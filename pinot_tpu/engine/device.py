@@ -281,25 +281,16 @@ def _finalize_sketch_outs(outs, agg_tpls):
     return outs
 
 
-def _hll_sorted_sums(slot, rho, num_groups, log2m, mm_mode):
-    """TERMINAL-only register-free HLL build for group counts too large
-    for the matmul register kernel: one global sort of packed
-    (slot << 5 | rho) int32 keys dedupes (register, rank) pairs — each
-    slot's run ends at its MAX rho — then three bf16 channels over the
-    boundary rows ride ONE group_sums matmul to per-GROUP scaled sums
-    that recombine to the exact Σ 2^-reg (ops/hll.py
-    estimate_from_sums_jnp). Replaces the 100M-row scatter-max (measured
-    ~665ms on v5e) with sort (~320ms) + matmul (~40ms). NOT mergeable
-    across shards/servers (same slot on two shards would double-count),
-    hence terminal-only; the scatter path remains the mergeable form."""
+def _hll_sums_from_sorted(sk, num_groups, log2m, mm_mode):
+    """(3, G) scaled register sums from an already-SORTED packed key array
+    (slot << 5 | rho): each slot's run ends at its MAX rho; three bf16
+    power-of-two channels over the boundary rows ride ONE group_sums
+    matmul (see estimate_from_sums_jnp for the exactness argument)."""
     from pinot_tpu.ops import groupby_mm as mm
 
     m = 1 << log2m
     rho_max = 33 - log2m
     split = rho_max // 2
-    key = (slot.reshape(-1).astype(jnp.int32) << 5) \
-        | rho.reshape(-1).astype(jnp.int32)
-    sk = jax.lax.sort(key)
     slot_s = sk >> 5
     is_end = jnp.concatenate(
         [slot_s[1:] != slot_s[:-1], jnp.ones(1, dtype=bool)])
@@ -317,6 +308,36 @@ def _hll_sorted_sums(slot, rho, num_groups, log2m, mm_mode):
                     zero).astype(jnp.bfloat16)
     return mm.group_sums(gid_s, jnp.stack([ch1, ch2, ch3]), num_groups,
                          interpret=(mm_mode == "interpret"))
+
+
+def _hll_sorted_sums(slot, rho, num_groups, log2m, mm_mode):
+    """TERMINAL-only register-free HLL build for group counts too large
+    for the matmul register kernel: one global sort of packed
+    (slot << 5 | rho) int32 keys dedupes (register, rank) pairs, then
+    _hll_sums_from_sorted reduces them to per-GROUP scaled sums that
+    recombine to the exact Σ 2^-reg (ops/hll.py estimate_from_sums_jnp).
+    Replaces the 100M-row scatter-max (measured ~665ms on v5e) with sort
+    (~320ms) + matmul (~40ms). NOT mergeable across shards/servers (same
+    slot on two shards would double-count), hence terminal-only; the
+    scatter path remains the mergeable form. FILTERLESS queries skip the
+    sort entirely via the batch's cached sorted projection
+    (params.BatchContext.sorted_hll_keys)."""
+    key = (slot.reshape(-1).astype(jnp.int32) << 5) \
+        | rho.reshape(-1).astype(jnp.int32)
+    return _hll_sums_from_sorted(jax.lax.sort(key), num_groups, log2m,
+                                 mm_mode)
+
+
+def _hll_sort_eligible(final, sorted_hll_ok, num_groups, log2m, mm_mode):
+    """Shared gate for the sorted terminal HLL paths (build_pipeline AND
+    the executor's needed-columns resolution must agree)."""
+    from pinot_tpu.ops import groupby_mm as mm
+
+    m = 1 << log2m
+    return (final and sorted_hll_ok and mm_mode != "off"
+            and not mm.hll_supported(num_groups, log2m)
+            and num_groups * m < (1 << 26)
+            and mm.mm_supported(num_groups, 3))
 
 
 def _with_time_partial(name: str, outs: dict, k: str, present):
@@ -448,7 +469,10 @@ def build_pipeline(template, mm_mode: str = "auto",
         num_groups *= c
 
     def pipeline(cols, n_docs, params):
-        any_col = next(iter(cols.values()))
+        # sk:: sorted projections are 1-D and must not drive the (S, L)
+        # shape inference
+        any_col = next(v for k, v in cols.items()
+                       if not k.startswith("sk::"))
         sl = any_col.shape[:2]  # MV blocks are (S, L, K); masks are (S, L)
         valid = mask_ops.valid_mask(n_docs, sl[1], batched=True)
         mask = _eval_filter(filter_tpl, cols, params, sl) & valid
@@ -602,24 +626,30 @@ def build_pipeline(template, mm_mode: str = "auto",
                     pres = pres.at[gid2.reshape(-1)].max(1)
                     outs[f"{k}_pres"] = pres[: num_groups * card].reshape(num_groups, card)
                 elif name == "distinctcounthll":
-                    from pinot_tpu.ops import groupby_mm as mm
-
                     log2m = extra
                     m = 1 << log2m
-                    # per-doc value hashes, gathered host-side at upload
-                    h = cols["hh::" + argt]
-                    idx, rho = hll_ops.hll_idx_rho(h, log2m)
-                    slot = jnp.where(mask, gid * m + idx, num_groups * m)
-                    if (_final and sorted_hll_ok and mm_mode != "off"
-                            and not mm.hll_supported(num_groups, log2m)
-                            and num_groups * m < (1 << 26)
-                            # the 3-channel group_sums launch must fit its
-                            # own VMEM budget too (huge-G shapes keep the
-                            # scatter path)
-                            and mm.mm_supported(num_groups, 3)):
+                    if _hll_sort_eligible(_final, sorted_hll_ok, num_groups,
+                                          log2m, mm_mode):
+                        sk_key = f"sk::{argt}::{log2m}"
+                        if filter_tpl == ("true",) and sk_key in cols:
+                            # FILTERLESS: the batch's cached sorted
+                            # projection already holds the packed keys —
+                            # no per-query sort at all
+                            outs[f"{k}_hs"] = _hll_sums_from_sorted(
+                                cols[sk_key], num_groups, log2m, mm_mode)
+                            continue
+                        h = cols["hh::" + argt]
+                        idx, rho = hll_ops.hll_idx_rho(h, log2m)
+                        slot = jnp.where(mask, gid * m + idx,
+                                         num_groups * m)
                         outs[f"{k}_hs"] = _hll_sorted_sums(
                             slot, rho, num_groups, log2m, mm_mode)
                     else:
+                        # per-doc value hashes, gathered host-side at upload
+                        h = cols["hh::" + argt]
+                        idx, rho = hll_ops.hll_idx_rho(h, log2m)
+                        slot = jnp.where(mask, gid * m + idx,
+                                         num_groups * m)
                         outs[f"{k}_regs"] = _hll_regs(
                             slot, rho, num_groups, log2m, mm_mode
                         )
@@ -965,12 +995,22 @@ class DeviceExecutor:
             self._pipelines[(template, self.mm_mode)] = entry
         pipeline, inner, layout_cache = entry
 
+        # SET useSortedProjection=false keeps the per-query in-pipeline
+        # sort (the cold-scan measurement form); default taps the batch's
+        # cached sorted projection for filterless terminal HLL
+        sorted_proj_ok = q.options_ci().get("usesortedprojection") is not False
         needed = self._needed_columns(filter_tpl) | set(group_cols)
         for name, argt, extra in agg_tpls:
             if name == "distinctcount":
                 needed.add(argt)
             elif name == "distinctcounthll":
-                needed.add("hh::" + argt)
+                if (shape == "groupby" and filter_tpl == ("true",)
+                        and sorted_proj_ok
+                        and _hll_sort_eligible(final, self.mesh is None,
+                                               total, extra, self.mm_mode)):
+                    needed.add(f"sk::{argt}::{extra}")
+                else:
+                    needed.add("hh::" + argt)
             elif name == "hllmerge":
                 needed.add("bp::" + argt)
             elif name in ("firstwithtime", "lastwithtime"):
@@ -982,6 +1022,10 @@ class DeviceExecutor:
         for c in sorted(needed):
             if c.startswith("dv::"):
                 cols[c] = ctx.decoded_column(c[4:])
+            elif c.startswith("sk::"):
+                _, colname, l2m = c.split("::")
+                cols[c] = ctx.sorted_hll_keys(
+                    group_cols, group_cards, colname, int(l2m))
             elif c.startswith("hh::"):
                 cols[c] = ctx.prehashed_column(c[4:])
             elif c.startswith("bp::"):
@@ -1007,7 +1051,8 @@ class DeviceExecutor:
         # round trip (measured ~100ms each on the bench tunnel). The layout
         # is shape-deterministic per (template, batch shapes) — eval_shape
         # traces without touching the device.
-        lkey = (ctx.S, next(iter(cols.values())).shape[1])
+        lkey = (ctx.S, next(v for k, v in cols.items()
+                            if not k.startswith("sk::")).shape[1])
         layout = layout_cache.get(lkey)
         if layout is None:
             layout = _out_layout(jax.eval_shape(inner, cols, n_docs, params))

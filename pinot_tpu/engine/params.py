@@ -70,6 +70,7 @@ class BatchContext:
         self._decoded: dict[str, object] = {}       # name -> (S, L) decoded values
         self._prehashed: dict[str, object] = {}     # name -> (S, L) value hashes
         self._mv_columns: dict[str, object] = {}    # name -> (S, L, K) id blocks
+        self._sorted_hll: dict = {}   # (group_cols, hash_col, log2m) -> sorted keys
 
     # ---- column access ---------------------------------------------------
     def column_meta(self, name: str):
@@ -250,12 +251,52 @@ class BatchContext:
 
     def device_bytes(self) -> int:
         """HBM resident bytes of materialized column blocks (columns +
-        decoded + prehashed) — the executor's byte-aware LRU eviction key."""
+        decoded + prehashed + sorted projections) — the executor's
+        byte-aware LRU eviction key."""
         total = 0
-        for d in (self._columns, self._decoded, self._prehashed, self._mv_columns):
+        for d in (self._columns, self._decoded, self._prehashed,
+                  self._mv_columns, self._sorted_hll):
             for arr in d.values():
                 total += getattr(arr, "nbytes", 0)
         return total
+
+    def sorted_hll_keys(self, group_cols, group_cards, hash_col: str,
+                        log2m: int):
+        """(n_total,) device int32: SORTED packed ``slot << 5 | rho`` keys
+        for the FILTERLESS HLL scan over these group columns — a lazily
+        built sorted projection, cached per batch exactly like the
+        prehashed/decoded columns (the role a sorted index plays in the
+        reference: built once, reused by every later query of the shape).
+        The first query pays the lax.sort (~320ms at 100M rows on v5e);
+        repeats reduce boundaries + one matmul (~60ms)."""
+        key = (tuple(group_cols), tuple(group_cards), hash_col, int(log2m))
+        if key not in self._sorted_hll:
+            import jax
+
+            from pinot_tpu.ops import agg as agg_ops
+            from pinot_tpu.ops import hll as hll_ops
+            from pinot_tpu.ops import masks as mask_ops
+
+            num_groups = 1
+            for c in group_cards:
+                num_groups *= int(c)
+            m = 1 << log2m
+            per_col = [self.column(c) for c in group_cols]
+            hh = self.prehashed_column(hash_col)
+
+            def build(cols_list, h, n_docs):
+                valid = mask_ops.valid_mask(n_docs, h.shape[1], batched=True)
+                gid = agg_ops.group_ids_combine(
+                    cols_list, group_cards, valid, num_groups)
+                idx, rho = hll_ops.hll_idx_rho(h, log2m)
+                slot = jnp.where(valid, gid * m + idx, num_groups * m)
+                k32 = (slot.reshape(-1).astype(jnp.int32) << 5) \
+                    | rho.reshape(-1).astype(jnp.int32)
+                return jax.lax.sort(k32)
+
+            self._sorted_hll[key] = jax.jit(build)(
+                per_col, hh, self.n_docs_dev)
+        return self._sorted_hll[key]
 
     def int_bounds(self, name: str):
         """(min, max) over the batch from column metadata, or None."""

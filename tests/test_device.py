@@ -351,3 +351,61 @@ class TestDeviceDistinct:
         sql = "SELECT DISTINCT ivalue + 1 FROM t ORDER BY ivalue + 1 LIMIT 5"
         rd, rh = dev.execute(sql), host.execute(sql)
         assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+
+class TestSortedProjection:
+    def test_cached_projection_matches_cold_and_host(self, tmp_path):
+        """The lazily-built sorted (group, hash) projection answers
+        filterless terminal HLL scans bit-identically to the in-query-sort
+        and host paths, and is actually CACHED on the batch."""
+        import numpy as np
+
+        from pinot_tpu.common.datatypes import DataType
+        from pinot_tpu.common.schema import Schema
+        from pinot_tpu.engine.engine import QueryEngine
+        from pinot_tpu.storage.creator import build_segment
+
+        rng = np.random.default_rng(13)
+        n = 60_000
+        # u must be a DIMENSION (dict-encoded): the device HLL path
+        # prehashes dictionary values
+        schema = Schema.build(
+            name="sp", dimensions=[("g", DataType.INT), ("u", DataType.LONG)],
+            metrics=[("v", DataType.INT)])
+        segs = []
+        for i in range(2):
+            cols = {
+                # global card high enough that G*m exceeds the mm register
+                # kernel's bound -> the sorted paths engage (log2m=10)
+                "g": rng.integers(0, 3000, n).astype(np.int32),
+                "u": rng.integers(0, 500_000, n).astype(np.int64),
+                "v": rng.integers(0, 9, n).astype(np.int32),
+            }
+            segs.append(build_segment(
+                schema, cols, str(tmp_path / f"s{i}"), segment_name=f"s{i}"))
+        sql = ("SET useStarTree = false; "
+               "SELECT g, COUNT(*), DISTINCTCOUNTHLL(u) FROM sp "
+               "GROUP BY g ORDER BY COUNT(*) DESC, g LIMIT 20")
+        cold_sql = sql.replace("SET useStarTree = false; ",
+                               "SET useStarTree = false; "
+                               "SET useSortedProjection = false; ")
+        from pinot_tpu.engine.device import DeviceExecutor
+
+        eng = QueryEngine(device_executor=DeviceExecutor(mm_mode="interpret"))
+        for s in segs:
+            eng.add_segment("sp", s)
+        warm = eng.execute(sql)
+        assert not warm.get("exceptions"), warm
+        # the projection is resident on the batch after the first execute
+        ctx = next(iter(eng.device._batches.values()))
+        assert ctx._sorted_hll, "sorted projection was not cached"
+        again = eng.execute(sql)
+        cold = eng.execute(cold_sql)
+        host_eng = QueryEngine(device_executor=None)
+        for s in segs:
+            host_eng.add_segment("sp", s)
+        host = host_eng.execute(sql)
+        rows = warm["resultTable"]["rows"]
+        assert rows == again["resultTable"]["rows"]
+        assert rows == cold["resultTable"]["rows"]
+        assert rows == host["resultTable"]["rows"]
