@@ -335,8 +335,11 @@ def bench_suite(engine, queries, warm=2, iters=7):
     kernel_ms (amortized repeated-launch device time,
     DeviceExecutor.profile_last_launch), host_ms (wall minus the blocking
     device_get wait — measured, not floor-subtracted: the tunnel's RTT
-    variance above its floor is link, not engine), link_ms (the get wait
-    minus kernel), and effective GB/s of device-resident bytes the kernel
+    variance above its floor is link, not engine), link_ms (median of the
+    SAME per-iteration get-wait samples minus kernel, clamped at 0 — the
+    old p50 - kernel - host arithmetic mixed medians of different sample
+    sets and went negative on short queries), and effective GB/s of
+    device-resident bytes the kernel
     read vs HBM peak (VERDICT r4 #1: hardware efficiency must be a
     measured number)."""
     detail = {}
@@ -352,6 +355,7 @@ def bench_suite(engine, queries, warm=2, iters=7):
             dev._last_launch = None
             dev.last_get_wait_s = None
         host_samples = []
+        get_samples = []
         lat = []
         for _ in range(iters):
             if dev is not None:
@@ -365,6 +369,7 @@ def bench_suite(engine, queries, warm=2, iters=7):
             get_wait = getattr(dev, "last_get_wait_s", None) if dev else None
             if get_wait is not None:
                 host_samples.append(max(0.0, wall - get_wait))
+                get_samples.append(get_wait)
         entry = {}
         if dev is not None and dev.fetch_bytes_total > b0[0]:
             entry["fetch_kb_per_query"] = round(
@@ -387,9 +392,12 @@ def bench_suite(engine, queries, warm=2, iters=7):
             entry["kernel_ms"] = round(kernel_s * 1e3, 2)
             entry["host_ms"] = round(
                 float(np.median(host_samples)) * 1e3, 2) if host_samples else None
+            # link = blocking get-wait minus kernel, from the SAME
+            # per-iteration samples host_ms uses; clamp at 0 so RTT
+            # jitter on short queries can't report a negative component
             entry["link_ms"] = round(
-                entry["p50_ms"] - entry["kernel_ms"]
-                - (entry["host_ms"] or 0.0), 2)
+                max(0.0, float(np.median(get_samples)) * 1e3
+                    - entry["kernel_ms"]), 2) if get_samples else None
             entry["device_bytes_read_gb"] = round(bytes_in / 1e9, 2)
             if kernel_s > 5e-4:  # sub-0.5ms kernels: amortized diff ≈ noise
                 gbps = bytes_in / kernel_s / 1e9
@@ -464,11 +472,13 @@ def bench_micro():
     # dense scatter-add group sum (the non-MXU fallback)
     rec("scatter_group_sum", devtime(
         lambda g, x: agg_ops.group_sum(g, x, G), gid, v), 8 * N)
-    # one-hot matmul group-by, 4 bf16 channels (count + 3 byte planes)
+    # one-hot matmul group-by, 4 bf16 channels (count + 3 byte planes) —
+    # first_channel_ones matches the production call (_try_mm_groupby),
+    # which folds the count channel into the hi one-hot
     def mm4(g, x):
         chans = jnp.stack(
             [jnp.ones(N, jnp.bfloat16)] + mm.int_planes(x, jnp.int64(0), 3))
-        return mm.group_sums(g, chans, G)
+        return mm.group_sums(g, chans, G, first_channel_ones=True)
     rec("mm_groupby_4ch", devtime(mm4, gid, v, iters=3), 8 * N)
     # HLL register scatter-max at the q4 shape (G*m slots)
     m = 1 << LOG2M
@@ -485,12 +495,40 @@ def bench_micro():
         slot = g * m + idx
         return _hll_sorted_sums(slot, rho, G, LOG2M, "auto")
     rec("hll_sorted_sums", devtime(hllsort, gid, h, iters=3), 8 * N)
-    # sort-based high-cardinality group-by key sort
+    # sort-based high-cardinality group-by key sort (the RETIRED monolithic
+    # basis — kept as the baseline the radix micros are judged against)
     key = jax.jit(lambda g, x: (g.astype(jnp.int64) << 20)
                   | x.astype(jnp.int64))(gid, v)
     jax.device_get(jnp.sum(key[:1]))
     rec("sortkey_int64", devtime(lambda k: jax.lax.sort(k), key, iters=3),
         8 * N)
+
+    # radix-partitioned group-by primitives (ops/radix_groupby.py — the
+    # basis that replaced the monolithic sort above). Key space ~100k
+    # distinct over 100M rows: the q4 high-cardinality scan shape. The
+    # packed key is int32 (pack_keys narrows when the cartesian space
+    # fits), so the comparator passes move half the bytes.
+    from pinot_tpu.engine.device import MAX_SORTED_GROUPS
+    from pinot_tpu.ops import radix_groupby as radix_ops
+
+    HC = 100_000  # distinct-key target (fits MAX_SORTED_GROUPS = 1<<17)
+    key32 = jax.jit(lambda hh: radix_ops.pack_keys(
+        [(hh % HC).astype(jnp.int32)], (HC,),
+        jnp.ones(N, dtype=bool)))(h)
+    v64 = jax.jit(lambda x: x.astype(jnp.int64))(v)
+    jax.device_get(jnp.sum(key32[:1]))
+    # occupancy probe: radix histogram of the key's high bits via the
+    # factored one-hot matmul kernel (folded count channel)
+    rec("radix_bucket_histogram", devtime(
+        lambda k: radix_ops.bucket_histogram(k, HC, 1024), key32, iters=3),
+        4 * N)
+    # the full chunked aggregate: level-1 chunk sorts + run-end partials +
+    # compacted merge, COUNT + int SUM payload riding along
+    def radix_agg(k, x):
+        return radix_ops.chunked_group_aggregate(
+            k, {"p0": (x, "int")}, {"p0"}, set(), set(), MAX_SORTED_GROUPS)
+    rec("radix_groupby_chunked", devtime(radix_agg, key32, v64, iters=3),
+        12 * N)
 
     # bit-unpack: host C++ forward-index decode (native/packer.cpp)
     try:
@@ -758,7 +796,8 @@ def main():
                             "per-query kernel_ms = amortized repeated-"
                             "launch device time; host_ms = wall minus the "
                             "blocking device-wait (measured); link_ms = "
-                            "the remainder (tunnel round trip; floor is "
+                            "median per-iteration get-wait minus kernel, "
+                            "clamped at 0 (tunnel round trip; floor is "
                             "the MINIMUM, typical RTT runs above it). "
                             "kernel_gbps/hbm_peak_pct rate the kernel "
                             "against the chip's memory system. The "

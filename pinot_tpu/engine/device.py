@@ -38,6 +38,7 @@ from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
 from pinot_tpu.ops import agg as agg_ops
 from pinot_tpu.ops import hll as hll_ops
 from pinot_tpu.ops import masks as mask_ops
+from pinot_tpu.ops import radix_groupby as radix_ops
 from pinot_tpu.ops.transform import get_function
 from pinot_tpu.query.context import Expression, QueryContext
 from pinot_tpu.storage.segment import Encoding
@@ -233,7 +234,7 @@ def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs):
 
     sums = mm.group_sums(
         gid.reshape(-1), jnp.stack(channels), num_groups,
-        interpret=(mm_mode == "interpret"),
+        interpret=(mm_mode == "interpret"), first_channel_ones=True,
     )
     gcount = jnp.round(sums[0]).astype(jnp.int64)
     outs["gcount"] = gcount
@@ -312,20 +313,22 @@ def _hll_sums_from_sorted(sk, num_groups, log2m, mm_mode):
 
 def _hll_sorted_sums(slot, rho, num_groups, log2m, mm_mode):
     """TERMINAL-only register-free HLL build for group counts too large
-    for the matmul register kernel: one global sort of packed
-    (slot << 5 | rho) int32 keys dedupes (register, rank) pairs, then
-    _hll_sums_from_sorted reduces them to per-GROUP scaled sums that
-    recombine to the exact Σ 2^-reg (ops/hll.py estimate_from_sums_jnp).
-    Replaces the 100M-row scatter-max (measured ~665ms on v5e) with sort
-    (~320ms) + matmul (~40ms). NOT mergeable across shards/servers (same
+    for the matmul register kernel: chunk-local sorts of packed
+    (slot << 5 | rho) int32 keys dedupe (register, rank) pairs down to
+    per-slot maxima (ops/radix_groupby.py hll_chunked_sorted_keys — the
+    radix-partitioned replacement for the old monolithic lax.sort, which
+    ran HBM-bound at ~1.6 GB/s over the full row-scale key array), then
+    _hll_sums_from_sorted reduces the surviving keys to per-GROUP scaled
+    sums that recombine to the exact Σ 2^-reg (ops/hll.py
+    estimate_from_sums_jnp). NOT mergeable across shards/servers (same
     slot on two shards would double-count), hence terminal-only; the
     scatter path remains the mergeable form. FILTERLESS queries skip the
     sort entirely via the batch's cached sorted projection
     (params.BatchContext.sorted_hll_keys)."""
     key = (slot.reshape(-1).astype(jnp.int32) << 5) \
         | rho.reshape(-1).astype(jnp.int32)
-    return _hll_sums_from_sorted(jax.lax.sort(key), num_groups, log2m,
-                                 mm_mode)
+    sk = radix_ops.hll_chunked_sorted_keys(key, num_groups * (1 << log2m))
+    return _hll_sums_from_sorted(sk, num_groups, log2m, mm_mode)
 
 
 def _hll_sort_eligible(final, sorted_hll_ok, num_groups, log2m, mm_mode):
@@ -480,115 +483,74 @@ def build_pipeline(template, mm_mode: str = "auto",
         outs = {"doc_count": jnp.sum(seg_matched), "seg_matched": seg_matched}
 
         if shape == "groupby_sorted":
-            # SORT-BASED high-cardinality regime: dense accumulators would
-            # blow HBM past MAX_DENSE_GROUPS, so sort the combined int64
-            # keys (payload values ride along), derive group boundaries,
-            # and scatter into a numGroupsLimit-capped table — the
-            # MAP_BASED regime of DictionaryBasedGroupKeyGenerator, done
-            # the XLA way (one lax.sort, static shapes throughout).
-            # K comes from the engine's num_groups_limit (template-encoded);
-            # overflow is detected host-side and falls back to the host
-            # path so device truncation policy never leaks into results.
+            # RADIX-PARTITIONED high-cardinality regime (the MAP_BASED
+            # analog of DictionaryBasedGroupKeyGenerator): dense
+            # accumulators would blow HBM past MAX_DENSE_GROUPS, so the
+            # packed group key rides ops/radix_groupby.py — chunk-local
+            # sorts + run-end partials + compacted multi-level merge —
+            # instead of the old monolithic lax.sort of the full (n,)
+            # int64 key array (~1.6 GB/s at 100M rows; BENCH_r05
+            # micro.sortkey_int64). Keys pack int32 when the cartesian
+            # key space allows (half the comparator bytes). K comes from
+            # the engine's num_groups_limit (template-encoded); overflow
+            # is detected host-side and falls back to the host path so
+            # device truncation policy never leaks into results. The
+            # (K,) table this emits is keyed, so parallel/mesh.py can
+            # merge per-shard tables (merge_tables) — the old basis was
+            # not mesh-combinable at all.
             K = sorted_k
             per_col = [cols[c] for c in group_cols]
-            key = agg_ops.combine_keys_int64(per_col, group_cards, mask)
-            flat_key = key.reshape(-1)
-            n_rows = flat_key.shape[0]
+            key = radix_ops.pack_keys(per_col, group_cards, mask)
             # dedup payloads by argument template: MIN(x)+MAX(x)+AVG(x)
-            # must carry ONE copy of x, not three. Only args consumed via
-            # the cumsum path (sum/avg) ride the PRIMARY sort; min/max-only
-            # args would be sorted twice for nothing (they get their own
-            # secondary-key sort below)
-            payloads, payload_of = [], {}
-            int_payload = {}
-            minmax_args = set()
-            sum_args = set()
-            arg_exprs = {}
+            # must carry ONE copy of x through the level-1 sort, not three
+            payloads, pname_of = {}, {}
+            sums, mins, maxs = set(), set(), set()
             for i, (name, argt, extra) in enumerate(aggs):
                 if name == "count":
                     continue
-                if argt not in arg_exprs:
+                if argt not in pname_of:
                     v = _eval_expr(argt, cols, params)
                     # integer args accumulate exactly in int64 (the host /
                     # dense paths are exact; per-doc f64 adds would round)
                     as_int = jnp.issubdtype(v.dtype, jnp.integer)
-                    int_payload[argt] = as_int
                     dt = jnp.int64 if as_int else jnp.float64
-                    arg_exprs[argt] = v.astype(dt).reshape(-1)
-                if name in ("min", "max", "minmaxrange"):
-                    minmax_args.add(argt)
+                    pname = f"p{len(payloads)}"
+                    pname_of[argt] = pname
+                    payloads[pname] = (v.astype(dt).reshape(-1),
+                                       "int" if as_int else "float")
+                pname = pname_of[argt]
                 if name in ("sum", "avg"):
-                    sum_args.add(argt)
-            for argt in sum_args:
-                payload_of[argt] = len(payloads)
-                payloads.append(arg_exprs[argt])
-            sorted_ops = jax.lax.sort([flat_key] + payloads, num_keys=1)
-            sk = sorted_ops[0]
-            is_start = jnp.concatenate(
-                [jnp.ones(1, dtype=bool), sk[1:] != sk[:-1]])
-            real = sk != agg_ops.INT64_SENTINEL
-            sid = jnp.cumsum(is_start) - 1
-            outs["n_groups_total"] = jnp.sum(is_start & real)
-            sid_c = jnp.where(real & (sid < K), sid, K).astype(jnp.int32)
-            # After the sort, each table slot's rows are CONTIGUOUS and sid
-            # ascends 0..G-1 (sentinel rows sort last and land in slot K).
-            # One int32 position scatter yields each slot's LAST row; every
-            # additive aggregate is then a cumsum difference at those
-            # boundaries and min/max come from secondary-key sorts — int64
-            # scatter-adds here measured 8-30x slower than this on v5e
-            # (5.3s -> ~0.5s at 12M rows).
-            pos = jnp.arange(n_rows, dtype=jnp.int32)
-            end_pos = jnp.full(K + 1, -1, dtype=jnp.int32).at[sid_c].max(pos)
-            ends = end_pos[:K]
-            prev = jnp.concatenate([jnp.full(1, -1, dtype=jnp.int32),
-                                    ends[:-1]])
-            empty = ends < 0
-            e_idx = jnp.clip(ends, 0, n_rows - 1)
-            p_idx = jnp.clip(prev, 0, n_rows - 1)
-            outs["skeys"] = jnp.where(
-                empty, agg_ops.INT64_SENTINEL, sk[e_idx])
-            outs["gcount"] = jnp.where(
-                empty, 0, (ends - prev).astype(jnp.int64))
-
-            def seg_sum(argt):
-                v_sorted = sorted_ops[1 + payload_of[argt]]
-                if int_payload[argt]:
-                    # exact for ints even if the running total wraps: the
-                    # two's-complement difference recovers the group sum
-                    csum = jnp.cumsum(v_sorted)
-                    hi = csum[e_idx]
-                    lo = jnp.where(prev >= 0, csum[p_idx], 0)
-                    return jnp.where(empty, 0, hi - lo)
-                # floats: a global cumsum difference suffers catastrophic
-                # cancellation when a group's sum is tiny next to the
-                # running total — keep the order-independent f64 scatter
-                # (matches host/dense float semantics; ints carry the perf)
-                return jnp.zeros(K + 1, dtype=jnp.float64).at[sid_c].add(
-                    v_sorted)[:K]
-
-            # min/max: re-sort with the value as a SECONDARY key, so each
-            # slot's minimum sits at its first row and maximum at its last
-            mm_sorted = {}
-            for argt in minmax_args:
-                _, vv = jax.lax.sort(
-                    [flat_key, arg_exprs[argt]], num_keys=2)
-                mm_sorted[argt] = vv
+                    sums.add(pname)
+                if name in ("min", "minmaxrange"):
+                    mins.add(pname)
+                if name in ("max", "minmaxrange"):
+                    maxs.add(pname)
+            tbl = radix_ops.chunked_group_aggregate(
+                key.reshape(-1), payloads, sums, mins, maxs, K)
+            empty = tbl["empty"]
+            outs["n_groups_total"] = tbl["n_groups_total"]
+            outs["skeys"] = tbl["skeys"]
+            outs["gcount"] = tbl["gcount"]
+            # empty-slot fills are each reduction's NEUTRAL element, so a
+            # cross-shard merge of partially-filled tables stays exact
             for i, (name, argt, extra) in enumerate(aggs):
                 k = f"a{i}"
                 if name == "count":
                     continue
-                is_int = int_payload[argt]
+                pname = pname_of[argt]
+                is_int = payloads[pname][1] == "int"
                 if name in ("sum", "avg"):
-                    outs[f"{k}_sum"] = seg_sum(argt)
+                    s = tbl["sum::" + pname]
+                    outs[f"{k}_sum"] = jnp.where(
+                        empty, jnp.zeros((), s.dtype), s)
                 if name in ("min", "minmaxrange"):
-                    vv = mm_sorted[argt]
-                    start = jnp.clip(prev + 1, 0, n_rows - 1)
                     lo_fill = jnp.iinfo(jnp.int64).max if is_int else jnp.inf
-                    outs[f"{k}_min"] = jnp.where(empty, lo_fill, vv[start])
+                    outs[f"{k}_min"] = jnp.where(
+                        empty, lo_fill, tbl["min::" + pname])
                 if name in ("max", "minmaxrange"):
-                    vv = mm_sorted[argt]
                     hi_fill = jnp.iinfo(jnp.int64).min if is_int else -jnp.inf
-                    outs[f"{k}_max"] = jnp.where(empty, hi_fill, vv[e_idx])
+                    outs[f"{k}_max"] = jnp.where(
+                        empty, hi_fill, tbl["max::" + pname])
             return outs
 
         if shape == "groupby":
@@ -943,10 +905,11 @@ class DeviceExecutor:
             if total >= (1 << 62):
                 raise DeviceUnsupported(
                     f"combined group key overflows int64 ({total})")
-            if self.mesh is not None:
-                # shard-local sorts produce unaligned group tables that a
-                # psum cannot merge; multi-chip high-card stays on host
-                raise DeviceUnsupported("sorted group-by not mesh-combinable")
+            # per-shard radix tables are KEYED (skeys + neutral empty-slot
+            # fills), so the mesh combine merges them by key
+            # (parallel/mesh.py _combine_sorted_table via
+            # ops/radix_groupby.py merge_tables) — no dense psum alignment
+            # needed; multi-chip high-card no longer routes to the host
             for a in aggs:
                 if a.name not in SORTED_AGGS:
                     raise DeviceUnsupported(

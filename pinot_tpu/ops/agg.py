@@ -190,21 +190,8 @@ def group_ids_combine(per_col_gids, cardinalities, mask, num_groups: int):
     return jnp.where(mask, gid, num_groups)
 
 
-INT64_SENTINEL = (1 << 63) - 1  # masked-doc key: sorts after every real key
-
-
-def combine_keys_int64(per_col_gids, cardinalities, mask):
-    """Cartesian combined key as int64 for the SORT-BASED high-cardinality
-    regime (the MAP_BASED analog of DictionaryBasedGroupKeyGenerator):
-    same arithmetic as group_ids_combine but uncapped — the caller
-    guarantees the product of cardinalities fits int64. Masked docs get
-    the sentinel so they sort to the tail and fall into the overflow
-    bucket."""
-    key = None
-    for g, c in zip(per_col_gids, cardinalities):
-        g = jnp.clip(g, 0, c - 1).astype(jnp.int64)
-        key = g if key is None else key * c + g
-    return jnp.where(mask, key, INT64_SENTINEL)
+# high-cardinality key packing moved to ops/radix_groupby.py pack_keys
+# (same cartesian arithmetic, dtype-narrowing + sentinel handling there)
 
 
 def distinct_presence(gids, num_groups: int):

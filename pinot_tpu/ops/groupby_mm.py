@@ -7,14 +7,19 @@ hot group-by shapes. Measured on v5e at 12M rows, G=6240, 6 channels:
 scatter path ~250ms compute, this kernel ~26ms — channels are nearly free
 because they ride the MXU.
 
-Design (radix-128 factored one-hot):
-    gid = hi*128 + lo.  Per row-block of ``blk`` rows:
-      oh_loT (128, blk) : oh_loT[j, l] = (lo_l == j)  — rows on lanes
+Design (factored one-hot, planned low radix ``lo`` in {32, 64, 128}):
+    gid = hi*lo + lo_bits.  Per row-block of ``blk`` rows:
+      oh_loT (lo, blk)  : oh_loT[j, l] = (lo_l == j)  — rows on lanes
       oh_hi (hpad, blk) : oh_hi[h, l]  = (hi_l == h)  — rows on lanes
       per channel a:     chh_a = oh_hi * ch_a(1, blk)  (masked channel)
                          acc[a] += chh_a @ oh_loT^T    (NT dot_general,
                                                         MXU contracts rows)
-    acc[a, h, j] == sum over rows with gid == h*128+j of channel a.
+    acc[a, h, j] == sum over rows with gid == h*lo+j of channel a.
+    ``_plan_lo`` picks the radix that balances VPU one-hot build cost
+    against hpad growth per shape; an all-ones first channel (the count
+    channel every dense group-by carries) is FOLDED into oh_hi — its
+    masked-channel multiply is the identity, so the kernel skips it
+    (``first_channel_ones``).
 
 The 3-way contraction channel x hi-onehot x lo-onehot never materializes
 the full (blk, G) one-hot: the VPU builds two small one-hots (~0.3
@@ -49,6 +54,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax <= 0.4.x spells the Mosaic params class TPUCompilerParams; newer
+# releases renamed it. Resolve once so the kernel runs (interpret mode
+# included) on both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 BLK = 8192              # rows per grid step (64 lane-rows of 128); larger
                         # blocks amortize per-step overhead — measured 35.8
                         # -> 30.3ms for the 4-channel q1 shape at 100M rows
@@ -67,7 +78,7 @@ TRANSIENT_BUDGET = 24 << 20  # in-kernel bf16 one-hot/channel transients;
                              # pre-retune value) until they fit
 
 
-def _plan_blk(a_real: int, hpad: int):
+def _plan_blk(a_real: int, hpad: int, lo: int):
     """(blk, ninner, stacked): per-shape block size. The one-hot and
     channel transients scale with hpad*blk, so large-hpad shapes (HLL rho
     mode near its support bound) shrink blk back toward 2048 — the value
@@ -78,7 +89,7 @@ def _plan_blk(a_real: int, hpad: int):
     while True:
         stacked = a_real * hpad * blk * 2 <= STACK_MAX_BYTES
         chh_rows = a_real * hpad if stacked else hpad
-        transient = (128 + hpad + chh_rows) * blk * 2
+        transient = (lo + hpad + chh_rows) * blk * 2
         if transient <= TRANSIENT_BUDGET or blk <= 2048:
             return blk, SUPERBLOCK // blk, stacked
         blk //= 2
@@ -87,28 +98,57 @@ _i32 = jnp.int32
 _NT = (((1,), (1,)), ((), ()))  # contract lanes-with-lanes (rows axis)
 
 
-def mm_supported(num_groups: int, n_channels: int) -> bool:
-    hpad = _hpad(num_groups)
+def _plan_lo(num_groups: int, a_real: int, ones_first: bool) -> int:
+    """Low-radix factor of the factored one-hot (gid = hi*lo + lo_bits).
+    The kernel is VPU-bound on building the one-hots: per row it compares
+    ``lo`` lanes for the lo one-hot, ``hpad`` for the hi one-hot, and
+    multiplies ``(a_real - folded) * hpad`` channel lanes, so the radix
+    that balances the two one-hots beats a fixed 128 for small G (q1's
+    G=2000 shape: lo=64 trades 128 lo-lanes for 32 hi-rows). The MXU pads
+    the dot's N dim to the 128-lane tile either way — but so does VMEM:
+    the accumulator's minor dim pads to 128 LANES regardless of ``lo``,
+    so a small radix doubles the physical accumulator (hpad doubles,
+    lanes don't shrink). Radixes whose physical acc would not fit are
+    skipped, which keeps the support surface exactly the radix-128 one."""
+    folded = 1 if ones_first else 0
+    best, best_cost = 128, None
+    for lo in (32, 64, 128):
+        hpad = _hpad(num_groups, lo)
+        if lo != 128 and a_real * hpad * 128 > MAX_ACC_CELLS:
+            continue
+        cost = 2 * lo + 2 * hpad + max(0, a_real - folded) * hpad
+        if best_cost is None or cost < best_cost:
+            best, best_cost = lo, cost
+    return best
+
+
+def mm_supported(num_groups: int, n_channels: int,
+                 ones_first: bool = True) -> bool:
+    lo = _plan_lo(num_groups, n_channels + 1, ones_first)
+    hpad = _hpad(num_groups, lo)
+    # physical cells: the acc minor dim pads to the 128-lane tile
     return (n_channels + 1) * hpad * 128 <= MAX_ACC_CELLS
 
 
-def _hpad(num_groups: int) -> int:
-    return max(8, ((num_groups // 128 + 1 + 7) // 8) * 8)
+def _hpad(num_groups: int, lo: int = 128) -> int:
+    return max(8, ((num_groups // lo + 1 + 7) // 8) * 8)
 
 
 def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
-            *, ninner, hpad, a_real, blk, rho_mode, stacked):
+            *, ninner, hpad, a_real, blk, lo, rho_mode, stacked,
+            ones_first):
     i = pl.program_id(1)
 
     @pl.when(i == 0)
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    lo_shift = lo.bit_length() - 1                  # lo is a power of two
     ids_r = ids_ref[:].reshape(1, blk)              # sublane→lane merge: OK
-    lo_r = ids_r & 127
-    hi_r = ids_r >> 7
+    lo_r = ids_r & (lo - 1)
+    hi_r = ids_r >> lo_shift
 
-    jsub = jax.lax.broadcasted_iota(jnp.int32, (128, blk), 0)
+    jsub = jax.lax.broadcasted_iota(jnp.int32, (lo, blk), 0)
     oh_loT = jnp.where(lo_r == jsub, jnp.float32(1), jnp.float32(0)) \
         .astype(jnp.bfloat16)
     hsub = jax.lax.broadcasted_iota(jnp.int32, (hpad, blk), 0)
@@ -118,29 +158,34 @@ def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
     if rho_mode:
         rho_r = ch_ref[:].reshape(1, blk)           # lane-major int32 rho
 
-    def channel(a):
+    def chh(a):
         if rho_mode:
             # channel a = indicator(rho == a+1), built in-VMEM
-            return jnp.where(rho_r == a + 1, jnp.float32(1), jnp.float32(0)) \
+            ch = jnp.where(rho_r == a + 1, jnp.float32(1), jnp.float32(0)) \
                 .astype(jnp.bfloat16)
-        return ch_ref[pl.ds(a, 1), :]               # (1, blk) bf16
+            return oh_hi * ch
+        if a == 0 and ones_first:
+            # all-ones count channel: the masked-channel multiply is the
+            # identity — oh_hi IS the product (one (hpad, blk) multiply
+            # saved per block; callers guarantee overflow-slot slicing
+            # absorbs the pad rows this also counts)
+            return oh_hi
+        return oh_hi * ch_ref[pl.ds(a, 1), :]       # (1, blk) bf16
 
     if stacked:
         # stack every channel's masked hi one-hot into ONE dot: per-channel
         # M=hpad dots underfill the MXU's M tile, so 4 channels cost ~4x one
         # — stacked to M = a_real*hpad they cost ~1x (measured 58.6 -> 27ms
         # for 4 channels at G=2000, 100M rows on v5e)
-        chh_all = jnp.concatenate(
-            [oh_hi * channel(a) for a in range(a_real)], axis=0)
+        chh_all = jnp.concatenate([chh(a) for a in range(a_real)], axis=0)
         acc_flat = jax.lax.dot_general(
             chh_all, oh_loT, _NT, preferred_element_type=jnp.float32)
-        acc_ref[:] += acc_flat.reshape(a_real, hpad, 128)
+        acc_ref[:] += acc_flat.reshape(a_real, hpad, lo)
     else:
         # large-hpad (HLL rho) shapes: a stacked operand would blow VMEM
         for a in range(a_real):
-            chh = oh_hi * channel(a)
             acc_ref[a] += jax.lax.dot_general(
-                chh, oh_loT, _NT, preferred_element_type=jnp.float32
+                chh(a), oh_loT, _NT, preferred_element_type=jnp.float32
             )
 
     @pl.when(i == ninner - 1)
@@ -148,12 +193,12 @@ def _kernel(ids_ref, ch_ref, out_ref, acc_ref,
         out_ref[0] = acc_ref[:]
 
 
-def _launch(ids_lane, ch_operand, ch_spec_kind, *, a_real, hpad, nsuper,
-            rho_mode, interpret):
-    blk, ninner, stacked = _plan_blk(a_real, hpad)
+def _launch(ids_lane, ch_operand, ch_spec_kind, *, a_real, hpad, lo, nsuper,
+            rho_mode, interpret, ones_first=False):
+    blk, ninner, stacked = _plan_blk(a_real, hpad, lo)
     kern = functools.partial(
-        _kernel, ninner=ninner, hpad=hpad, a_real=a_real, blk=blk,
-        rho_mode=rho_mode, stacked=stacked,
+        _kernel, ninner=ninner, hpad=hpad, a_real=a_real, blk=blk, lo=lo,
+        rho_mode=rho_mode, stacked=stacked, ones_first=ones_first,
     )
     if ch_spec_kind == "channels":
         ch_spec = pl.BlockSpec(
@@ -171,9 +216,9 @@ def _launch(ids_lane, ch_operand, ch_spec_kind, *, a_real, hpad, nsuper,
     # acc=4.8MB), so budget 8x + headroom PLUS the blk-proportional
     # transients _plan_blk bounded; MAX_ACC_CELLS keeps the result under
     # the ceiling.
-    acc_bytes = a_real * hpad * 128 * 4
+    acc_bytes = a_real * hpad * 128 * 4  # minor dim pads to 128 lanes
     chh_rows = a_real * hpad if stacked else hpad
-    transient_bytes = (128 + hpad + chh_rows) * blk * 2
+    transient_bytes = (lo + hpad + chh_rows) * blk * 2
     vmem_limit = max(16 * 2**20,
                      min(110 * 2**20,
                          8 * acc_bytes + transient_bytes + 16 * 2**20))
@@ -186,13 +231,13 @@ def _launch(ids_lane, ch_operand, ch_spec_kind, *, a_real, hpad, nsuper,
             ch_spec,
         ],
         out_specs=pl.BlockSpec(
-            (1, a_real, hpad, 128),
+            (1, a_real, hpad, lo),
             lambda s, i: (s, _i32(0), _i32(0), _i32(0)),
             memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((nsuper, a_real, hpad, 128), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((a_real, hpad, 128), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(vmem_limit_bytes=vmem_limit),
+        out_shape=jax.ShapeDtypeStruct((nsuper, a_real, hpad, lo), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((a_real, hpad, lo), jnp.float32)],
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=vmem_limit),
         interpret=interpret,
     )(ids_lane, ch_operand)
     return jnp.sum(out, axis=0, dtype=jnp.float64)
@@ -205,16 +250,21 @@ def _pad_ids(gid, num_groups: int, n_pad: int, n: int):
     return ids.reshape(-1, 128)
 
 
-def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
+def group_sums(gid, channels, num_groups: int, *, interpret: bool = False,
+               first_channel_ones: bool = False):
     """Dense per-group sums of bf16 plane channels.
 
     gid: (n,) int32 in [0, num_groups]; id == num_groups is the overflow
     slot for masked/padded rows (sliced off).
     channels: (A, n) bf16 planes, |value| <= 255 for exact integer sums.
+    first_channel_ones: channels[0] is all-ones (the count channel) — the
+    kernel folds its masked-channel multiply into the hi one-hot. Pad rows
+    then count into the overflow slot, which this function slices off.
     Returns (A, num_groups) float64.
     """
     a_real, n = channels.shape
-    hpad = _hpad(num_groups)
+    lo = _plan_lo(num_groups, a_real, first_channel_ones)
+    hpad = _hpad(num_groups, lo)
     n_pad = ((n + SUPERBLOCK - 1) // SUPERBLOCK) * SUPERBLOCK
     nsuper = n_pad // SUPERBLOCK
 
@@ -222,9 +272,10 @@ def group_sums(gid, channels, num_groups: int, *, interpret: bool = False):
     ch = jnp.concatenate(
         [channels, jnp.zeros((a_real, n_pad - n), channels.dtype)], axis=1
     )
-    tot = _launch(ids_lane, ch, "channels", a_real=a_real, hpad=hpad,
-                  nsuper=nsuper, rho_mode=False, interpret=interpret)
-    return tot.reshape(a_real, hpad * 128)[:, :num_groups]
+    tot = _launch(ids_lane, ch, "channels", a_real=a_real, hpad=hpad, lo=lo,
+                  nsuper=nsuper, rho_mode=False, interpret=interpret,
+                  ones_first=first_channel_ones)
+    return tot.reshape(a_real, hpad * lo)[:, :num_groups]
 
 
 def rho_group_counts(slot, rho, num_groups: int, nrho: int, *,
@@ -237,7 +288,8 @@ def rho_group_counts(slot, rho, num_groups: int, nrho: int, *,
     Returns (nrho, num_groups) float64 counts.
     """
     n = slot.shape[0]
-    hpad = _hpad(num_groups)
+    lo = _plan_lo(num_groups, nrho, False)
+    hpad = _hpad(num_groups, lo)
     n_pad = ((n + SUPERBLOCK - 1) // SUPERBLOCK) * SUPERBLOCK
     nsuper = n_pad // SUPERBLOCK
 
@@ -246,8 +298,8 @@ def rho_group_counts(slot, rho, num_groups: int, nrho: int, *,
         [rho.astype(jnp.int32), jnp.zeros(n_pad - n, dtype=jnp.int32)]
     ).reshape(-1, 128)
     tot = _launch(ids_lane, rho_lane, "rho_lane", a_real=nrho, hpad=hpad,
-                  nsuper=nsuper, rho_mode=True, interpret=interpret)
-    return tot.reshape(nrho, hpad * 128)[:, :num_groups]
+                  lo=lo, nsuper=nsuper, rho_mode=True, interpret=interpret)
+    return tot.reshape(nrho, hpad * lo)[:, :num_groups]
 
 
 # ---------------------------------------------------------------------------
@@ -292,7 +344,9 @@ def hll_nrho(log2m: int) -> int:
 
 def hll_supported(num_groups: int, log2m: int) -> bool:
     nslots = num_groups * (1 << log2m)
-    return mm_supported(nslots, hll_nrho(log2m)) and nslots <= (1 << 20)
+    # rho mode has no folded count channel (ones_first=False)
+    return mm_supported(nslots, hll_nrho(log2m), ones_first=False) \
+        and nslots <= (1 << 20)
 
 
 def hll_registers(slot, rho, num_groups: int, log2m: int, *,

@@ -14,7 +14,11 @@ of the reference's parallel layers (SURVEY.md §2.9):
 
 Because group-by accumulators live in *global dictionary id space*
 (engine/params.py), the cross-chip psum is a dense elementwise reduce — no
-key exchange, no IndexedTable merge, no all-to-all.
+key exchange, no IndexedTable merge, no all-to-all. The one exception is
+the sorted/high-cardinality (radix) regime, whose per-shard tables are
+keyed, not slot-aligned: those merge by KEY over an all-gather
+(_combine_sorted_table — answer-sized work, the IndexedTable-merge analog
+done once per query on ICI).
 """
 
 from __future__ import annotations
@@ -26,6 +30,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# newer jax exposes shard_map at top level (replication checking spelled
+# check_vma); jax <= 0.4.x ships it in experimental as check_rep. Resolve
+# once so the combine layer runs on both.
+if hasattr(jax, "shard_map"):
+    _shard_map, _SM_KW = jax.shard_map, {"check_vma": False}
+else:  # pragma: no cover - exercised on jax 0.4.x only
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SM_KW = {"check_rep": False}
 
 SEG_AXIS = "segments"
 
@@ -52,6 +66,49 @@ def _combine_out(key: str, v):
     return jax.lax.psum(v, SEG_AXIS)
 
 
+def _combine_sorted_table(outs: dict) -> dict:
+    """KEY-ALIGNED merge for the sorted/high-cardinality (radix) regime:
+    each shard emits a (K,) group table whose slots are keyed by ``skeys``
+    (INT64_SENTINEL empties) with NEUTRAL empty-slot fills, so the same
+    group can sit in different slots on different shards and a dense psum
+    would be wrong. All-gather the (K,) tables to (D, K) and re-run the
+    radix level-2 combine over them (ops/radix_groupby.py merge_tables) —
+    answer-sized work, riding ICI. Overflow stays host-detected: if any
+    shard's table overflowed (shard_total > K, so its table is truncated
+    and the gathered keys are incomplete) the combined total is forced
+    past K so the executor's host fallback fires, exactly like
+    single-device."""
+    from pinot_tpu.ops import radix_groupby as radix_ops
+
+    # per-shard table length is min(shard_rows, sorted_k) — a SHARD-shape
+    # quantity. The merged table must hold every gathered entry (D*K), not
+    # one shard's length: merged distinct can legitimately exceed any
+    # single shard's table. numGroupsLimit semantics stay host-side, via
+    # the executor's n_groups_total check against sorted_k.
+    K = outs["skeys"].shape[-1]
+    reds, cols = {}, {}
+    for k, v in outs.items():
+        if k in ("doc_count", "seg_matched", "n_groups_total", "skeys"):
+            continue
+        reds[k] = "min" if k.endswith("_min") \
+            else "max" if k.endswith("_max") else "sum"
+        cols[k] = jax.lax.all_gather(v, SEG_AXIS)
+    skeys = jax.lax.all_gather(outs["skeys"], SEG_AXIS)
+    merged, fk, empty, merged_distinct = radix_ops.merge_tables(
+        skeys, cols, reds, skeys.shape[0] * K)
+    shard_total = outs["n_groups_total"]
+    overflow_total = jax.lax.pmax(
+        jnp.where(shard_total > K, shard_total, 0), SEG_AXIS)
+    combined = {
+        "doc_count": jax.lax.psum(outs["doc_count"], SEG_AXIS),
+        "seg_matched": outs["seg_matched"],
+        "skeys": jnp.where(empty, radix_ops.INT64_SENTINEL, fk),
+        "n_groups_total": jnp.maximum(merged_distinct, overflow_total),
+    }
+    combined.update(merged)
+    return combined
+
+
 def _combine_outs(outs: dict) -> dict:
     """Combine a pipeline's outputs across shards. Most keys combine
     independently (_combine_out); the FIRSTWITHTIME/LASTWITHTIME value
@@ -60,7 +117,11 @@ def _combine_outs(outs: dict) -> dict:
     winning time with pmin/pmax, mask each shard's values to rows that
     carry it, then pmax the values — associative, deterministic (ties on
     time break toward the largest value, matching
-    engine/aggspec.py FirstLastWithTimeSpec)."""
+    engine/aggspec.py FirstLastWithTimeSpec). The sorted/high-cardinality
+    regime's keyed group tables take the key-aligned merge instead
+    (_combine_sorted_table)."""
+    if "skeys" in outs:
+        return _combine_sorted_table(outs)
     combined = {}
     for k, v in outs.items():
         if k.endswith("_vtmin") or k.endswith("_vtmax"):
@@ -107,9 +168,9 @@ def shard_pipeline(pipeline_fn, mesh: Mesh):
         out_specs = {
             k: (P(SEG_AXIS) if k == "seg_matched" else P()) for k in outs_shape
         }
-        fn = jax.shard_map(
+        fn = _shard_map(
             sharded, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
+            **_SM_KW,
         )
         return fn(cols, n_docs, params)
 

@@ -74,6 +74,7 @@ def peer_download(registry, table: str, name: str, dest_dir: str,
         if info is None or not getattr(info, "grpc_port", None):
             continue
         ch = QueryRouterChannel(f"{info.host}:{info.grpc_port}", tls=tls)
+        tmp = f"{dest_dir}.peer{os.getpid()}"
         try:
             import tempfile
 
@@ -81,7 +82,6 @@ def peer_download(registry, table: str, name: str, dest_dir: str,
                 for chunk in ch.fetch_segment(req, timeout_s=timeout_s):
                     spool.write(chunk)
                 spool.seek(0)
-                tmp = f"{dest_dir}.peer{os.getpid()}"
                 shutil.rmtree(tmp, ignore_errors=True)
                 with tarfile.open(fileobj=spool, mode="r") as tar:
                     # filter="data" rejects symlink/hardlink/absolute
@@ -93,17 +93,19 @@ def peer_download(registry, table: str, name: str, dest_dir: str,
             if os.path.isdir(dest_dir):
                 # a concurrent loader finished first: keep its copy (same
                 # keep-existing race semantics as _download_segment)
-                shutil.rmtree(tmp, ignore_errors=True)
                 return dest_dir
             os.makedirs(os.path.dirname(dest_dir), exist_ok=True)
             os.replace(src, dest_dir)
-            shutil.rmtree(tmp, ignore_errors=True)
             log.info("segment %s/%s peer-downloaded from %s",
                      table, name, inst_id)
             return dest_dir
         except Exception as e:  # noqa: BLE001 — try the next replica
             errors.append(f"{inst_id}: {type(e).__name__}: {e}")
         finally:
+            # the extraction dir is removed on EVERY exit — including an
+            # os.replace failure after extractall, which used to leak it
+            # (only the success paths cleaned up)
+            shutil.rmtree(tmp, ignore_errors=True)
             ch.close()
     raise RuntimeError(
         f"peer download of {table}/{name} failed "

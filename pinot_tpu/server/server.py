@@ -186,11 +186,17 @@ class ServerInstance:
         return name
 
     def _handle_submit(self, request: bytes) -> bytes:
+        """Unary query submit. The ``queries`` metric counts at RECEIVE
+        time, before SQL compile, so ``queryErrors`` (which a parse error
+        increments) can never exceed ``queries`` on the dashboard. Compile
+        itself still runs BEFORE admission — the scheduler group and
+        timeout come from the compiled context, and a parse error must not
+        burn a concurrency slot — at the cost that compile CPU is spent
+        pre-admission on the transport thread, outside scheduler
+        accounting (admission caps only EXECUTION concurrency)."""
         req = parse_instance_request(request)
         try:
-            # compile BEFORE admission: the scheduler group and timeout come
-            # from the compiled context, and a parse error must not burn a
-            # concurrency slot
+            self.metrics.count("queries")
             q = optimize_query(compile_query(req["sql"]))
             # NOTE: the latency timer lives inside _handle_submit_inner —
             # wrapping the scheduler here would fold rejection queue-waits
@@ -217,7 +223,8 @@ class ServerInstance:
         from pinot_tpu.common.trace import span
 
         t_cpu = _time.thread_time_ns()
-        self.metrics.count("queries")
+        # "queries" was already counted at receive time (_handle_submit),
+        # before compile/admission
         timer = self.metrics.timed("query")
         timer.__enter__()
         tracer = trace.start_trace() if q.options_ci().get("trace") else None
@@ -272,6 +279,9 @@ class ServerInstance:
         early — selection without ORDER BY is any-subset semantics."""
         req = parse_instance_request(request)
         try:
+            # count at receive time, pre-compile — same invariant as the
+            # unary path: queryErrors <= queries even on parse errors
+            self.metrics.count("queries")
             q = optimize_query(compile_query(req["sql"]))
             yield from self.scheduler.run(
                 lambda: self._stream_blocks(req, q),
@@ -296,7 +306,6 @@ class ServerInstance:
             raise ValueError(
                 "streaming submit only serves selection-without-order queries"
             )
-        self.metrics.count("queries")
         tdm = self.engine.tables.get(q.table_name)
         wanted = set(req["segments"])
         acquired = [] if tdm is None else tdm.acquire()

@@ -44,6 +44,37 @@ from pinot_tpu.stream.spi import (
 
 _ENTRY_BITS = 20
 _BATCH_BITS = 8
+# broker-side managedLedgerMaxEntriesPerLedger, declared by the operator so
+# the packing bound is checked at CONSTRUCTION (fail fast, before any
+# checkpoint advances) instead of mid-consume
+_MAX_ENTRIES_PROP = "pulsar.max.entries.per.ledger"
+
+
+def _validate_entry_bound(config: StreamConfig) -> None:
+    """Packed offsets bound entry_id below 2^20 per ledger. Brokers default
+    to 50k entries/ledger (far under the bound), but an operator who raised
+    managedLedgerMaxEntriesPerLedger past 2^20 would only find out via a
+    mid-consume ValueError with the consumer making no ingest progress —
+    so the factory/consumer checks the DECLARED broker bound (the
+    ``pulsar.max.entries.per.ledger`` stream property) up front and rejects
+    the config with the same remediation message. pack_message_id keeps
+    its per-message guard as the backstop for undeclared configs."""
+    props = config.properties or {}
+    declared = props.get(_MAX_ENTRIES_PROP)
+    if declared is None:
+        return
+    try:
+        bound = int(declared)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{_MAX_ENTRIES_PROP}={declared!r} is not an integer — set it "
+            f"to the broker's managedLedgerMaxEntriesPerLedger value")
+    if bound > (1 << _ENTRY_BITS):
+        raise ValueError(
+            f"{_MAX_ENTRIES_PROP}={declared} exceeds the packed-offset "
+            f"entry_id bound 2^{_ENTRY_BITS} — lower the broker's "
+            f"managedLedgerMaxEntriesPerLedger below it or widen the "
+            f"packing (_ENTRY_BITS)")
 
 
 def _pulsar():
@@ -98,6 +129,7 @@ def _partition_topic(topic: str, partition: int, n_partitions: int) -> str:
 class PulsarPartitionConsumer(PartitionGroupConsumer):
     def __init__(self, config: StreamConfig, partition: int,
                  n_partitions: int):
+        _validate_entry_bound(config)
         self.config = config
         self._pulsar = _pulsar()
         self._client = _client(config)
@@ -165,6 +197,7 @@ class PulsarPartitionConsumer(PartitionGroupConsumer):
 class PulsarConsumerFactory(StreamConsumerFactory):
     def __init__(self, config: StreamConfig):
         super().__init__(config)
+        _validate_entry_bound(config)
         self._n_partitions: int | None = None
 
     def partition_count(self) -> int:

@@ -181,9 +181,10 @@ def test_matmul_groupby_parity(mm_engine, engines, sql):
 
 
 class TestSortedHighCardGroupBy:
-    """Sort-based high-cardinality device regime (MAP_BASED analog): the
-    cartesian dict-id product exceeds MAX_DENSE_GROUPS, so the combined
-    int64 keys are lax.sort-ed and aggregated into a capped table."""
+    """Radix-partitioned high-cardinality device regime (MAP_BASED
+    analog): the cartesian dict-id product exceeds MAX_DENSE_GROUPS, so
+    the packed keys ride ops/radix_groupby.py (chunk-local sorts +
+    run-end partials + compacted merge) into a capped table."""
 
     @pytest.fixture(scope="class")
     def hc(self, tmp_path_factory):
@@ -409,3 +410,146 @@ class TestSortedProjection:
         assert rows == again["resultTable"]["rows"]
         assert rows == cold["resultTable"]["rows"]
         assert rows == host["resultTable"]["rows"]
+
+
+class TestSortedRegimeBoundaries:
+    """Satellite for the radix tentpole: drive group counts across the
+    sorted_k = min(numGroupsLimit, MAX_SORTED_GROUPS) table-cap and the
+    host-overflow boundaries, asserting device == host on every side and
+    numGroupsLimitReached semantics on both paths. The fixture pins BOTH
+    column dictionaries at full cardinality (3000 x 1500 = 4.5M key space
+    > MAX_DENSE_GROUPS) with EXACTLY 5000 distinct pairs, so each engine
+    limit below/above 5000 picks the regime deterministically."""
+
+    U, I, D, N = 3000, 1500, 5000, 40_000
+
+    @pytest.fixture(scope="class")
+    def bc(self, tmp_path_factory):
+        rng = np.random.default_rng(31)
+        U, I, D, n = self.U, self.I, self.D, self.N
+        base = sorted({j * I + (j % I) for j in range(U)}  # covers every u
+                      | set(range(I)))                     # covers every i
+        pool = rng.choice(U * I, size=2 * D, replace=False)
+        bset = set(base)
+        extra = [int(p) for p in pool if p not in bset][:D - len(base)]
+        pids = np.array(base + extra)
+        assert len(pids) == D
+        draw = np.concatenate([pids, rng.choice(pids, n - D)])
+        rng.shuffle(draw)
+        cols = {
+            "u": (draw // I).astype(np.int32),
+            "i": (draw % I).astype(np.int32),
+            "v": rng.integers(-1000, 1000, n).astype(np.int64),
+            "f": np.round(rng.uniform(-5, 5, n), 6),
+        }
+        schema = Schema.build(
+            name="bc",
+            dimensions=[("u", DataType.INT), ("i", DataType.INT)],
+            metrics=[("v", DataType.LONG), ("f", DataType.DOUBLE)],
+        )
+        base_dir = tmp_path_factory.mktemp("bcseg")
+        segs = []
+        quarter = n // 4
+        for s in range(4):
+            part = {k: v[s * quarter:(s + 1) * quarter]
+                    for k, v in cols.items()}
+            build_segment(schema, part, str(base_dir / f"s{s}"),
+                          TableConfig(table_name="bc"), f"s{s}")
+            segs.append(ImmutableSegment(str(base_dir / f"s{s}")))
+        return segs
+
+    SQL = ("SELECT u, i, COUNT(*), SUM(v), AVG(v), MIN(v), MAX(v), "
+           "MINMAXRANGE(v), SUM(f) FROM bc GROUP BY u, i "
+           "ORDER BY SUM(v) DESC, u, i LIMIT 30")
+
+    def _engines(self, segs, limit):
+        dev = QueryEngine(num_groups_limit=limit)
+        host = QueryEngine(device_executor=None, num_groups_limit=limit)
+        for s in segs:
+            dev.add_segment("bc", s)
+            host.add_segment("bc", s)
+        return dev, host
+
+    def _assert_parity(self, dev, host, sql=None):
+        rd, rh = dev.execute(sql or self.SQL), host.execute(sql or self.SQL)
+        assert not rd.get("exceptions"), rd
+        assert not rh.get("exceptions"), rh
+        rows_d, rows_h = rd["resultTable"]["rows"], rh["resultTable"]["rows"]
+        assert len(rows_d) == len(rows_h)
+        for a, b in zip(rows_d, rows_h):
+            assert all(_close(x, y) for x, y in zip(a, b)), (a, b)
+        return rd, rh
+
+    def test_below_cap_device_radix_regime(self, bc):
+        """D < sorted_k: the radix regime answers on device, exactly."""
+        dev, host = self._engines(bc, limit=6000)
+        rd, rh = self._assert_parity(dev, host)
+        shapes = {t[0] for (t, _m) in dev.device._pipelines}
+        assert "groupby_sorted" in shapes
+        assert rd["numGroupsLimitReached"] is False
+        assert rh["numGroupsLimitReached"] is False
+
+    def test_above_cap_host_overflow_fallback(self, bc):
+        """D > sorted_k: the device table would truncate, so the executor
+        must detect overflow and defer to the host path (both engines
+        then flag the limit and answer identically)."""
+        dev, host = self._engines(bc, limit=4000)
+        rd, rh = self._assert_parity(dev, host)
+        assert rd["numGroupsLimitReached"] is True
+        assert rh["numGroupsLimitReached"] is True
+
+    def test_max_sorted_groups_ceiling(self, bc, monkeypatch):
+        """sorted_k is min(numGroupsLimit, MAX_SORTED_GROUPS): with the
+        hard ceiling lowered below D, even a generous numGroupsLimit must
+        route through the host fallback — and raising it back re-enables
+        the device regime."""
+        from pinot_tpu.engine import device as devmod
+
+        monkeypatch.setattr(devmod, "MAX_SORTED_GROUPS", 4500)
+        dev, host = self._engines(bc, limit=100_000)
+        self._assert_parity(dev, host)
+        monkeypatch.setattr(devmod, "MAX_SORTED_GROUPS", 1 << 17)
+        dev2, host2 = self._engines(bc, limit=100_000)
+        rd, _rh = self._assert_parity(dev2, host2)
+        shapes = {t[0] for (t, _m) in dev2.device._pipelines}
+        assert "groupby_sorted" in shapes
+        assert rd["numGroupsLimitReached"] is False
+
+    def test_set_num_groups_limit_flags_both_paths(self, bc):
+        """Per-query SET numGroupsLimit below D: results are plan-
+        dependent-partial by reference contract — BOTH paths must say so
+        (rows are not compared; the flag is the contract)."""
+        dev, host = self._engines(bc, limit=6000)
+        sql = ("SET numGroupsLimit = 1000; "
+               "SELECT u, i, COUNT(*) FROM bc GROUP BY u, i "
+               "ORDER BY COUNT(*) DESC LIMIT 5")
+        for eng in (dev, host):
+            r = eng.execute(sql)
+            assert not r.get("exceptions"), r
+            assert r["numGroupsLimitReached"] is True, r
+
+    def test_chunked_plan_parity(self, bc, monkeypatch):
+        """Force the multi-chunk radix plan at engine scale (CHUNK_ROWS
+        shrunk + compaction ratio tightened so the 40k-row batch splits
+        into level-1 chunks + a merge level) — results must not depend on
+        the chunk plan."""
+        from pinot_tpu.ops import radix_groupby as radix
+
+        orig_plan = radix.plan_chunks
+        monkeypatch.setattr(radix, "CHUNK_ROWS", 256)
+        monkeypatch.setattr(
+            radix, "plan_chunks",
+            lambda n, k, chunk_rows=None, min_ratio=None:
+            orig_plan(n, k, chunk_rows, radix.HLL_COMPACT_RATIO))
+        C, _L = radix.plan_chunks(self.N, 6000)
+        assert C > 1, "plan must actually chunk at this scale"
+        dev, host = self._engines(bc, limit=6000)
+        self._assert_parity(dev, host)
+
+    def test_unsupported_agg_family_falls_back(self, bc):
+        """DISTINCTCOUNTHLL is not in SORTED_AGGS: the sorted regime must
+        defer to the host rather than mis-aggregate."""
+        dev, host = self._engines(bc, limit=6000)
+        sql = ("SELECT u, i, DISTINCTCOUNTHLL(v) FROM bc GROUP BY u, i "
+               "ORDER BY u, i LIMIT 10")
+        self._assert_parity(dev, host, sql)

@@ -79,3 +79,82 @@ def test_sharded_uses_device(mesh_engines):
     sharded, _ = mesh_engines
     sharded.execute("SELECT k1, SUM(v) FROM m GROUP BY k1")
     assert len(sharded.device._pipelines) > 0
+
+
+class TestSortedRegimeMesh:
+    """High-cardinality (radix) regime ON the mesh: per-shard group tables
+    are KEYED, so parallel/mesh.py merges them by key (merge_tables) —
+    the shape that used to route every multi-chip high-card query to the
+    host. Sharded == single-device == host, exactly."""
+
+    @pytest.fixture(scope="class")
+    def hc_engines(self, tmp_path_factory):
+        rng = np.random.default_rng(37)
+        n, U, I = 12_000, 2300, 2000  # 4.6M key space > MAX_DENSE_GROUPS
+        # pin both dictionaries at full cardinality, then draw ~3k extra
+        # distinct pairs; groups deliberately SPAN segments so the merge
+        # must combine cross-shard partials
+        u = rng.integers(0, U, n).astype(np.int32)
+        i = rng.integers(0, I, n).astype(np.int32)
+        u[:U] = np.arange(U, dtype=np.int32)
+        i[:I] = np.arange(I, dtype=np.int32)
+        cols = {
+            "u": u, "i": i,
+            "v": rng.integers(-500, 500, n).astype(np.int64),
+        }
+        schema = Schema.build(
+            name="hcm",
+            dimensions=[("u", DataType.INT), ("i", DataType.INT)],
+            metrics=[("v", DataType.LONG)],
+        )
+        base = tmp_path_factory.mktemp("hcmesh")
+        sharded = QueryEngine(device_executor=DeviceExecutor(mesh=make_mesh(8)))
+        single = QueryEngine()
+        host = QueryEngine(device_executor=None)
+        bounds = [0, 1500, 2600, 4800, 6400, 9000, n]  # mesh-unaligned
+        for s in range(6):
+            part = {k: v[bounds[s]:bounds[s + 1]] for k, v in cols.items()}
+            build_segment(schema, part, str(base / f"s{s}"),
+                          TableConfig(table_name="hcm"), f"s{s}")
+            seg = ImmutableSegment(str(base / f"s{s}"))
+            for eng in (sharded, single, host):
+                eng.add_segment("hcm", seg)
+        return sharded, single, host
+
+    @pytest.mark.parametrize("sql", [
+        "SELECT u, i, COUNT(*), SUM(v) FROM hcm GROUP BY u, i "
+        "ORDER BY COUNT(*) DESC, u, i LIMIT 30",
+        "SELECT u, i, MIN(v), MAX(v), AVG(v) FROM hcm WHERE v > -200 "
+        "GROUP BY u, i ORDER BY MIN(v), u, i LIMIT 40",
+    ])
+    def test_mesh_equals_single_equals_host(self, hc_engines, sql):
+        sharded, single, host = hc_engines
+        rs, r1, rh = (e.execute(sql) for e in (sharded, single, host))
+        for r in (rs, r1, rh):
+            assert not r.get("exceptions"), r
+        assert rs["resultTable"]["rows"] == r1["resultTable"]["rows"]
+        assert rs["resultTable"]["rows"] == rh["resultTable"]["rows"]
+
+    def test_mesh_sorted_template_on_device(self, hc_engines):
+        sharded, _, _ = hc_engines
+        sharded.execute("SELECT u, i, SUM(v) FROM hcm GROUP BY u, i")
+        shapes = {t[0] for (t, _m) in sharded.device._pipelines}
+        assert "groupby_sorted" in shapes
+
+    def test_mesh_overflow_still_falls_back(self, hc_engines):
+        """Distinct > sorted_k under the mesh: merged n_groups_total must
+        trip the SAME host fallback as single-device."""
+        sharded, _, host = hc_engines
+        small = QueryEngine(
+            device_executor=DeviceExecutor(mesh=make_mesh(8),
+                                           num_groups_limit=1000),
+            num_groups_limit=1000)
+        host_small = QueryEngine(device_executor=None, num_groups_limit=1000)
+        for seg in sharded.tables["hcm"].segments.values():
+            small.add_segment("hcm", seg)
+            host_small.add_segment("hcm", seg)
+        sql = ("SELECT u, i, SUM(v) FROM hcm GROUP BY u, i "
+               "ORDER BY u, i LIMIT 20")
+        rs, rh = small.execute(sql), host_small.execute(sql)
+        assert not rs.get("exceptions"), rs
+        assert rs["resultTable"]["rows"] == rh["resultTable"]["rows"]

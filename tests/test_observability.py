@@ -203,3 +203,25 @@ class TestClusterObservability:
                                     timeout=5) as resp:
             text = resp.read().decode()
         assert "pinot_tpu_broker_queries_total" in text
+
+    def test_parse_error_counts_query_before_error(self, tmp_path):
+        """The server counts ``queries`` at RECEIVE time (pre-compile), so
+        a stream of parse errors can never push queryErrors above queries
+        on the dashboard (the old inner-count, incremented only after a
+        successful compile + admission, made the invariant violable)."""
+        from pinot_tpu.common.metrics import get_metrics
+        from pinot_tpu.transport.grpc_transport import make_instance_request
+
+        registry = ClusterRegistry()
+        server = ServerInstance("server_m", registry, str(tmp_path / "sm"),
+                                device_executor=None)
+        m = get_metrics("server")
+        snap0 = m.snapshot()["counters"]
+        q0 = snap0.get("server.queries", 0)
+        e0 = snap0.get("server.queryErrors", 0)
+        bad = make_instance_request("SELEKT garbage FRM nowhere", [], 1, "b0")
+        resp = server._handle_submit(bad)
+        assert b"query_error" in resp
+        snap = m.snapshot()["counters"]
+        assert snap.get("server.queryErrors", 0) == e0 + 1
+        assert snap.get("server.queries", 0) == q0 + 1

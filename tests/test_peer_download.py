@@ -164,3 +164,57 @@ def test_realtime_adopt_falls_back_to_peer(tmp_path, monkeypatch):
             except Exception:
                 pass
         TopicRegistry.delete("pd_clicks")
+
+
+def test_extraction_tmpdir_removed_when_replace_fails(tmp_path, monkeypatch):
+    """os.replace failing AFTER extractall used to leak the
+    ``{dest_dir}.peer<pid>`` extraction dir; the per-replica try/finally
+    must remove it on every exit path."""
+    import io
+    import tarfile
+    import types
+
+    from pinot_tpu.server import peer as peer_mod
+
+    # a minimal tar payload holding <segment>/file
+    seg_src = tmp_path / "src" / "seg1"
+    seg_src.mkdir(parents=True)
+    (seg_src / "cols.bin").write_bytes(b"payload")
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(str(seg_src), arcname="seg1")
+    tar_bytes = buf.getvalue()
+
+    class FakeChannel:
+        def __init__(self, addr, tls=None):
+            pass
+
+        def fetch_segment(self, req, timeout_s=None):
+            yield tar_bytes
+
+        def close(self):
+            pass
+
+    import pinot_tpu.transport.grpc_transport as gt
+
+    monkeypatch.setattr(gt, "QueryRouterChannel", FakeChannel)
+
+    real_replace = os.replace
+
+    def broken_replace(src, dst):
+        raise OSError("cross-device link (simulated)")
+
+    monkeypatch.setattr(os, "replace", broken_replace)
+
+    info = types.SimpleNamespace(instance_id="peer1", host="127.0.0.1",
+                                 grpc_port=1234)
+    registry = types.SimpleNamespace(
+        external_view=lambda table: {"seg1": ["peer1", "me"]},
+        instances=lambda: [info])
+
+    dest = str(tmp_path / "tables" / "ev" / "seg1")
+    with pytest.raises(RuntimeError, match="peer download"):
+        peer_mod.peer_download(registry, "ev_OFFLINE", "seg1", dest, "me")
+    leak = f"{dest}.peer{os.getpid()}"
+    assert not os.path.isdir(leak), "extraction tmp dir leaked"
+    monkeypatch.setattr(os, "replace", real_replace)

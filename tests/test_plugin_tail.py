@@ -273,6 +273,40 @@ def test_pulsar_plugin(monkeypatch):
     assert len(more) == 1 and json.loads(more.messages[0].payload)["i"] == 99
 
 
+def test_pulsar_entry_bound_validated_at_construction(monkeypatch):
+    """An operator who raised managedLedgerMaxEntriesPerLedger past the
+    packed-offset entry_id bound must be rejected when the factory /
+    consumer is BUILT (declared via the pulsar.max.entries.per.ledger
+    property), not via a mid-consume ValueError after ingest started."""
+    fake = types.ModuleType("pulsar")
+    fake.Client = FakeClient
+    fake.MessageId = FakeMessageId
+    monkeypatch.setitem(sys.modules, "pulsar", fake)
+
+    from pinot_tpu.stream.pulsar_stream import (
+        PulsarConsumerFactory,
+        _ENTRY_BITS,
+    )
+
+    over = StreamConfig(
+        stream_type="pulsar", topic="t", decoder="json",
+        properties={"pulsar.max.entries.per.ledger": str(1 << 21)})
+    with pytest.raises(ValueError, match="entry_id bound"):
+        PulsarConsumerFactory(over)
+
+    # at or under the bound (the broker default is 50k): accepted, and
+    # consumer construction passes the same gate
+    under = StreamConfig(
+        stream_type="pulsar", topic="t", decoder="json",
+        properties={"pulsar.max.entries.per.ledger": str(1 << _ENTRY_BITS)})
+    factory = PulsarConsumerFactory(under)
+    assert factory.create_partition_consumer(0) is not None
+
+    # undeclared config: the per-message pack guard stays the backstop
+    undeclared = StreamConfig(stream_type="pulsar", topic="t", decoder="json")
+    PulsarConsumerFactory(undeclared).create_partition_consumer(0)
+
+
 def test_pulsar_gating_error():
     import builtins
 

@@ -71,6 +71,13 @@ def setup(tmp_path_factory):
         engine.add_segment("baseballStats", seg)
 
     con = sqlite3.connect(":memory:")
+    try:
+        con.execute("SELECT MOD(1, 1)")
+    except sqlite3.OperationalError:
+        # sqlite < 3.35 has no built-in math functions; the oracle only
+        # needs MOD
+        con.create_function("MOD", 2, lambda a, b: None if b in (0, None)
+                            or a is None else a % b)
     con.execute(
         "CREATE TABLE baseballStats (playerName TEXT, teamID TEXT, "
         "league TEXT, yearID INT, runs INT, hits INT, homeRuns INT, salary REAL)"

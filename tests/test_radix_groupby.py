@@ -1,0 +1,223 @@
+"""Radix-partitioned group-by primitive tests (ops/radix_groupby.py).
+
+The chunked-sort basis must be EXACTLY equivalent to a numpy group-by
+oracle for every partial it emits — the device regime (engine/device.py
+groupby_sorted) and the mesh combine (parallel/mesh.py) both build on
+these invariants:
+
+- pack_keys narrows to int32 exactly when the cartesian key space fits;
+- chunked_group_aggregate's table matches the oracle for COUNT / int SUM /
+  float SUM / MIN / MAX through single-chunk, multi-chunk and multi-LEVEL
+  merge plans (chunk_rows forces the plans the 100M-row shapes take);
+- overflow (distinct > K) is always DETECTED (n_groups_total > K), never
+  silently truncated;
+- merge_tables re-merges per-shard tables by key with neutral empty fills;
+- hll_chunked_sorted_keys preserves the per-slot max-rho structure of the
+  monolithic sort it replaces;
+- bucket_histogram matches np.bincount over the radix partition.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops import radix_groupby as radix
+
+
+def _oracle(keys, vals=None):
+    """numpy group-by: {key: (count, sum, min, max)} over real keys."""
+    out = {}
+    for i, k in enumerate(keys):
+        v = None if vals is None else vals[i]
+        c, s, lo, hi = out.get(k, (0, 0, None, None))
+        if v is None:
+            out[k] = (c + 1, 0, None, None)
+        else:
+            out[k] = (c + 1, s + v,
+                      v if lo is None else min(lo, v),
+                      v if hi is None else max(hi, v))
+    return out
+
+
+class TestPackKeys:
+    def test_int32_when_space_fits(self):
+        g = [jnp.array([0, 3, 1]), jnp.array([2, 0, 1])]
+        key = radix.pack_keys(g, (4, 3), jnp.array([True, True, True]))
+        assert key.dtype == jnp.int32
+        assert key.tolist() == [0 * 3 + 2, 3 * 3 + 0, 1 * 3 + 1]
+
+    def test_int64_fallback_for_wide_spaces(self):
+        g = [jnp.array([1]), jnp.array([1])]
+        cards = (1 << 16, 1 << 16)  # product 2^32 >= 2^31
+        key = radix.pack_keys(g, cards, jnp.array([True]))
+        assert key.dtype == jnp.int64
+        assert key.tolist() == [(1 << 16) + 1]
+
+    def test_masked_rows_get_sentinel(self):
+        g = [jnp.array([0, 1])]
+        key = radix.pack_keys(g, (8,), jnp.array([True, False]))
+        assert key.tolist() == [0, radix.INT32_SENTINEL]
+
+
+class TestPlanChunks:
+    def test_small_n_degenerates_to_single_chunk(self):
+        assert radix.plan_chunks(10_000, 1000) == (1, 10_000)
+
+    def test_chunking_engages_when_compaction_pays(self):
+        C, L = radix.plan_chunks(64 << 20, 1000, chunk_rows=1 << 20)
+        assert C == 64 and L == 1 << 20
+
+    def test_wide_k_grows_chunks_then_gives_up(self):
+        # K so large no compaction ratio is reachable: monolithic plan
+        C, L = radix.plan_chunks(4 << 20, 16 << 20, chunk_rows=1 << 20)
+        assert C == 1
+
+
+def _run_agg(keys, payloads, sums, mins, maxs, K, chunk_rows=None):
+    fn = jax.jit(lambda k, p: radix.chunked_group_aggregate(
+        k, {n: (p[n], kind) for n, (_, kind) in payloads.items()},
+        sums, mins, maxs, K, chunk_rows=chunk_rows))
+    return fn(keys, {n: v for n, (v, _) in payloads.items()})
+
+
+class TestChunkedGroupAggregate:
+    # chunk_rows=None: single monolithic chunk. 256: multi-chunk, one
+    # merge level. 64: forces MULTI-LEVEL merges at n=2000 (levels of
+    # compacted partials re-enter the chunked structure).
+    @pytest.mark.parametrize("chunk_rows", [None, 256, 64])
+    def test_matches_oracle_all_families(self, chunk_rows):
+        rng = np.random.default_rng(7)
+        n, nkeys, K = 2000, 40, 50
+        keys = rng.integers(0, nkeys, n).astype(np.int32)
+        ivals = rng.integers(-500, 500, n).astype(np.int64)
+        fvals = rng.uniform(-10, 10, n)
+        mask = rng.random(n) < 0.9
+        kj = jnp.where(jnp.asarray(mask), jnp.asarray(keys),
+                       radix.INT32_SENTINEL)
+        tbl = _run_agg(
+            kj,
+            {"pi": (jnp.asarray(ivals), "int"),
+             "pf": (jnp.asarray(fvals), "float")},
+            {"pi", "pf"}, {"pi"}, {"pf"}, K, chunk_rows)
+        want = _orc = {}
+        for k, iv, fv, m in zip(keys, ivals, fvals, mask):
+            if not m:
+                continue
+            c, si, sf, lo, hi = want.get(k, (0, 0, 0.0, None, None))
+            want[k] = (c + 1, si + iv, sf + fv,
+                       iv if lo is None else min(lo, iv),
+                       fv if hi is None else max(hi, fv))
+        total = int(tbl["n_groups_total"])
+        assert total == len(want)
+        got = {}
+        sk = np.asarray(tbl["skeys"])
+        for j in range(len(sk)):
+            if bool(tbl["empty"][j]):
+                continue
+            got[int(sk[j])] = (
+                int(tbl["gcount"][j]), int(tbl["sum::pi"][j]),
+                float(tbl["sum::pf"][j]), int(tbl["min::pi"][j]),
+                float(tbl["max::pf"][j]))
+        assert set(got) == set(want)
+        for k, (c, si, sf, lo, hi) in want.items():
+            gc, gsi, gsf, glo, ghi = got[k]
+            assert (gc, gsi, glo) == (c, si, lo), k
+            assert gsf == pytest.approx(sf, rel=1e-12)
+            assert ghi == hi, k
+
+    @pytest.mark.parametrize("chunk_rows", [None, 256])
+    def test_overflow_detected_never_truncated_silently(self, chunk_rows):
+        rng = np.random.default_rng(8)
+        n, K = 3000, 100
+        keys = jnp.asarray(rng.permutation(n).astype(np.int32))  # all unique
+        tbl = _run_agg(keys, {}, set(), set(), set(), K, chunk_rows)
+        # distinct(3000) > K(100): the executor's host-fallback contract
+        # is n_groups_total > K, regardless of which level detected it
+        assert int(tbl["n_groups_total"]) > K
+
+    def test_exact_int_sums_under_wrapping_cumsum(self):
+        # the int path takes cumsum differences; huge values exercise the
+        # two's-complement wrap argument
+        big = (1 << 62) - 7
+        keys = jnp.array([0, 1, 0, 1], dtype=jnp.int32)
+        vals = jnp.array([big, -big, big, -big], dtype=jnp.int64)
+        tbl = _run_agg(keys, {"p": (vals, "int")}, {"p"}, set(), set(), 8)
+        s = np.asarray(tbl["sum::p"])
+        sk = np.asarray(tbl["skeys"])
+        got = {int(k): int(v) for k, v in zip(sk[:2], s[:2])}
+        # 2*big wraps int64 transiently; the group sums recover exactly
+        # under two's-complement arithmetic (matches the host path's
+        # int64 accumulation)
+        assert got[0] == np.int64(big * 2)
+        assert got[1] == np.int64(-big * 2)
+
+
+class TestMergeTables:
+    def test_cross_shard_key_aligned_merge(self):
+        SEN = radix.INT64_SENTINEL
+        sk = jnp.array([[2, 5, 9, SEN], [5, 9, 30, SEN]], dtype=jnp.int64)
+        cnt = jnp.array([[2, 1, 3, 0], [4, 1, 1, 0]], dtype=jnp.int64)
+        mn = jnp.array([[1, 7, 2, 2**62], [3, 1, 8, 2**62]], dtype=jnp.int64)
+        cols, fk, empty, dist = radix.merge_tables(
+            sk, {"gcount": cnt, "m": mn},
+            {"gcount": "sum", "m": "min"}, 8)
+        assert int(dist) == 4
+        got = {int(k): (int(c), int(m)) for k, c, m, e in zip(
+            fk, cols["gcount"], cols["m"], empty) if not bool(e)}
+        assert got == {2: (2, 1), 5: (5, 3), 9: (4, 1), 30: (1, 8)}
+
+    def test_empty_slots_carry_neutral_fills(self):
+        """Non-run-end entries land in the sentinel region of the final
+        sort carrying PARTIAL scan values — they must come out re-filled
+        with neutrals or the executor would see phantom groups (the mesh
+        combine reads gcount > 0)."""
+        SEN = radix.INT64_SENTINEL
+        sk = jnp.array([[7, SEN], [7, SEN]], dtype=jnp.int64)
+        cnt = jnp.array([[3, 0], [2, 0]], dtype=jnp.int64)
+        cols, fk, empty, dist = radix.merge_tables(
+            sk, {"gcount": cnt}, {"gcount": "sum"}, 4)
+        assert int(dist) == 1
+        assert np.asarray(cols["gcount"])[np.asarray(empty)].max(
+            initial=0) == 0
+
+
+class TestHllChunkedSortedKeys:
+    @pytest.mark.parametrize("chunk_rows", [None, 128])
+    def test_slot_max_structure_preserved(self, chunk_rows):
+        rng = np.random.default_rng(9)
+        n, n_slots = 5000, 300
+        slot = rng.integers(0, n_slots, n).astype(np.int32)
+        rho = rng.integers(1, 23, n).astype(np.int32)
+        packed = jnp.asarray((slot << 5) | rho)
+        out = np.asarray(jax.jit(
+            lambda p: radix.hll_chunked_sorted_keys(
+                p, n_slots, chunk_rows=chunk_rows))(packed))
+        # drop pad sentinels, read per-slot max rho at slot-run ends
+        out = out[out != radix.INT32_SENTINEL]
+        assert np.all(np.diff(out) >= 0)  # globally sorted (drop-in operand)
+        got = {}
+        for v in out.tolist():
+            got[v >> 5] = v & 31  # ascending: last write per slot = max
+        want = {}
+        for s, r in zip(slot.tolist(), rho.tolist()):
+            want[s] = max(want.get(s, 0), r)
+        assert got == want
+
+
+class TestBucketHistogram:
+    def test_matches_bincount(self):
+        rng = np.random.default_rng(10)
+        n, keyspace, n_buckets = 4096, 5000, 16
+        keys = rng.integers(0, keyspace, n).astype(np.int32)
+        mask = rng.random(n) < 0.8
+        kj = jnp.where(jnp.asarray(mask), jnp.asarray(keys),
+                       radix.INT32_SENTINEL)
+        counts = np.asarray(radix.bucket_histogram(
+            kj, keyspace, n_buckets, interpret=True))
+        shift = 0
+        while (keyspace - 1) >> shift >= n_buckets:
+            shift += 1
+        want = np.bincount(keys[mask] >> shift, minlength=n_buckets)
+        assert counts.tolist() == want.tolist()

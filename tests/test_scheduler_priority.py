@@ -191,15 +191,19 @@ class TestServerIntegration:
             cfg = TableConfig(table_name="t")
             controller.add_table(cfg, schema)
             d = str(tmp_path / "seg")
+            # enough rows that the query's CPU burst reliably crosses the
+            # container clock's thread_time granularity (a 1000-row query
+            # can finish inside one tick and report a flaky 0)
+            n = 200_000
             build_segment(schema, {
-                "k": np.array(["a", "b"] * 500),
-                "v": np.arange(1000, dtype=np.int32)}, d, cfg, "t_0")
+                "k": np.array(["a", "b"] * (n // 2)),
+                "v": np.arange(n, dtype=np.int32)}, d, cfg, "t_0")
             controller.upload_segment("t", d)
             deadline = time.time() + 10
             r = None
             while time.time() < deadline:
                 r = broker.execute("SELECT k, SUM(v) FROM t GROUP BY k")
-                if not r.get("exceptions"):
+                if not r.get("exceptions") and r["threadCpuTimeNs"] > 0:
                     break
                 time.sleep(0.1)
             assert not r.get("exceptions"), r
