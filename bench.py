@@ -552,6 +552,84 @@ def bench_micro():
     return out
 
 
+def bench_concurrency(engine, sql, levels=(1, 4, 8), iters_per_thread=4):
+    """Link-amortization sweep (the tentpole metric of the async
+    launch/fetch split): N threads submit the same query concurrently
+    through ONE engine. Per level: aggregate qps + per-query p50, and
+    ``overlap_efficiency`` = N·qps₁/qps_N (1.0 = the N round trips fully
+    overlap; N = they serialize — each query pays its own RTT as the old
+    blocking device_get did). ``coalesced_cohort_p50_ms``: 8
+    identical-template queries released together (the dashboard fan-out
+    case) — the coalescer stacks them into ONE vmapped launch fetched as
+    ONE packed buffer, so the target is p50 ≤ 1.5× the solo p50."""
+    import threading
+
+    def run_level(n, iters):
+        barrier = threading.Barrier(n + 1)
+        lats = [[] for _ in range(n)]
+        errs = []
+
+        def worker(i):
+            try:
+                barrier.wait()
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    r = engine.execute(sql)
+                    lats[i].append(time.perf_counter() - t0)
+                    if r.get("exceptions"):
+                        errs.append(str(r["exceptions"])[:200])
+                        return
+            except Exception as e:  # noqa: BLE001 — surfaced after join
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise RuntimeError(f"concurrency sweep failed: {errs[0]}")
+        return wall, [x for lat in lats for x in lat]
+
+    dev = engine.device
+    if dev is not None:
+        # profile capture pins launches and disables coalescing — the
+        # sweep must measure the production execute path
+        dev.profile_enabled = False
+    run_level(1, 2)  # warm (compile + batch caches)
+    out = {}
+    qps1 = None
+    for n in levels:
+        # warm pass at this concurrency: cohort pipelines jit-specialize
+        # per pow2-padded cohort size, and steady-state amortization (not
+        # first-compile) is the metric
+        run_level(n, 1)
+        wall, lat = run_level(n, iters_per_thread)
+        qps = len(lat) / wall
+        entry = {
+            "qps": round(qps, 2),
+            "p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 2),
+        }
+        if n == 1:
+            qps1 = qps
+        elif qps1 is not None:  # relative fields need a level-1 reference
+            entry["speedup_vs_n1"] = round(qps / qps1, 2)
+            entry["overlap_efficiency"] = round(n * qps1 / qps, 2)
+        out[f"n{n}"] = entry
+    co = getattr(dev, "coalescer", None) if dev is not None else None
+    c0 = (co.cohorts_launched, co.queries_coalesced) if co else (0, 0)
+    _, lat = run_level(8, 1)
+    out["coalesced_cohort_p50_ms"] = round(
+        float(np.percentile(lat, 50)) * 1e3, 2)
+    if co is not None:
+        out["cohorts_launched"] = co.cohorts_launched - c0[0]
+        out["queries_coalesced"] = co.queries_coalesced - c0[1]
+    return out
+
+
 def bench_realtime():
     """Realtime path numbers (BenchmarkRealtimeConsumptionSpeed analog):
     row-at-a-time ingest rate into a consuming (mutable) segment, seal
@@ -728,6 +806,9 @@ def main():
 
     ssb_detail = bench_suite(eng, SSB_QUERIES)
     taxi_detail = bench_suite(eng, TAXI_QUERIES)
+    # the link-amortization sweep rides the motivating q2 shape (BENCH_r05:
+    # 81.8ms of its 114.9ms p50 was host<->device round trip)
+    concurrency_detail = bench_concurrency(eng, SSB_QUERIES["q2_range_sum"])
     realtime_detail = bench_realtime()
     micro_detail = bench_micro()
 
@@ -775,6 +856,7 @@ def main():
                 "detail": {
                     "ssb100m": ssb_detail,
                     "taxi12m": taxi_detail,
+                    "concurrency": concurrency_detail,
                     "realtime": realtime_detail,
                     "micro": micro_detail,
                     "cube_accelerated": {
@@ -797,13 +879,18 @@ def main():
                             "launch device time; host_ms = wall minus the "
                             "blocking device-wait (measured); link_ms = "
                             "median per-iteration get-wait minus kernel, "
-                            "clamped at 0 (tunnel round trip; floor is "
-                            "the MINIMUM, typical RTT runs above it). "
+                            "clamped at 0 — the get-wait is now measured "
+                            "on the FETCH phase of the async launch/fetch "
+                            "split (tunnel round trip; floor is the "
+                            "MINIMUM, typical RTT runs above it). "
                             "kernel_gbps/hbm_peak_pct rate the kernel "
                             "against the chip's memory system. The "
                             "breakdown covers the query's FINAL device "
                             "launch — every suite query executes as one "
-                            "batched launch."
+                            "batched launch solo; under concurrency, "
+                            "same-template queries coalesce into one "
+                            "vmapped launch per cohort (detail."
+                            "concurrency)."
                         ),
                     },
                     "q4_cube_equals_scan": True,

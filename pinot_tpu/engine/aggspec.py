@@ -605,9 +605,14 @@ class FirstLastWithTimeSpec(AggSpec):
     required here so host scatter, device scatter, and the mesh's
     pmin/pmax-pair combine (parallel/mesh.py) all agree bit-for-bit.
 
-    State: per-group (val, time); numeric values ride float64 arrays,
-    STRING dataType rides an object array (host path only — the device
-    path is numeric)."""
+    State: per-group (val, time); float values ride float64 arrays,
+    INTEGER value columns ride an object array of exact Python ints on
+    the host path (ADVICE r5: the old astype(float64) rounded LONG values
+    with |v| > 2^53 — the winning TIME was always exact, the VALUE was
+    not), STRING dataType rides an object array. The device path's value
+    plane remains float64 (PARITY.md documents that divergence); a device
+    partial merging into a host accumulator keeps whatever exactness each
+    side produced."""
 
     _T_MAX = np.iinfo(np.int64).max
     _T_MIN = np.iinfo(np.int64).min
@@ -648,11 +653,13 @@ class FirstLastWithTimeSpec(AggSpec):
 
     def host_groups(self, arg_values, group_idx, n):
         v = np.asarray(arg_values[0])
-        numeric = v.dtype.kind in "biuf"
-        if numeric:
+        if v.dtype.kind == "f":
             v = v.astype(np.float64)
             val = np.full(n, np.nan)
         else:
+            # exact value plane: integer columns become Python ints
+            # (arbitrary precision — LONG |v| > 2^53 survives exactly),
+            # strings stay objects; empty slots are None either way
             val = np.empty(n, dtype=object)
             val[:] = None
         t = np.asarray(arg_values[1], dtype=np.int64)
@@ -700,8 +707,23 @@ class FirstLastWithTimeSpec(AggSpec):
         # runtime-dtype-based, reduce._np_type_name): an integral
         # declaration renders LONG/INT unless empty groups force NaN
         # (NULL) into the column
-        if self.data_type in ("INT", "LONG", "BOOLEAN", "TIMESTAMP") \
-                and out.dtype.kind == "f" and len(out) \
+        integral = self.data_type in ("INT", "LONG", "BOOLEAN", "TIMESTAMP")
+        if out.dtype == object and len(out):
+            # exact int plane (host_groups) — possibly mixed with float64
+            # values merged in from a device partial
+            vals = out.tolist()
+            if all(v is None or isinstance(v, (int, float, np.integer,
+                                               np.floating)) for v in vals):
+                has_null = any(
+                    v is None or (isinstance(v, float) and np.isnan(v))
+                    for v in vals)
+                if integral and not has_null:
+                    # the exact path: LONG |v| > 2^53 renders bit-exact
+                    return np.array([int(v) for v in vals], dtype=np.int64)
+                return np.array(
+                    [np.nan if v is None else float(v) for v in vals],
+                    dtype=np.float64)
+        if integral and out.dtype.kind == "f" and len(out) \
                 and not np.isnan(out).any():
             return out.astype(np.int64)
         return out

@@ -87,11 +87,31 @@ def _flatten_obj(name: str, arr: np.ndarray, arrays: dict, meta: dict) -> None:
         arrays[f"{name}__counts"] = np.asarray(counts, dtype=np.int64)
         arrays[f"{name}__offsets"] = offsets
         meta[name] = {"obj": "dict"}
-    elif isinstance(first, (int, _Decimal())):
-        # exact scalars (SUMPRECISION): arbitrary-precision ints/Decimals
-        # ride as decimal strings
-        arrays[f"{name}__values"] = np.asarray([str(x) for x in arr],
-                                               dtype=np.str_)
+    elif isinstance(first, (int, float, _Decimal(),
+                            np.integer, np.floating)):
+        # exact scalars (SUMPRECISION; FIRSTWITHTIME/LASTWITHTIME's exact
+        # int64 value plane): arbitrary-precision ints/Decimals ride as
+        # decimal strings. A per-element type flag (0=None, 1=int,
+        # 2=float, 3=Decimal) keeps empty slots and MIXED planes exact —
+        # a host exact-int accumulator that merged a device float64
+        # partial (FirstLast over host + device segments) carries both
+        # ints and floats in one object array.
+        flags = np.zeros(len(arr), dtype=np.int8)
+        strs = []
+        for i, x in enumerate(arr):
+            if x is None:
+                strs.append("0")
+            elif isinstance(x, (float, np.floating)):
+                flags[i] = 2
+                strs.append(repr(float(x)))
+            elif isinstance(x, (int, np.integer)):
+                flags[i] = 1
+                strs.append(str(int(x)))
+            else:
+                flags[i] = 3
+                strs.append(str(x))
+        arrays[f"{name}__values"] = np.asarray(strs, dtype=np.str_)
+        arrays[f"{name}__flags"] = flags
         meta[name] = {"obj": "exact_scalar"}
     elif isinstance(first, str):
         # scalar strings with empty slots (FIRSTWITHTIME/LASTWITHTIME over
@@ -145,10 +165,21 @@ def _unflatten_obj(name: str, spec: dict, arrays: dict) -> np.ndarray:
         import decimal
 
         vals = arrays[f"{name}__values"]
+        flags = arrays.get(f"{name}__flags")
         out = np.empty(len(vals), dtype=object)
         for i, s in enumerate(vals.tolist()):
-            out[i] = int(s) if "." not in s and "E" not in s.upper() \
-                else decimal.Decimal(s)
+            if flags is None:
+                # legacy payload (no type flags): SUMPRECISION semantics
+                out[i] = int(s) if "." not in s and "E" not in s.upper() \
+                    else decimal.Decimal(s)
+            elif flags[i] == 0:
+                out[i] = None
+            elif flags[i] == 1:
+                out[i] = int(s)
+            elif flags[i] == 2:
+                out[i] = float(s)
+            else:
+                out[i] = decimal.Decimal(s)
         return out
     if spec["obj"] == "scalar_str":
         vals = arrays[f"{name}__values"]

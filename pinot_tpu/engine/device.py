@@ -20,6 +20,7 @@ merge: groups are already aligned across segments when the scatter lands.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -27,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from pinot_tpu.engine import aggspec
+from pinot_tpu.engine.inflight import InflightLaunch, LaunchCoalescer
 from pinot_tpu.engine.params import (
     BatchContext,
     DeviceUnsupported,
@@ -689,7 +691,7 @@ def build_pipeline(template, mm_mode: str = "auto",
 class DeviceExecutor:
     MAX_CACHED_BATCHES = 4  # LRU cap: a batch holds full columns in HBM
     # byte-aware cap: column blocks are materialized lazily, so the byte
-    # check runs after each execution too (engine/device.py _execute)
+    # check runs again as each in-flight launch drains (_release_launch)
     MAX_CACHED_BYTES = int(os.environ.get("PINOT_TPU_BATCH_CACHE_BYTES", 6 << 30))
 
     def __init__(self, mesh=None, mm_mode: str = "auto",
@@ -703,7 +705,14 @@ class DeviceExecutor:
         self.mm_mode = mm_mode
         self.num_groups_limit = max(1, num_groups_limit)
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
-        self._pipelines: dict = {}   # (template, mm_mode) -> jitted/sharded fn
+        self._pipelines: dict = {}   # (template, mm_mode) -> entry dict
+        # thread safety: server query threads launch/fetch concurrently —
+        # one lock guards the caches, refcounts, and observability fields
+        # (BatchContext guards its own lazy column materialization)
+        self._lock = threading.RLock()
+        self._inflight_launches: dict = {}  # batch key -> in-flight count
+        self.inflight = 0            # launches between dispatch and fetch
+        self.coalescer = LaunchCoalescer()
         # cumulative host-link observability (bench reads deltas per query)
         self.fetch_bytes_total = 0
         self.fetch_leaves_total = 0
@@ -761,38 +770,91 @@ class DeviceExecutor:
     def _batch_key(segments):
         return tuple(s.dir for s in segments)
 
-    def batch_for(self, segments) -> BatchContext:
+    def batch_for(self, segments, retain: bool = False) -> BatchContext:
+        """LRU-cached BatchContext for this segment set. ``retain=True``
+        takes the in-flight pin ATOMICALLY with the cache insert (same
+        lock hold) — pinning after return would leave a window where a
+        concurrent _evict drops the still-unpinned batch and the next hit
+        rebuilds a duplicate at transiently ~2x the byte budget."""
         key = self._batch_key(segments)
-        ctx = self._batches.pop(key, None)
-        if ctx is None:
-            ctx = BatchContext(segments)
-        self._batches[key] = ctx
+        with self._lock:
+            ctx = self._batches.pop(key, None)
+            if ctx is None:
+                ctx = BatchContext(segments)
+            self._batches[key] = ctx
+            if retain:
+                self._retain_launch(key)  # RLock: reentrant
         self._evict(keep=key)
         return ctx
 
     def _evict(self, keep=None):
         """LRU eviction by count AND resident HBM bytes (a 100M-row batch's
         decoded/prehashed blocks alone can approach HBM capacity — count
-        caps alone don't bound that)."""
-        def over():
-            if len(self._batches) > self.MAX_CACHED_BATCHES:
-                return True
-            total = sum(b.device_bytes() for b in self._batches.values())
-            return total > self.MAX_CACHED_BYTES and len(self._batches) > 1
-        while over():
-            lru = next(k for k in self._batches if k != keep)
-            self._batches.pop(lru)
+        caps alone don't bound that). Batches with in-flight launches are
+        PINNED (refcounted via _retain_launch): evicting one would drop
+        HBM blocks a dispatched-but-unfetched query is still reading.
 
-    def try_execute(self, q: QueryContext, segments, final: bool = False):
-        """list[IntermediateResult] (length 1) or None → host fallback.
+        The byte sum runs OUTSIDE the executor lock: device_bytes takes
+        each batch's materialization lock, and a cold multi-GB column
+        build can hold that for seconds — holding the executor lock
+        across it would serialize every concurrent launch/fetch. The
+        snapshot is racy by design; eviction is best-effort LRU."""
+        while True:
+            with self._lock:
+                batches = list(self._batches.values())
+                over = len(batches) > self.MAX_CACHED_BATCHES
+            if not over:
+                total = sum(b.device_bytes() for b in batches)
+                if not (total > self.MAX_CACHED_BYTES and len(batches) > 1):
+                    return
+            with self._lock:
+                lru = next(
+                    (k for k in self._batches
+                     if k != keep and k not in self._inflight_launches), None)
+                if lru is None:
+                    return  # everything else is pinned by in-flight launches
+                self._batches.pop(lru)
 
-        ``final=True``: this result will be finalized directly with no
-        upstream merge (terminal local query) — sketch aggregations may
-        finalize on device and ship answers instead of mergeable state."""
-        try:
-            return [self._execute(q, segments, final)]
-        except DeviceUnsupported:
-            return None
+    def _retain_launch(self, key) -> None:
+        with self._lock:
+            self._inflight_launches[key] = \
+                self._inflight_launches.get(key, 0) + 1
+            self.inflight += 1
+
+    def _release_launch(self, key) -> None:
+        with self._lock:
+            n = self._inflight_launches.get(key, 0) - 1
+            if n > 0:
+                self._inflight_launches[key] = n
+            else:
+                self._inflight_launches.pop(key, None)
+            self.inflight -= 1
+        # byte cap re-check after the fetch (columns materialize lazily,
+        # so the batch may have grown during this query)
+        self._evict(keep=key)
+
+    def _make_resolve(self, bufs_dev, layout):
+        """fetch-phase closure shared by solo and cohort launches: ONE
+        blocking device_get of the dispatched packed buffer, observability
+        accounting under the lock, unpack by the precomputed layout."""
+        def resolve():
+            import time as _time
+
+            _t_get = _time.perf_counter()
+            bufs = jax.device_get(bufs_dev)
+            # blocking wait = link round trip + kernel; bench subtracts it
+            # from wall time for a MEASURED host_ms (floor-subtraction
+            # overstated host work by the link's RTT variance)
+            wait = _time.perf_counter() - _t_get
+            bufs = {k: np.asarray(v) for k, v in bufs.items()}
+            with self._lock:
+                self.last_get_wait_s = wait
+                # observability: what actually crossed the host link
+                self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())
+                self.fetch_leaves_total += len(bufs)
+            return _unpack_outs(bufs, layout)
+
+        return resolve
 
     # ---- template build --------------------------------------------------
     def _agg_template(self, i: int, a: Expression, ctx: BatchContext, params, counter):
@@ -850,8 +912,16 @@ class DeviceExecutor:
             return (name, argt, (nplanes, rpb))
         return (name, argt, rpb)
 
-    def _execute(self, q: QueryContext, segments,
-                 final: bool = False) -> IntermediateResult:
+    def launch(self, q: QueryContext, segments,
+               final: bool = False) -> InflightLaunch:
+        """LAUNCH phase: template build + column gather + NON-BLOCKING XLA
+        dispatch (JAX dispatch is async; only device_get blocks). Returns
+        an InflightLaunch whose ``fetch()`` resolves the packed output
+        buffer — N concurrent queries overlap their link round trips
+        instead of serializing them. Under concurrency, same-cohort
+        launches (one batch, one template, same param shapes) coalesce
+        into a single vmapped dispatch (engine/inflight.py). Raises
+        DeviceUnsupported for shapes the device path doesn't cover."""
         aggs = q.aggregations()
         if q.distinct:
             # DISTINCT == group-by over the select columns with no aggs:
@@ -870,7 +940,20 @@ class DeviceExecutor:
             if not segment_device_eligible(s):
                 raise DeviceUnsupported("mutable/upsert segment needs host scan path")
 
-        ctx = self.batch_for(segments)
+        # the batch stays pinned for the WHOLE launch — template build and
+        # column materialization included, not just the dispatched flight
+        # (retain=True takes the pin atomically with the cache insert)
+        ctx = self.batch_for(segments, retain=True)
+        batch_key = self._batch_key(segments)
+        try:
+            return self._launch_pinned(q, ctx, batch_key, segments,
+                                       aggs, final)
+        except BaseException:
+            self._release_launch(batch_key)
+            raise
+
+    def _launch_pinned(self, q, ctx, batch_key, segments, aggs,
+                       final) -> InflightLaunch:
         params: dict = {}
         counter = [0]
 
@@ -934,29 +1017,7 @@ class DeviceExecutor:
         template = (shape, filter_tpl, group_cols, group_cards, agg_tpls,
                     sorted_k, final)
 
-        entry = self._pipelines.get((template, self.mm_mode))
-        if entry is None:
-            raw = build_pipeline(template, self.mm_mode,
-                                 sorted_hll_ok=(self.mesh is None))
-            if self.mesh is not None:
-                from pinot_tpu.parallel.mesh import shard_pipeline
-
-                sharded = shard_pipeline(raw, self.mesh)
-            else:
-                sharded = raw
-            if final:
-                # device finalize runs AFTER the cross-shard max-combine
-                def inner(cols, n_docs, params, _fn=sharded):
-                    return _finalize_sketch_outs(
-                        _fn(cols, n_docs, params), agg_tpls)
-            else:
-                inner = sharded
-            pipeline = jax.jit(
-                lambda cols, n_docs, params: _pack_outs(inner(cols, n_docs, params))
-            )
-            entry = (pipeline, inner, {})
-            self._pipelines[(template, self.mm_mode)] = entry
-        pipeline, inner, layout_cache = entry
+        entry = self._pipeline_entry(template, agg_tpls, final)
 
         # SET useSortedProjection=false keeps the per-query in-pipeline
         # sort (the cold-scan measurement form); default taps the batch's
@@ -1016,31 +1077,161 @@ class DeviceExecutor:
         # traces without touching the device.
         lkey = (ctx.S, next(v for k, v in cols.items()
                             if not k.startswith("sk::")).shape[1])
-        layout = layout_cache.get(lkey)
+        layout = entry["layouts"].get(lkey)
         if layout is None:
-            layout = _out_layout(jax.eval_shape(inner, cols, n_docs, params))
-            layout_cache[lkey] = layout
-        if self.profile_enabled:
-            self._last_launch = (
-                pipeline, cols, n_docs, params,
-                sum(int(np.prod(v.shape, dtype=np.int64)) * v.dtype.itemsize
-                    for v in cols.values()),
-            )
-        import time as _time
+            layout = _out_layout(
+                jax.eval_shape(entry["inner"], cols, n_docs, params))
+            with self._lock:
+                entry["layouts"][lkey] = layout
+        resolve = self._dispatch(
+            entry, batch_key, cols, n_docs, params, lkey, layout)
+        return InflightLaunch(self, q, ctx, template, aggs, batch_key, resolve)
 
-        _t_get = _time.perf_counter()
-        bufs = jax.device_get(pipeline(cols, n_docs, params))
-        # blocking wait = link round trip + kernel; bench subtracts it from
-        # wall time for a MEASURED host_ms (floor-subtraction overstated
-        # host work by the link's RTT variance)
-        self.last_get_wait_s = _time.perf_counter() - _t_get
-        bufs = {k: np.asarray(v) for k, v in bufs.items()}
-        # observability: what actually crossed the host link (bench breakdown)
-        self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())
-        self.fetch_leaves_total += len(bufs)
-        outs = _unpack_outs(bufs, layout)
-        self._evict(keep=self._batch_key(segments))
-        return self._to_intermediate(q, ctx, template, outs, aggs)
+    # ---- dispatch: solo vs coalesced -------------------------------------
+    def _pipeline_entry(self, template, agg_tpls, final) -> dict:
+        """Compiled-pipeline cache entry for (template, mm_mode): the solo
+        jitted pipeline, the pre-pack inner fn (eval_shape layouts), the
+        raw pipeline (cohort rebuilds compose vmap/mesh from it), and the
+        layout caches. Built under the executor lock so concurrent
+        same-template launches share ONE entry (the coalescer keys on it)."""
+        with self._lock:
+            entry = self._pipelines.get((template, self.mm_mode))
+            if entry is not None:
+                return entry
+            raw = build_pipeline(template, self.mm_mode,
+                                 sorted_hll_ok=(self.mesh is None))
+            if self.mesh is not None:
+                from pinot_tpu.parallel.mesh import shard_pipeline
+
+                sharded = shard_pipeline(raw, self.mesh)
+            else:
+                sharded = raw
+            if final:
+                # device finalize runs AFTER the cross-shard max-combine
+                def inner(cols, n_docs, params, _fn=sharded):
+                    return _finalize_sketch_outs(
+                        _fn(cols, n_docs, params), agg_tpls)
+            else:
+                inner = sharded
+            pipeline = jax.jit(
+                lambda cols, n_docs, params: _pack_outs(
+                    inner(cols, n_docs, params))
+            )
+            entry = {
+                "pipeline": pipeline, "inner": inner, "raw": raw,
+                "agg_tpls": agg_tpls, "final": final,
+                "layouts": {}, "cohort": None, "cohort_layouts": {},
+            }
+            self._pipelines[(template, self.mm_mode)] = entry
+            return entry
+
+    def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout):
+        """Dispatch one query: through the coalescer when concurrency makes
+        a cohort partner likely, else solo. Returns the resolve() closure
+        the InflightLaunch fetch phase blocks on. Coalescing is disabled
+        under profile capture (the bench must see per-query launches)."""
+        co = self.coalescer
+        if (co is not None and not self.profile_enabled
+                and co.should_window(self.inflight)):
+            # cohort key: same pipeline entry + same batch + same column
+            # set + same param shapes/dtypes → params stack along a
+            # leading axis into one vmapped launch
+            sig = tuple(sorted(
+                (k, tuple(v.shape), str(v.dtype)) for k, v in params.items()))
+            ckey = (id(entry), batch_key, lkey, tuple(sorted(cols)), sig)
+            cohort, idx = co.join(
+                ckey, params,
+                lambda members: self._cohort_launch(
+                    entry, cols, n_docs, members, lkey))
+            return lambda: cohort.resolve_member(idx)
+        return self._solo_launch(entry, cols, n_docs, params, layout)
+
+    def _solo_launch(self, entry, cols, n_docs, params, layout):
+        pipeline = entry["pipeline"]
+        if self.profile_enabled:
+            with self._lock:
+                self._last_launch = (
+                    pipeline, cols, n_docs, params,
+                    sum(int(np.prod(v.shape, dtype=np.int64))
+                        * v.dtype.itemsize for v in cols.values()),
+                )
+        bufs_dev = pipeline(cols, n_docs, params)  # async dispatch
+        return self._make_resolve(bufs_dev, layout)
+
+    def _cohort_launch(self, entry, cols, n_docs, members, lkey):
+        """Leader side of a coalesced cohort: stack every member's params
+        along a leading axis and dispatch ONE vmapped launch; the shared
+        resolve() fetches ONE packed buffer for the whole cohort (each
+        member then slices its row — engine/inflight.py _Cohort)."""
+        if len(members) == 1:
+            # window opened but nobody joined: the already-compiled solo
+            # pipeline serves it — a size-1 vmapped variant would be a
+            # whole extra compile of the template for nothing
+            layout = entry["layouts"][lkey]
+            base = self._solo_launch(entry, cols, n_docs, members[0], layout)
+            return lambda: {k: v[None] for k, v in base().items()}
+        pipeline_v, inner_v = self._cohort_pipeline(entry)
+        # pad the cohort to the next power of two (repeating the last
+        # member's params): jit re-specializes per stack size, and ragged
+        # cohort sizes under churn would compile up to max_cohort variants
+        # of the whole pipeline — pow2 bucketing caps that at
+        # log2(max_cohort) for at most 2x padded lanes, and member slices
+        # (idx < real size) never see the padding
+        n_real = len(members)
+        n_pad = 1 << (n_real - 1).bit_length()
+        padded = list(members) + [members[-1]] * (n_pad - n_real)
+        pstack = {k: jnp.stack([m[k] for m in padded])
+                  for k in members[0]}
+        # literal-free templates have EMPTY params; vmap needs at least one
+        # batched leaf, so every cohort rides a synthetic member index
+        # (templates index params by name — an extra key is never read)
+        pstack["__member__"] = jnp.arange(n_pad, dtype=jnp.int32)
+        ck = (lkey, n_pad)
+        layout = entry["cohort_layouts"].get(ck)
+        if layout is None:
+            layout = _out_layout(
+                jax.eval_shape(inner_v, cols, n_docs, pstack))
+            with self._lock:
+                entry["cohort_layouts"][ck] = layout
+        bufs_dev = pipeline_v(cols, n_docs, pstack)  # async dispatch
+        return self._make_resolve(bufs_dev, layout)
+
+    def _cohort_pipeline(self, entry):
+        """(jitted packed pipeline, inner fn) over params carrying a
+        leading cohort axis. Single device: vmap the solo inner (finalize
+        included) over the stacked params. Mesh: one shard_map whose body
+        vmaps pipeline + combine (+ finalize) per member —
+        parallel/mesh.py shard_pipeline(cohort=True). jit re-specializes
+        per cohort size; the coalescer's max_cohort bounds that."""
+        with self._lock:
+            cached = entry["cohort"]
+        if cached is not None:
+            return cached
+        raw, agg_tpls, final = entry["raw"], entry["agg_tpls"], entry["final"]
+        post = None
+        if final:
+            def post(outs, _tpls=agg_tpls):
+                return _finalize_sketch_outs(outs, _tpls)
+        if self.mesh is not None:
+            from pinot_tpu.parallel.mesh import shard_pipeline
+
+            inner_v = shard_pipeline(raw, self.mesh, cohort=True, post=post)
+        else:
+            one = raw
+            if post is not None:
+                def one(cols, n_docs, p, _raw=raw, _post=post):
+                    return _post(_raw(cols, n_docs, p))
+
+            def inner_v(cols, n_docs, pstack, _one=one):
+                return jax.vmap(
+                    lambda p: _one(cols, n_docs, p))(pstack)
+        pipeline_v = jax.jit(
+            lambda cols, n_docs, pstack: _pack_outs(
+                inner_v(cols, n_docs, pstack)))
+        with self._lock:
+            if entry["cohort"] is None:
+                entry["cohort"] = (pipeline_v, inner_v)
+            return entry["cohort"]
 
     @staticmethod
     def _needed_columns(tpl) -> set:

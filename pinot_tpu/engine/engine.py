@@ -238,6 +238,25 @@ class QueryEngine:
         partial, sketch aggregations may finalize on device and skip
         shipping G×m mergeable state over the host link. Server-shipped
         partials stay mergeable (the broker combines them)."""
+        return self.execute_segments_async(q, segments, terminal)()
+
+    def execute_segments_async(self, q: QueryContext, segments,
+                               terminal: bool = False, fallback_gate=None):
+        """LAUNCH phase of execute_segments → zero-arg fetch() closure.
+
+        Everything CPU-bound runs here — pruning, star-tree/metadata fast
+        paths, the device template build + NON-BLOCKING dispatch
+        (DeviceExecutor.launch), and the host scan partials (which overlap
+        the device launch's link round trip). The returned closure does
+        only the blocking device fetch + merge, so a server can release
+        its scheduler slot before the host↔device round trip and N
+        concurrent queries overlap their link waits (server/server.py
+        _handle_submit). Fetch-time device fallbacks (sorted group-table
+        overflow) re-run the device batch on the host inside the closure;
+        ``fallback_gate`` (callable(fn) → fn()) wraps THAT re-run so a
+        server can put the heavy host scan back under scheduler admission
+        — the fetch phase itself runs slot-free by design, and without
+        the gate a fallback storm would escape the concurrency cap."""
         q = self._expand_star(q, segments[0])
 
         kept, pruned = [], 0
@@ -288,41 +307,77 @@ class QueryEngine:
             scan = remaining
         else:
             scan = []
+        device_handle, device_segs, host_results = None, [], []
         if scan:
             # consuming (mutable) and upsert-masked segments run on the host
             # scan path; sealed immutables go to the device in one batch
-            from pinot_tpu.engine.device import segment_device_eligible
+            from pinot_tpu.engine.device import DeviceUnsupported, \
+                segment_device_eligible
 
             device_ok, host_segs = [], []
             for s in scan:
                 (device_ok if segment_device_eligible(s) else host_segs).append(s)
-            device_result = None
             if self.device is not None and device_ok:
                 # device finalize is safe only when the device batch is the
                 # whole answer: no host segments, no star-tree/metadata
                 # partials to merge with
                 final = terminal and not results and not host_segs
-                device_result = self.device.try_execute(q, device_ok, final=final)
-            if device_result is not None:
-                results.extend(device_result)
-            else:
-                host_segs = scan
-            for s in host_segs:
-                results.append(self.host.execute_segment(q, s))
-        if not results:
-            # everything pruned: empty result over schema of first segment
-            executed = [segments[0]]
-            results.append(self.host.execute_segment(_impossible(q), segments[0]))
+                try:
+                    device_handle = self.device.launch(q, device_ok, final=final)
+                    device_segs = device_ok
+                except DeviceUnsupported:
+                    device_handle = None
+            if device_handle is None:
+                host_segs = scan  # launch refused: whole scan on the host
+            # host partials execute in the launch phase, overlapping the
+            # dispatched device batch's link round trip; a host failure
+            # must release the in-flight handle or its batch pin leaks
+            try:
+                host_results = [self.host.execute_segment(q, s)
+                                for s in host_segs]
+            except BaseException:
+                if device_handle is not None:
+                    device_handle.release()
+                raise
 
-        merged = merge_intermediates(q, results)
-        merged.stats.num_segments_pruned = pruned
-        merged.stats.num_segments_queried = len(segments)
-        # pruned segments still count toward totalDocs (reference semantics)
-        executed_ids = {id(s) for s in executed}
-        for s in segments:
-            if id(s) not in executed_ids:
-                merged.stats.total_docs += s.n_docs
-        return merged
+        def fetch():
+            res = list(results)
+            if device_handle is not None:
+                from pinot_tpu.engine.device import DeviceUnsupported
+
+                try:
+                    res.append(device_handle.fetch())
+                except DeviceUnsupported:
+                    # fetch-time fallback (sorted group-table overflow):
+                    # the device must never shape truncation policy. The
+                    # host re-scan is heavy CPU work — route it through
+                    # the caller's admission gate when one is provided
+                    def _host_rerun():
+                        return [self.host.execute_segment(q, s)
+                                for s in device_segs]
+
+                    res.extend(_host_rerun() if fallback_gate is None
+                               else fallback_gate(_host_rerun))
+            res.extend(host_results)
+            ran = executed
+            if not res:
+                # everything pruned: empty result over first segment's schema
+                ran = [segments[0]]
+                res.append(self.host.execute_segment(
+                    _impossible(q), segments[0]))
+
+            merged = merge_intermediates(q, res)
+            merged.stats.num_segments_pruned = pruned
+            merged.stats.num_segments_queried = len(segments)
+            # pruned segments still count toward totalDocs (reference
+            # semantics)
+            executed_ids = {id(s) for s in ran}
+            for s in segments:
+                if id(s) not in executed_ids:
+                    merged.stats.total_docs += s.n_docs
+            return merged
+
+        return fetch
 
     # ---- dimension-table lookup (DimensionTableDataManager analog) -------
     def dim_table_lookup(self, dim_table: str, value_col: str, pk_col: str):

@@ -1,0 +1,207 @@
+"""In-flight launch handles + cross-query launch coalescing.
+
+The device executor's hot path splits into an async **launch** phase
+(template build + column gather + non-blocking XLA dispatch — JAX dispatch
+is already asynchronous, only ``jax.device_get`` blocks) and a **fetch**
+phase that resolves the packed output buffer. ``InflightLaunch`` is the
+handle between the two: N concurrent queries overlap their host↔device
+round trips instead of serializing them on the transport threads, and the
+server releases its scheduler slot before the link wait (the per-server
+many-requests-in-flight posture of the reference's scatter-gather model —
+a Pinot server keeps many segment queries in flight to hide exactly this
+latency).
+
+``LaunchCoalescer`` rides on top: concurrent queries sharing one
+(batch, template, param-shape) cohort key — the dashboard fan-out case,
+same SQL shape with different literals — stack their params along a
+leading axis and execute as ONE vmapped launch whose result crosses the
+link as ONE packed buffer, amortizing a single RTT over the whole cohort.
+The micro-batch window only opens under pressure (another query already in
+flight on the executor, or the server scheduler reporting contention): an
+idle server dispatches immediately and pays no window latency.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class InflightLaunch:
+    """A dispatched-but-not-fetched device launch.
+
+    ``fetch()`` blocks on the host link (the ONLY blocking step), unpacks
+    the packed buffer, and builds the canonical IntermediateResult. The
+    batch the launch reads from is refcounted against LRU eviction until
+    the fetch completes (``DeviceExecutor._retain_launch`` /
+    ``_release_launch``) — without the pin, a concurrent query's
+    ``_evict`` could drop the HBM blocks this launch is still reading.
+    """
+
+    def __init__(self, executor, q, ctx, template, aggs, batch_key, resolve):
+        self._executor = executor
+        self._q = q
+        self._ctx = ctx
+        self._template = template
+        self._aggs = aggs
+        self._batch_key = batch_key
+        self._resolve = resolve
+        self._done = False
+
+    def fetch(self):
+        """Blocking phase: resolve the packed buffer → IntermediateResult.
+        Raises DeviceUnsupported on fetch-time fallbacks (sorted group
+        table overflow) — the caller re-runs the batch on the host path.
+        One-shot: the batch pin is dropped whether or not it succeeds."""
+        if self._done:
+            raise RuntimeError("InflightLaunch.fetch() called twice")
+        self._done = True
+        try:
+            outs = self._resolve()
+            return self._executor._to_intermediate(
+                self._q, self._ctx, self._template, outs, self._aggs)
+        finally:
+            self._executor._release_launch(self._batch_key)
+
+    def release(self):
+        """Abandon without fetching: drop the batch pin. Callers that fail
+        BETWEEN launch and fetch (e.g. a host-segment partial raising
+        while the device batch is in flight) must call this, or the pin
+        leaks — the batch would stay unevictable and the executor's
+        inflight count (the coalescer's pressure signal) never drains.
+        Idempotent with fetch(); safe to call on an already-fetched handle."""
+        if not self._done:
+            self._done = True
+            self._executor._release_launch(self._batch_key)
+
+
+class _Cohort:
+    """One coalesced launch: the leader stacks every member's params and
+    dispatches once; the shared packed buffer is fetched once (first
+    ``resolve_member`` wins) and each member slices its row."""
+
+    # liveness poll: a member waits as long as the leader THREAD is alive
+    # (a first dispatch jit-compiles the whole vmapped pipeline, which can
+    # far exceed any fixed timeout) but must not wait forever on a leader
+    # that died mid-window
+    READY_POLL_S = 5.0
+
+    def __init__(self, launch_fn):
+        self._launch_fn = launch_fn
+        self.leader_thread = threading.current_thread()  # creator leads
+        self.members = []          # per-member params dicts, join order
+        self.open = True           # False once the window closed
+        self.full = threading.Event()  # hit max_cohort: leader stops waiting
+        self.ready = threading.Event()
+        self.error = None          # leader's dispatch failure, if any
+        self._shared_resolve = None
+        self._fetch_lock = threading.Lock()
+        self._outs = None
+        self._exc = None
+        self._fetched = False
+
+    def dispatch(self):
+        """Leader only: one stacked launch for the whole cohort."""
+        try:
+            self._shared_resolve = self._launch_fn(self.members)
+        except BaseException as e:  # noqa: BLE001 — members must observe it
+            self.error = e
+        finally:
+            self.ready.set()
+
+    def resolve_member(self, idx: int) -> dict:
+        """Member ``idx``'s unpacked outputs. The shared buffer crosses
+        the link ONCE; every member's slice comes from that one fetch."""
+        while not self.ready.wait(self.READY_POLL_S):
+            # slow-but-alive leader (e.g. first jit compile of the cohort
+            # pipeline) keeps members waiting; a dead one fails them fast
+            if not self.leader_thread.is_alive():
+                raise RuntimeError(
+                    "coalesced launch leader died before dispatch")
+        if self.error is not None:
+            raise self.error
+        with self._fetch_lock:
+            if not self._fetched:
+                try:
+                    self._outs = self._shared_resolve()
+                except BaseException as e:  # noqa: BLE001 — shared failure
+                    self._exc = e
+                self._fetched = True
+        if self._exc is not None:
+            raise self._exc
+        return {k: v[idx] for k, v in self._outs.items()}
+
+
+class LaunchCoalescer:
+    """Micro-batches concurrent same-template launches into one vmapped
+    dispatch. Pure synchronization — the executor supplies the actual
+    stacked-launch closure (``DeviceExecutor._cohort_launch``)."""
+
+    def __init__(self, window_s: float = 0.003, max_cohort: int = 8):
+        self.enabled = True
+        self.window_s = window_s      # leader's micro-batch window
+        self.max_cohort = max_cohort  # vmap width cap (bounds recompiles)
+        self.force = False            # tests/bench: window regardless of load
+        self.pressure_fn = None       # server wires scheduler.pressure here
+        self._lock = threading.Lock()
+        self._pending: dict = {}      # cohort key -> open _Cohort
+        # observability (bench concurrency sweep reads deltas)
+        self.cohorts_launched = 0
+        self.queries_coalesced = 0    # members that joined past the leader
+
+    def should_window(self, executor_inflight: int) -> bool:
+        """Gate: open a window only when concurrency makes a partner
+        likely — an idle server must run its one query immediately.
+        ``executor_inflight`` counts launches between dispatch and fetch
+        (INCLUDING the asking query, hence > 1); the scheduler's pressure
+        covers queries still queued for admission."""
+        if not self.enabled:
+            return False
+        if self.force:
+            return True
+        if executor_inflight > 1:
+            return True
+        fn = self.pressure_fn
+        if fn is not None:
+            try:
+                return fn() > 1
+            except Exception:  # noqa: BLE001 — gating must never fail a query
+                return False
+        return False
+
+    def join(self, key, params: dict, launch_fn):
+        """Join (or open) the cohort for ``key`` → (cohort, member index).
+
+        The FIRST arrival becomes leader: it holds the window open for
+        ``window_s``, then closes the cohort and dispatches one stacked
+        launch built by ``launch_fn(members)``. Later arrivals append
+        their params and return immediately — they block only inside
+        ``resolve_member`` (their fetch phase), so a member's scheduler
+        slot is released while the leader's launch is still in flight.
+        """
+        with self._lock:
+            c = self._pending.get(key)
+            if c is not None and c.open:
+                idx = len(c.members)
+                c.members.append(params)
+                if len(c.members) >= self.max_cohort:
+                    c.open = False          # full: stop accepting members
+                    self._pending.pop(key, None)
+                    c.full.set()            # leader dispatches immediately
+                self.queries_coalesced += 1
+                return c, idx
+            c = _Cohort(launch_fn)
+            c.members.append(params)
+            self._pending[key] = c
+        # leader: hold the micro-batch window open — but a cohort that
+        # fills to max_cohort early dispatches immediately (the remaining
+        # window would be pure added latency for everyone in it). A window
+        # that finds NO partner costs window_s against a ~100ms link RTT;
+        # the pressure gate keeps that bounded to genuinely-concurrent load.
+        c.full.wait(self.window_s)
+        with self._lock:
+            c.open = False
+            if self._pending.get(key) is c:
+                self._pending.pop(key, None)
+            self.cohorts_launched += 1
+        c.dispatch()
+        return c, 0

@@ -71,6 +71,15 @@ class BatchContext:
         self._prehashed: dict[str, object] = {}     # name -> (S, L) value hashes
         self._mv_columns: dict[str, object] = {}    # name -> (S, L, K) id blocks
         self._sorted_hll: dict = {}   # (group_cols, hash_col, log2m) -> sorted keys
+        # concurrent queries share one cached BatchContext (the executor's
+        # batch LRU): lazy materialization is locked so two threads never
+        # build the same block twice. RLock: sorted_hll_keys re-enters
+        # column. Resident bytes ride a LOCK-FREE counter updated at
+        # block-insert time — the executor's _evict reads it from OTHER
+        # queries' batches, and taking this lock there would stall
+        # unrelated launches behind a cold multi-GB column build.
+        self._lock = threading.RLock()
+        self._resident_bytes = 0
 
     # ---- column access ---------------------------------------------------
     def column_meta(self, name: str):
@@ -80,6 +89,10 @@ class BatchContext:
         raise DeviceUnsupported(f"unknown column {name}")
 
     def encoding(self, name: str) -> str:
+        with self._lock:
+            return self._encoding_locked(name)
+
+    def _encoding_locked(self, name: str) -> str:
         if name not in self._encodings:
             metas = []
             for s in self.segments:
@@ -107,6 +120,10 @@ class BatchContext:
         entries padded with -1 (K = batch max entries per doc). The device
         form of getDictIdMV (ForwardIndexReader.java:99) — predicates
         evaluate per entry and reduce match-any over K."""
+        with self._lock:
+            return self._mv_column_locked(name)
+
+    def _mv_column_locked(self, name: str):
         if name not in self._mv_columns:
             metas = [s.column_metadata(name) for s in self.segments]
             if any(m.encoding != Encoding.DICT for m in metas):
@@ -132,11 +149,16 @@ class BatchContext:
                 rank = np.arange(len(fwd), dtype=np.int64) - np.repeat(off[:-1], lens)
                 blocks[i, doc_of_entry, rank] = remap[fwd]
             self._mv_columns[name] = jnp.asarray(blocks)
+            self._note_resident(self._mv_columns[name])
         return self._mv_columns[name]
 
     def column(self, name: str):
         """(S, L) device array: **global** dict ids (DICT, pad -1) or raw
         values (RAW, pad 0)."""
+        with self._lock:
+            return self._column_locked(name)
+
+    def _column_locked(self, name: str):
         if name not in self._columns:
             enc = self.encoding(name)
             if enc == Encoding.DICT:
@@ -156,10 +178,15 @@ class BatchContext:
                     [host_column_block(s, name, self.pad_to) for s in self.segments]
                 )
             self._columns[name] = jnp.asarray(blocks)
+            self._note_resident(self._columns[name])
         return self._columns[name]
 
     def global_dict(self, name: str) -> Dictionary:
         """Sorted union of per-segment dictionary values (global id space)."""
+        with self._lock:
+            return self._global_dict_locked(name)
+
+    def _global_dict_locked(self, name: str) -> Dictionary:
         if name not in self._global_dicts:
             vals = []
             for s in self.segments:
@@ -181,6 +208,10 @@ class BatchContext:
         removes it entirely. Floats decode to f32 (the device value space,
         as the old value-LUT path did); ints keep the WIDEST dtype across
         segments."""
+        with self._lock:
+            return self._decoded_column_locked(name)
+
+    def _decoded_column_locked(self, name: str):
         if name not in self._decoded:
             if self.encoding(name) != Encoding.DICT:
                 return self.column(name)
@@ -201,12 +232,17 @@ class BatchContext:
                 fwd = np.asarray(s.forward(name))
                 blocks[i, : len(fwd)] = vals[fwd]
             self._decoded[name] = jnp.asarray(blocks)
+            self._note_resident(self._decoded[name])
         return self._decoded[name]
 
     def prehashed_column(self, name: str):
         """(S, L) device array of per-doc canonical value hashes for
         DISTINCTCOUNTHLL — host-side LUT gather at upload replaces the
         device hash-LUT gather (~80ms/query on v5e at 12M docs)."""
+        with self._lock:
+            return self._prehashed_column_locked(name)
+
+    def _prehashed_column_locked(self, name: str):
         if name not in self._prehashed:
             blocks = np.zeros((self.S, self.pad_to), dtype=np.uint32)
             for i, s in enumerate(self.segments):
@@ -214,6 +250,7 @@ class BatchContext:
                 fwd = np.asarray(s.forward(name))
                 blocks[i, : len(fwd)] = h[fwd]
             self._prehashed[name] = jnp.asarray(blocks)
+            self._note_resident(self._prehashed[name])
         return self._prehashed[name]
 
     def bytes_width(self, name: str) -> int:
@@ -234,6 +271,10 @@ class BatchContext:
         """(S, L, W) device array of raw byte planes for a fixed-width
         BYTES dict column (HLLMERGE's pre-aggregated register planes) —
         per-doc LUT gather on the host at upload, like decoded_column."""
+        with self._lock:
+            return self._bytes_plane_locked(name)
+
+    def _bytes_plane_locked(self, name: str):
         key = "bp::" + name
         if key not in self._decoded:
             W = self.bytes_width(name)
@@ -247,18 +288,21 @@ class BatchContext:
                 fwd = np.asarray(s.forward(name))
                 blocks[i, : len(fwd)] = planes[fwd]
             self._decoded[key] = jnp.asarray(blocks)
+            self._note_resident(self._decoded[key])
         return self._decoded[key]
+
+    def _note_resident(self, arr) -> None:
+        """Caller holds self._lock; device_bytes reads the counter
+        lock-free (int update under the GIL)."""
+        self._resident_bytes += int(getattr(arr, "nbytes", 0))
 
     def device_bytes(self) -> int:
         """HBM resident bytes of materialized column blocks (columns +
         decoded + prehashed + sorted projections) — the executor's
-        byte-aware LRU eviction key."""
-        total = 0
-        for d in (self._columns, self._decoded, self._prehashed,
-                  self._mv_columns, self._sorted_hll):
-            for arr in d.values():
-                total += getattr(arr, "nbytes", 0)
-        return total
+        byte-aware LRU eviction key. LOCK-FREE read of the insert-time
+        counter: _evict must never block behind another query's cold
+        column build."""
+        return self._resident_bytes
 
     def sorted_hll_keys(self, group_cols, group_cards, hash_col: str,
                         log2m: int):
@@ -269,6 +313,12 @@ class BatchContext:
         reference: built once, reused by every later query of the shape).
         The first query pays the lax.sort (~320ms at 100M rows on v5e);
         repeats reduce boundaries + one matmul (~60ms)."""
+        with self._lock:
+            return self._sorted_hll_keys_locked(
+                group_cols, group_cards, hash_col, log2m)
+
+    def _sorted_hll_keys_locked(self, group_cols, group_cards, hash_col: str,
+                                log2m: int):
         key = (tuple(group_cols), tuple(group_cards), hash_col, int(log2m))
         if key not in self._sorted_hll:
             import jax
@@ -296,6 +346,7 @@ class BatchContext:
 
             self._sorted_hll[key] = jax.jit(build)(
                 per_col, hh, self.n_docs_dev)
+            self._note_resident(self._sorted_hll[key])
         return self._sorted_hll[key]
 
     def int_bounds(self, name: str):

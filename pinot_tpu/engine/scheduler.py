@@ -42,8 +42,16 @@ class QueryScheduler:
         self._sem = threading.Semaphore(max_concurrent)
         self._lock = threading.Lock()
         self._waiting = 0
+        self._running = 0
         self.num_rejected = 0
         self.num_executed = 0
+
+    def pressure(self) -> int:
+        """Admitted + queued query count — the device launch coalescer's
+        gate (engine/inflight.py): a micro-batch window only opens when
+        concurrent demand makes a cohort partner likely."""
+        with self._lock:
+            return self._running + self._waiting
 
     def run(self, fn, queue_timeout_s=None, group: str = "default",
             stats_out=None):
@@ -78,6 +86,7 @@ class QueryScheduler:
         try:
             with self._lock:
                 self.num_executed += 1
+                self._running += 1
             # wait is over — publish it BEFORE fn so fn can fold it into
             # the stats it serializes (fn measures its own thread CPU: a
             # post-fn write here could never reach an already-encoded
@@ -87,6 +96,8 @@ class QueryScheduler:
                     (time.perf_counter() - t_enq) * 1e3
             return fn()
         finally:
+            with self._lock:
+                self._running -= 1
             self._sem.release()
 
 
@@ -153,6 +164,11 @@ class TokenBucketScheduler:
         self.num_executed = 0
 
     MAX_GROUPS = 1024  # arbitrary-SQL servers must not grow state unboundedly
+
+    def pressure(self) -> int:
+        """Admitted + queued query count (see QueryScheduler.pressure)."""
+        with self._cond:
+            return self._running + len(self._waiters)
 
     def _group(self, name: str) -> SchedulerGroup:
         g = self._groups.get(name)

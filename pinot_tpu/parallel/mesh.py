@@ -136,7 +136,7 @@ def _combine_outs(outs: dict) -> dict:
     return combined
 
 
-def shard_pipeline(pipeline_fn, mesh: Mesh):
+def shard_pipeline(pipeline_fn, mesh: Mesh, cohort: bool = False, post=None):
     """Wrap a device pipeline (engine/device.py build_pipeline inner fn) in
     shard_map over the segment axis.
 
@@ -144,11 +144,25 @@ def shard_pipeline(pipeline_fn, mesh: Mesh):
     sharded; everything else (literals, (K,) id lists) is replicated.
     Output convention: 'seg_matched' is gathered back to (S,); all other
     outputs are combined to replicated accumulators via psum/pmin/pmax.
+
+    ``cohort=True``: params carry a LEADING cohort axis — a stack of
+    same-template queries coalesced into one launch (engine/inflight.py).
+    The per-shard pipeline AND the cross-shard combine are vmapped over
+    that axis inside ONE shard_map, so a whole cohort costs one dispatch
+    and its collectives batch over ICI. ``post`` (cohort only): a
+    replicated post-combine transform (device sketch finalize) applied
+    per member INSIDE the vmap — its per-member semantics (regs → est)
+    must see unbatched shapes.
     """
 
+    def one(cols, n_docs, p):
+        outs = _combine_outs(pipeline_fn(cols, n_docs, p))
+        return post(outs) if post is not None else outs
+
     def sharded(cols, n_docs, params):
-        outs = pipeline_fn(cols, n_docs, params)
-        return _combine_outs(outs)
+        if cohort:
+            return jax.vmap(lambda p: one(cols, n_docs, p))(params)
+        return one(cols, n_docs, params)
 
     # global-id design: every param (literals, (C,) LUTs) is batch-wide and
     # replicated; only columns and n_docs carry the segment axis. The "ps"
@@ -164,10 +178,28 @@ def shard_pipeline(pipeline_fn, mesh: Mesh):
             P(SEG_AXIS),
             {k: param_spec(k, v) for k, v in params.items()},
         )
-        outs_shape = jax.eval_shape(pipeline_fn, cols, n_docs, params)
-        out_specs = {
-            k: (P(SEG_AXIS) if k == "seg_matched" else P()) for k in outs_shape
-        }
+        # output KEYS (and ranks) come from the collective-free parts:
+        # pipeline_fn (+ post, which only renames sketch leaves) — the
+        # combine itself preserves the key set, so eval_shape never has to
+        # trace an unbound collective
+        shape_params = params
+        if cohort:
+            shape_params = {
+                k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype)
+                for k, v in params.items()
+            }
+        keys_fn = pipeline_fn if post is None else (
+            lambda c, nd, p: post(pipeline_fn(c, nd, p)))
+        outs_shape = jax.eval_shape(keys_fn, cols, n_docs, shape_params)
+
+        def out_spec(k: str) -> P:
+            if k != "seg_matched":
+                return P()
+            # per-shard seg_matched is (S_shard,) — or (N, S_shard) with a
+            # leading cohort axis — and reassembles along the segment dim
+            return P(None, SEG_AXIS) if cohort else P(SEG_AXIS)
+
+        out_specs = {k: out_spec(k) for k in outs_shape}
         fn = _shard_map(
             sharded, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             **_SM_KW,

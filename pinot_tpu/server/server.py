@@ -75,7 +75,7 @@ class ServerInstance:
                  sync_interval_s: float = 0.2, device_executor="auto",
                  max_concurrent_queries: int = 8, max_queued_queries: int = 32,
                  group_trim_size: int = 5000, scheduler_name: str = None,
-                 tls="auto", tags=()):
+                 tls="auto", tags=(), compile_concurrency: int = None):
         self.instance_id = instance_id
         self.registry = registry
         self.data_dir = data_dir
@@ -111,6 +111,20 @@ class ServerInstance:
         self.scheduler = make_scheduler(
             scheduler_name, max_concurrent=max_concurrent_queries,
             max_queued=max_queued_queries)
+        # pre-admission compile bound: SQL compiles on the gRPC transport
+        # thread BEFORE scheduler admission (group/timeout come from the
+        # compiled context), previously limited only by grpc max_workers —
+        # a saturated server could burn every transport thread parsing
+        # queries it would then reject
+        self._compile_sem = threading.BoundedSemaphore(
+            compile_concurrency if compile_concurrency is not None
+            else max(2, max_concurrent_queries))
+        self._compile_timeout_s = 5.0
+        # launch coalescer gate: micro-batch windows open only under real
+        # scheduler pressure (engine/inflight.py LaunchCoalescer)
+        dev = getattr(self.engine, "device", None)
+        if dev is not None and getattr(dev, "coalescer", None) is not None:
+            dev.coalescer.pressure_fn = self.scheduler.pressure
         self.group_trim_size = group_trim_size
         from pinot_tpu.common.metrics import get_metrics
 
@@ -185,28 +199,55 @@ class ServerInstance:
                 name = name[: -len(suffix)]
         return name
 
+    def _compile_admitted(self, sql: str):
+        """SQL compile bounded by a small semaphore (ADVICE r5): compile
+        runs pre-admission on the transport thread, so without a bound a
+        saturated server burns unbounded CPU parsing queries it will
+        reject. The semaphore wait ships as the ``compileQueueMs`` timer;
+        waiting out the bound is a scheduling rejection, not a server
+        fault."""
+        t0 = time.perf_counter()
+        if not self._compile_sem.acquire(timeout=self._compile_timeout_s):
+            raise SchedulerSaturated(
+                f"compile queue full (no compile slot within "
+                f"{self._compile_timeout_s}s)")
+        try:
+            self.metrics.time_ms(
+                "compileQueueMs", (time.perf_counter() - t0) * 1e3)
+            return optimize_query(compile_query(sql))
+        finally:
+            self._compile_sem.release()
+
     def _handle_submit(self, request: bytes) -> bytes:
-        """Unary query submit. The ``queries`` metric counts at RECEIVE
-        time, before SQL compile, so ``queryErrors`` (which a parse error
-        increments) can never exceed ``queries`` on the dashboard. Compile
-        itself still runs BEFORE admission — the scheduler group and
-        timeout come from the compiled context, and a parse error must not
-        burn a concurrency slot — at the cost that compile CPU is spent
-        pre-admission on the transport thread, outside scheduler
-        accounting (admission caps only EXECUTION concurrency)."""
+        """Unary query submit, split into a LAUNCH phase under the
+        scheduler slot (compile → admission → segment acquire → device
+        dispatch + host partials) and a FETCH phase AFTER the slot is
+        released (the blocking device_get link wait + trim + encode):
+        N concurrent queries overlap their host↔device round trips
+        instead of holding N slots through them
+        (engine.execute_segments_async / engine/inflight.py).
+
+        The ``queries`` metric counts at RECEIVE time, before SQL compile,
+        so ``queryErrors`` (which a parse error increments) can never
+        exceed ``queries`` on the dashboard. Compile runs BEFORE admission
+        — the scheduler group and timeout come from the compiled context,
+        and a parse error must not burn a concurrency slot — bounded by
+        the compile semaphore (_compile_admitted)."""
         req = parse_instance_request(request)
         try:
             self.metrics.count("queries")
-            q = optimize_query(compile_query(req["sql"]))
-            # NOTE: the latency timer lives inside _handle_submit_inner —
+            q = self._compile_admitted(req["sql"])
+            # NOTE: the latency timer lives inside the launch/fetch pair —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
             acct: dict = {}
-            return self.scheduler.run(
-                lambda: self._handle_submit_inner(req, q, acct),
+            finish = self.scheduler.run(
+                lambda: self._handle_submit_launch(req, q, acct),
                 queue_timeout_s=self._request_timeout_s(q),
                 group=self._scheduler_group(q, req),
                 stats_out=acct)
+            # slot released: the link wait below must not hold admission
+            return finish()
         except SchedulerSaturated as e:
             # admission rejection is a query-level error: the server is
             # healthy (broker must not poison its failure detector)
@@ -216,7 +257,12 @@ class ServerInstance:
             self.metrics.count("queryErrors")
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
-    def _handle_submit_inner(self, req: dict, q, acct: dict = None) -> bytes:
+    def _handle_submit_launch(self, req: dict, q, acct: dict = None):
+        """LAUNCH phase (runs under the scheduler slot) → zero-arg FETCH
+        closure the transport thread invokes after the slot is released.
+        Segment refs, the latency timer, and the tracer span BOTH phases;
+        cleanup lives in the closure's finally (launch failures clean up
+        here and re-raise into the submit error path)."""
         import time as _time
 
         from pinot_tpu.common import trace
@@ -228,47 +274,80 @@ class ServerInstance:
         timer = self.metrics.timed("query")
         timer.__enter__()
         tracer = trace.start_trace() if q.options_ci().get("trace") else None
+        tdm, acquired = None, []
+
+        def cleanup():
+            if tdm is not None:
+                tdm.release(acquired)
+            if tracer is not None:
+                trace.end_trace()
+            timer.__exit__()
+
         try:
             q = _apply_request_overrides(q, req)
             tdm = self.engine.tables.get(q.table_name)
             wanted = set(req["segments"])
             acquired = [] if tdm is None else tdm.acquire()
+            segments = [s for s in acquired if s.name in wanted]
+            if not segments:
+                # benign routing race (segments moved since the broker's
+                # external-view read): broker skips this partial
+                err = encode_error(
+                    "no_segments",
+                    f"server {self.instance_id} hosts none of the "
+                    f"requested segments for table {q.table_name!r}",
+                )
+
+                def finish_missing():
+                    try:
+                        return err
+                    finally:
+                        cleanup()
+
+                return finish_missing
+            # requested-but-missing segments (assignment raced ahead of
+            # loading) are simply absent from this partial, like the
+            # reference's missing-segment accounting
+            with span("server.execute"):
+                # the fetch-time host fallback (sorted-table overflow) is
+                # heavy CPU work on a slot-free thread: re-admit it
+                # through the scheduler so a fallback storm can't escape
+                # the concurrency cap (saturation rejects it in-band)
+                gate = (lambda fn: self.scheduler.run(
+                    fn, queue_timeout_s=self._request_timeout_s(q),
+                    group=self._scheduler_group(q, req)))
+                fetch_merged = self.engine.execute_segments_async(
+                    q, segments, fallback_gate=gate)
+        except BaseException:
+            cleanup()
+            raise
+
+        def finish() -> bytes:
             try:
-                segments = [s for s in acquired if s.name in wanted]
-                if not segments:
-                    # benign routing race (segments moved since the broker's
-                    # external-view read): broker skips this partial
-                    return encode_error(
-                        "no_segments",
-                        f"server {self.instance_id} hosts none of the "
-                        f"requested segments for table {q.table_name!r}",
-                    )
-                # requested-but-missing segments (assignment raced ahead of
-                # loading) are simply absent from this partial, like the
-                # reference's missing-segment accounting
-                with span("server.execute"):
-                    merged = self.engine.execute_segments(q, segments)
+                # the blocking link wait lives here, OUTSIDE the slot
+                with span("server.fetch"):
+                    merged = fetch_merged()
+                with span("server.trim"):
+                    merged = trim_group_by(q, merged, self.group_trim_size)
+                # per-query resource accounting shipped in the partial's
+                # stats (the reference's DataTable V3 threadCpuTimeNs
+                # metadata); same transport thread runs both phases, so
+                # thread_time spans launch + fetch
+                merged.stats.thread_cpu_time_ns = \
+                    _time.thread_time_ns() - t_cpu
+                if acct:
+                    merged.stats.scheduler_wait_ms = acct.get(
+                        "scheduler_wait_ms", 0.0)
+                self.queries_served += 1
+                if tracer is not None:
+                    # encode itself can't appear in the trace: the spans
+                    # are serialized INTO the payload encode produces
+                    merged.trace = tracer.to_json()
+                return encode(merged)
             finally:
-                if tdm is not None:
-                    tdm.release(acquired)
-            with span("server.trim"):
-                merged = trim_group_by(q, merged, self.group_trim_size)
-            # per-query resource accounting shipped in the partial's stats
-            # (the reference's DataTable V3 threadCpuTimeNs metadata)
-            merged.stats.thread_cpu_time_ns = _time.thread_time_ns() - t_cpu
-            if acct:
-                merged.stats.scheduler_wait_ms = acct.get(
-                    "scheduler_wait_ms", 0.0)
-            self.queries_served += 1
-            if tracer is not None:
-                # encode itself can't appear in the trace: the spans are
-                # serialized INTO the payload encode produces
-                merged.trace = tracer.to_json()
-            return encode(merged)
-        finally:
-            if tracer is not None:
-                trace.end_trace()
-            timer.__exit__()
+                cleanup()
+
+        return finish
 
     # ---- streaming query path (GrpcQueryServer streaming Submit) ---------
     def _handle_submit_streaming(self, request: bytes):
@@ -280,9 +359,10 @@ class ServerInstance:
         req = parse_instance_request(request)
         try:
             # count at receive time, pre-compile — same invariant as the
-            # unary path: queryErrors <= queries even on parse errors
+            # unary path: queryErrors <= queries even on parse errors;
+            # compile rides the same pre-admission semaphore bound
             self.metrics.count("queries")
-            q = optimize_query(compile_query(req["sql"]))
+            q = self._compile_admitted(req["sql"])
             yield from self.scheduler.run(
                 lambda: self._stream_blocks(req, q),
                 queue_timeout_s=self._request_timeout_s(q),

@@ -184,6 +184,82 @@ def test_empty_groups_and_no_match(segments):
             or val == "null", val
 
 
+def test_long_beyond_2p53_exact_on_host(tmp_path):
+    """ADVICE r5: LONG values with |v| > 2^53 must survive the host path
+    EXACTLY (the old float64 state rounded them); the winning value, its
+    wire round trip, and the broker merge all carry the native long. The
+    device path's value plane stays float64 (documented in PARITY.md)."""
+    schema = Schema.build(
+        name="big", dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("ts", DataType.LONG)])
+    base = (1 << 53) + 1  # first integer float64 cannot represent
+    df = pd.DataFrame({
+        "k": ["a", "a", "a", "b", "b"],
+        "v": np.array([base, base + 2, 7, -base - 4, 11], dtype=np.int64),
+        "ts": np.array([5, 9, 1, 3, 2], dtype=np.int64),
+    })
+    segs = [build_segment(
+        schema, {c: df.iloc[i::2][c].to_numpy() for c in df},
+        str(tmp_path / f"s{i}"), segment_name=f"s{i}") for i in range(2)]
+    eng = QueryEngine(device_executor=None)
+    for s in segs:
+        eng.add_segment("big", s)
+    r = eng.execute("SELECT k, LASTWITHTIME(v, ts, 'LONG'), "
+                    "FIRSTWITHTIME(v, ts, 'LONG') FROM big "
+                    "GROUP BY k ORDER BY k")
+    assert not r.get("exceptions"), r
+    # a: last ts=9 -> base+2 (float64 would render base+2 as base+2±1);
+    #    first ts=1 -> 7. b: last ts=3 -> -base-4; first ts=2 -> 11.
+    assert r["resultTable"]["rows"] == [
+        ["a", base + 2, 7], ["b", -base - 4, 11]]
+    # the multi-segment merge above already crossed scatter_merge; now the
+    # wire: a server partial's exact int plane survives encode/decode
+    from pinot_tpu.sql.compiler import compile_query
+
+    q = compile_query("SELECT k, LASTWITHTIME(v, ts, 'LONG') FROM big "
+                      "GROUP BY k")
+    res = eng.execute_segments(q, list(eng.tables["big"].segments.values()))
+    back = decode(encode(res))
+    assert list(back.agg_partials[0]["val"]) == list(res.agg_partials[0]["val"])
+    assert (base + 2) in list(back.agg_partials[0]["val"])
+
+
+def test_mixed_host_device_partial_wire_roundtrip(tmp_path):
+    """A server hosting BOTH device-eligible and host-path segments merges
+    a device float64 FirstLast partial into the host exact-int object
+    accumulator; the mixed plane must survive the DataTable wire (typed
+    exact_scalar flags) and render correctly end to end."""
+    schema = Schema.build(
+        name="mx", dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG), ("ts", DataType.LONG)])
+    df = pd.DataFrame({
+        "k": ["a", "a", "b", "b"],
+        "v": np.array([3, 9, 20, 11], dtype=np.int64),
+        "ts": np.array([1, 6, 2, 8], dtype=np.int64),
+    })
+    dev_seg = build_segment(schema, {c: df.iloc[:2][c].to_numpy() for c in df},
+                            str(tmp_path / "dev"), segment_name="dev")
+    host_seg = build_segment(schema, {c: df.iloc[2:][c].to_numpy() for c in df},
+                             str(tmp_path / "host"), segment_name="host")
+    # an upsert-style validDocIds mask forces the host scan path
+    host_seg.valid_docs_mask = np.ones(host_seg.n_docs, dtype=bool)
+    eng = QueryEngine()
+    eng.add_segment("mx", dev_seg)
+    eng.add_segment("mx", host_seg)
+    from pinot_tpu.sql.compiler import compile_query
+
+    q = compile_query("SELECT k, LASTWITHTIME(v, ts, 'LONG') FROM mx "
+                      "GROUP BY k")
+    res = eng.execute_segments(q, list(eng.tables["mx"].segments.values()))
+    back = decode(encode(res))  # must not raise, must not drift types
+    assert [float(x) for x in back.agg_partials[0]["val"]] == \
+        [float(x) for x in res.agg_partials[0]["val"]]
+    r = eng.execute("SELECT k, LASTWITHTIME(v, ts, 'LONG') FROM mx "
+                    "GROUP BY k ORDER BY k")
+    assert not r.get("exceptions"), r
+    assert r["resultTable"]["rows"] == [["a", 9], ["b", 11]]
+
+
 def test_nan_values_lose_ties(tmp_path):
     """NaN values never win the tie-break on ANY backend (review finding:
     XLA max propagates NaN; the kernels mask it out)."""
