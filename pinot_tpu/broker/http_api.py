@@ -8,22 +8,37 @@ the BrokerResponse JSON. /health mirrors the reference's health resource.
 Auth (BasicAuthAccessControlFactory analog): pass ``users`` as
 {username: password} to require HTTP Basic credentials on the query
 endpoints; /health stays open like the reference's health resource.
+``acls`` ({username: [table, ...]}) adds per-principal TABLE access
+control (principals.<user>.tables= in config form): a query against a
+table outside the principal's list answers 403 BEFORE any execution —
+the reference's AccessControl.hasAccess check in
+BaseBrokerRequestHandler.
 """
 
 from __future__ import annotations
 
-import base64
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from pinot_tpu.common.auth import BasicAuthAccessControl
+
 
 class BrokerHttpServer:
     def __init__(self, broker, host: str = "127.0.0.1", port: int = 0,
-                 users: Optional[dict] = None, tls="auto"):
+                 users: Optional[dict] = None, tls="auto",
+                 acls: Optional[dict] = None,
+                 access_control: Optional[BasicAuthAccessControl] = None):
         self.broker = broker
-        self._users = dict(users) if users else None
+        if access_control is None and users:
+            access_control = BasicAuthAccessControl(users, acls)
+        elif access_control is None and acls:
+            # ACLs without credentials cannot be enforced — constructing an
+            # open endpoint the operator believes is table-restricted is
+            # the one wrong answer
+            raise ValueError("table acls require users (or access_control)")
+        self._access = access_control
         if tls == "auto":
             from pinot_tpu.common.tls import TlsConfig
 
@@ -49,8 +64,17 @@ class BrokerHttpServer:
                     return
                 # everything beyond /health requires credentials when auth
                 # is enabled (metrics leak query/table statistics)
-                if not self._authorized():
+                principal = self._authorized()
+                if principal is None:
                     self._reject_unauthorized()
+                    return
+                if outer._access is not None and \
+                        outer._access.is_restricted(principal):
+                    # metrics aggregate across ALL tables: a principal with
+                    # a table grant list must not read them
+                    self._send(403, {"error": "Permission denied: metrics "
+                                              "span tables outside this "
+                                              "principal's grants"})
                     return
                 if self.path == "/metrics":
                     from pinot_tpu.common.metrics import all_snapshots
@@ -69,26 +93,13 @@ class BrokerHttpServer:
                 else:
                     self._send(404, {"error": "not found"})
 
-            def _authorized(self) -> bool:
-                if outer._users is None:
-                    return True
-                header = self.headers.get("Authorization", "")
-                if header.startswith("Basic "):
-                    try:
-                        raw = base64.b64decode(header[6:]).decode("utf-8")
-                        user, _, pw = raw.partition(":")
-                    except Exception:  # noqa: BLE001 — malformed header
-                        return False
-                    import hmac
-
-                    # bytes-compare (str compare_digest rejects non-ASCII)
-                    # against a dummy for unknown users so timing doesn't
-                    # enumerate usernames
-                    expected = outer._users.get(user)
-                    known = expected is not None
-                    ref = (expected if known else "\x00dummy").encode("utf-8")
-                    return hmac.compare_digest(pw.encode("utf-8"), ref) and known
-                return False
+            def _authorized(self):
+                """Principal name, "" when auth is disabled, None when
+                rejected."""
+                if outer._access is None:
+                    return ""
+                return outer._access.authenticate(
+                    self.headers.get("Authorization"))
 
             def _reject_unauthorized(self) -> None:
                 self.send_response(401)
@@ -100,13 +111,24 @@ class BrokerHttpServer:
                 if self.path not in ("/query/sql", "/query"):
                     self._send(404, {"error": "not found"})
                     return
-                if not self._authorized():
+                principal = self._authorized()
+                if principal is None:
                     self._reject_unauthorized()
                     return
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     sql = payload.get("sql", "")
+                    denied = outer._denied_table(principal, sql)
+                    if denied is not None:
+                        # per-principal table ACL: reject BEFORE execution
+                        # (BaseBrokerRequestHandler access-control ordering)
+                        self._send(403, {"exceptions": [{
+                            "errorCode": 403,
+                            "message": f"Permission denied on table "
+                                       f"{denied!r} for principal "
+                                       f"{principal!r}"}]})
+                        return
                     self._send(200, outer.broker.execute(sql))
                 except Exception as e:  # noqa: BLE001
                     self._send(
@@ -131,6 +153,23 @@ class BrokerHttpServer:
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="broker-http", daemon=True
         )
+
+    def _denied_table(self, principal: str, sql: str):
+        """Table the principal may NOT query, or None when allowed.
+        Unparseable SQL passes through — the broker's own compile error
+        answers it in-band (no information leak: the table name in a
+        broken query never resolves)."""
+        if self._access is None or not self._access.restricts_tables:
+            return None  # pure-auth setup: skip the extra SQL compile
+        try:
+            from pinot_tpu.sql.compiler import compile_query
+
+            table = compile_query(sql).table_name
+        except Exception:  # noqa: BLE001 — broker reports the parse error
+            return None
+        if table and not self._access.allows(principal, table):
+            return table
+        return None
 
     def start(self) -> None:
         self._thread.start()

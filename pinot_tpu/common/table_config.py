@@ -120,6 +120,25 @@ class StreamConfig:
 
 
 @dataclasses.dataclass
+class ChunkletConfig:
+    """Consuming-segment chunklet promotion (realtime/chunklet.py): the
+    frozen prefix of a consuming segment is sealed into immutable
+    device-eligible blocks while only the unfrozen row tail stays on the
+    host scan path.
+
+    ``device_min_rows`` is the freshness/latency crossover knob: below it
+    the whole consuming segment runs on the host (promotion overhead would
+    dominate); above it, sealed chunklets query at device speed and only
+    the tail pays host-scan latency. Lower it for query latency on large
+    consuming segments, raise it (or disable) for pure-ingest tables."""
+
+    enabled: bool = True
+    rows_per_chunklet: int = 65_536
+    # frozen rows required before chunklets route to the device path
+    device_min_rows: int = 262_144
+
+
+@dataclasses.dataclass
 class TableConfig:
     table_name: str  # raw name, no type suffix
     table_type: str = TableType.OFFLINE
@@ -137,6 +156,8 @@ class TableConfig:
     ingestion: IngestionConfig = dataclasses.field(
         default_factory=IngestionConfig)
     stream: Optional[StreamConfig] = None
+    chunklets: ChunkletConfig = dataclasses.field(
+        default_factory=ChunkletConfig)
     # Minion task configs keyed by task type (TableTaskConfig analog), e.g.
     # {"MergeRollupTask": {"max_docs_per_segment": 1_000_000}}
     task_configs: dict = dataclasses.field(default_factory=dict)
@@ -198,4 +219,6 @@ class TableConfig:
             obj["ingestion"] = IngestionConfig(**ing)
         if obj.get("stream") is not None and isinstance(obj["stream"], dict):
             obj["stream"] = StreamConfig(**obj["stream"])
+        if "chunklets" in obj and isinstance(obj["chunklets"], dict):
+            obj["chunklets"] = ChunkletConfig(**obj["chunklets"])
         return cls(**obj)

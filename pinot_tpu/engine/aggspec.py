@@ -18,6 +18,8 @@ hold set/list-valued states (distinct sets, percentile value lists).
 
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from pinot_tpu.ops import hll as hll_ops
@@ -448,11 +450,22 @@ class PercentileSpec(AggSpec):
 
 
 class DistinctCountThetaSketchSpec(AggSpec):
-    """DISTINCTCOUNTTHETASKETCH(col[, nominalEntries]) — mergeable KMV
-    theta sketch (ops/theta.py), the role DataSketches' QuickSelect sketch
-    plays in DistinctCountThetaSketchAggregationFunction.java. The
-    reference's optional filter-expression arguments (sketch set algebra)
-    are not modeled. State per group: theta + <=k retained hashes."""
+    """DISTINCTCOUNTTHETASKETCH — mergeable KMV theta sketch
+    (ops/theta.py), the role DataSketches' QuickSelect sketch plays in
+    DistinctCountThetaSketchAggregationFunction.java. Two forms:
+
+    - ``(col[, nominalEntries])``: one sketch per group; state is theta +
+      <=k retained hashes.
+    - ``(col, 'nominalEntries=K', filterExpr..., 'SET_INTERSECT($1,$2)')``
+      — the reference's set-operation form: each quoted filter expression
+      builds its OWN sketch over the matching rows ($1 is the first), the
+      quoted LAST argument is a post-merge set expression
+      (SET_INTERSECT / SET_UNION / SET_DIFF, nestable) evaluated at
+      finalize. Filters evaluate per row through the engine's own
+      expression registry, so any boolean-valued expression works. State
+      per group: one (theta, hashes) pair per filter, keyed theta{i} /
+      hashes{i} — each key is a wire-supported flat state, so partials
+      ship over the DataTable like the single-sketch form."""
 
     name = "distinctcountthetasketch"
 
@@ -461,58 +474,164 @@ class DistinctCountThetaSketchSpec(AggSpec):
 
         super().__init__(expr)
         self.k = theta_ops.DEFAULT_NOMINAL
-        if len(expr.args) >= 2 and expr.args[1].is_literal:
-            self.k = int(expr.args[1].value)
-        self.args = expr.args[:1]
+        args = expr.args
+        if len(args) >= 2 and args[1].is_literal:
+            v = args[1].value
+            if isinstance(v, str):
+                params_ok = self._parse_params(v)
+                if not params_ok and len(args) >= 4:
+                    # set form: a malformed params string is almost always
+                    # a MISSING params string — treating a filter like
+                    # 'dim = ''a''' as ignorable params would silently
+                    # shift every $N reference one filter over
+                    raise ValueError(
+                        f"DISTINCTCOUNTTHETASKETCH set form: second "
+                        f"argument must be a parameters string like "
+                        f"'nominalEntries=4096' (or ''), got {v!r}")
+            elif v is not None:
+                self.k = int(v)
+        self.filters = []
+        self.set_expr = None
+        if len(args) >= 4:
+            from pinot_tpu.sql.parser import Parser
 
-    def host_groups(self, arg_values, group_idx, n):
+            for a in args[2:-1]:
+                if not (a.is_literal and isinstance(a.value, str)):
+                    raise ValueError(
+                        "theta set form takes quoted filter expressions")
+                self.filters.append(Parser(a.value).parse_expr())
+            last = args[-1]
+            if not (last.is_literal and isinstance(last.value, str)):
+                raise ValueError(
+                    "theta set form needs a quoted set expression last")
+            self.set_expr = theta_ops.parse_set_expression(last.value)
+            if theta_ops.max_ref(self.set_expr) >= len(self.filters):
+                raise ValueError(
+                    f"set expression references ${theta_ops.max_ref(self.set_expr) + 1} "
+                    f"but only {len(self.filters)} filters are given")
+            self.args = [args[0]] + self.filters
+        elif len(args) == 3:
+            # ambiguous: (col, params, X) — X can't be both the required
+            # filter AND the required set expression. Silently ignoring it
+            # would return an UNFILTERED count, so fail loudly.
+            raise ValueError(
+                "DISTINCTCOUNTTHETASKETCH set form needs at least one "
+                "filter expression AND a set expression: "
+                "(col, params, filterExpr..., 'SET_...($1,...)')")
+        else:
+            self.args = args[:1]
+
+    _KNOWN_PARAMS = {"nominalentries", "samplingprobability",
+                     "accumulatorthreshold"}
+
+    def _parse_params(self, s: str) -> bool:
+        """'nominalEntries=4096' style parameter string (';'/',' separated;
+        empty allowed; a bare quoted integer is legacy nominalEntries).
+        Returns False when the content doesn't look like parameters
+        (unknown key, no '=') — the caller decides whether that's
+        tolerable (legacy 2-arg form) or an error (set form)."""
+        if s.strip().isdigit():  # legacy quoted form: ('4096')
+            self.k = int(s)
+            return True
+        ok = True
+        for kv in re.split(r"[;,]", s):
+            if not kv.strip():
+                continue
+            key, eq, val = kv.partition("=")
+            if not eq or key.strip().lower() not in self._KNOWN_PARAMS:
+                ok = False
+                continue
+            if key.strip().lower() == "nominalentries" and val.strip():
+                try:
+                    self.k = int(val)
+                except ValueError:
+                    ok = False
+        return ok
+
+    def _sketch_keys(self):
+        if not self.filters:
+            return [("theta", "hashes")]
+        return [(f"theta{i}", f"hashes{i}") for i in range(len(self.filters))]
+
+    @staticmethod
+    def _build_per_group(v, group_idx, n, k):
         from pinot_tpu.ops import theta as theta_ops
 
-        v = np.asarray(arg_values[0])
         thetas = np.full(n, float(theta_ops.MAX_HASH))
         hashes = _obj_array(n, list)
         if len(v):
             order = np.argsort(group_idx, kind="stable")
             gs = np.asarray(group_idx)[order]
-            vs = v[order]
+            vs = np.asarray(v)[order]
             bounds = np.flatnonzero(np.diff(gs)) + 1
             starts = np.concatenate([[0], bounds])
             ends = np.concatenate([bounds, [len(gs)]])
             for s, e in zip(starts, ends):
                 g = int(gs[s])
-                th, h = theta_ops.build(vs[s:e], self.k)
+                th, h = theta_ops.build(vs[s:e], k)
                 thetas[g] = float(th)
                 hashes[g] = h.tolist()
-        return {"theta": thetas, "hashes": hashes}
+        return thetas, hashes
+
+    def host_groups(self, arg_values, group_idx, n):
+        v = np.asarray(arg_values[0])
+        gi = np.asarray(group_idx)
+        if not self.filters:
+            thetas, hashes = self._build_per_group(v, gi, n, self.k)
+            return {"theta": thetas, "hashes": hashes}
+        out = {}
+        for i, (tk, hk) in enumerate(self._sketch_keys()):
+            fmask = np.asarray(arg_values[1 + i], dtype=bool)
+            thetas, hashes = self._build_per_group(
+                v[fmask], gi[fmask], n, self.k)
+            out[tk] = thetas
+            out[hk] = hashes
+        return out
 
     def empty(self, n):
         from pinot_tpu.ops import theta as theta_ops
 
-        return {"theta": np.full(n, float(theta_ops.MAX_HASH)),
-                "hashes": _obj_array(n, list)}
+        out = {}
+        for tk, hk in self._sketch_keys():
+            out[tk] = np.full(n, float(theta_ops.MAX_HASH))
+            out[hk] = _obj_array(n, list)
+        return out
 
     def scatter_merge(self, acc, idx, part):
         from pinot_tpu.ops import theta as theta_ops
 
-        for i, g in enumerate(idx):
-            if not len(part["hashes"][i]) \
-                    and part["theta"][i] >= float(theta_ops.MAX_HASH):
-                continue
-            th, h = theta_ops.merge(
-                int(acc["theta"][g]), np.asarray(acc["hashes"][g], np.int64),
-                int(part["theta"][i]), np.asarray(part["hashes"][i], np.int64),
-                self.k,
-            )
-            acc["theta"][g] = float(th)
-            acc["hashes"][g] = h.tolist()
+        for tk, hk in self._sketch_keys():
+            for i, g in enumerate(idx):
+                if not len(part[hk][i]) \
+                        and part[tk][i] >= float(theta_ops.MAX_HASH):
+                    continue
+                th, h = theta_ops.merge(
+                    int(acc[tk][g]), np.asarray(acc[hk][g], np.int64),
+                    int(part[tk][i]), np.asarray(part[hk][i], np.int64),
+                    self.k,
+                )
+                acc[tk][g] = float(th)
+                acc[hk][g] = h.tolist()
 
     def finalize(self, part):
         from pinot_tpu.ops import theta as theta_ops
 
-        return np.array([
-            round(theta_ops.estimate(int(t), h))
-            for t, h in zip(part["theta"], part["hashes"])
-        ], dtype=np.int64)
+        keys = self._sketch_keys()
+        n = len(part[keys[0][0]])
+        if self.set_expr is None:
+            return np.array([
+                round(theta_ops.estimate(int(t), h))
+                for t, h in zip(part["theta"], part["hashes"])
+            ], dtype=np.int64)
+        out = np.empty(n, dtype=np.int64)
+        for g in range(n):
+            sketches = [
+                (int(part[tk][g]), np.asarray(part[hk][g], np.int64))
+                for tk, hk in keys
+            ]
+            th, h = theta_ops.evaluate_set(self.set_expr, sketches, self.k)
+            out[g] = round(theta_ops.estimate(th, h))
+        return out
 
     def result_type(self):
         return "LONG"

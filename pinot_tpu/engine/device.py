@@ -66,7 +66,12 @@ def segment_device_eligible(seg) -> bool:
     """Sealed, non-upsert-masked segments only: consuming (mutable) segments
     and segments with a validDocIds mask execute on the host scan path (the
     one place this rule lives — the engine partitions with it and the
-    executor guards with it)."""
+    executor guards with it). Consuming segments re-enter through their
+    CHUNKLETS (realtime/chunklet.py): the sealed frozen-prefix blocks pass
+    this check (immutable, mask None while clean) and join the batch LRU +
+    in-flight refcounting like any sealed segment — an upsert invalidation
+    inside a block flips its mask non-None, failing this check back to the
+    host path."""
     return not getattr(seg, "is_mutable", False) and \
         getattr(seg, "valid_docs_mask", None) is None
 

@@ -307,57 +307,81 @@ class QueryEngine:
             scan = remaining
         else:
             scan = []
-        device_handle, device_segs, host_results = None, [], []
+        device_handles, host_results = [], []
         if scan:
             # consuming (mutable) and upsert-masked segments run on the host
-            # scan path; sealed immutables go to the device in one batch
+            # scan path; sealed immutables go to the device in one batch.
+            # A consuming segment with PROMOTED CHUNKLETS splits: the clean
+            # frozen-prefix blocks go to the device, the unfrozen row tail
+            # (+ any upsert-dirtied blocks, mask applied) stays on the
+            # host, and the partials merge below like any backend mix
+            # (realtime/chunklet.py). Chunklets launch as their OWN device
+            # batch: promotion changes the chunklet set every 64k rows, and
+            # a combined batch key would evict + re-upload the (stable)
+            # sealed columns on every promotion.
             from pinot_tpu.engine.device import DeviceUnsupported, \
                 segment_device_eligible
+            from pinot_tpu.realtime.chunklet import split_for_query
 
-            device_ok, host_segs = [], []
+            device_sealed, device_chunklets, host_segs = [], [], []
             for s in scan:
-                (device_ok if segment_device_eligible(s) else host_segs).append(s)
-            if self.device is not None and device_ok:
-                # device finalize is safe only when the device batch is the
+                if segment_device_eligible(s):
+                    device_sealed.append(s)
+                    continue
+                split = split_for_query(s) if self.device is not None else None
+                if split is None:
+                    host_segs.append(s)
+                else:
+                    device_chunklets.extend(split[0])
+                    host_segs.extend(split[1])
+            groups = [g for g in (device_sealed, device_chunklets) if g]
+            if self.device is not None and groups:
+                # device finalize is safe only when ONE device batch is the
                 # whole answer: no host segments, no star-tree/metadata
-                # partials to merge with
-                final = terminal and not results and not host_segs
+                # partials, no second batch to merge with
+                final = (terminal and not results and not host_segs
+                         and len(groups) == 1)
                 try:
-                    device_handle = self.device.launch(q, device_ok, final=final)
-                    device_segs = device_ok
+                    for g in groups:
+                        device_handles.append(
+                            (self.device.launch(q, g, final=final), g))
                 except DeviceUnsupported:
-                    device_handle = None
-            if device_handle is None:
+                    for h, _ in device_handles:
+                        h.release()
+                    device_handles = []
+            if not device_handles:
                 host_segs = scan  # launch refused: whole scan on the host
             # host partials execute in the launch phase, overlapping the
-            # dispatched device batch's link round trip; a host failure
-            # must release the in-flight handle or its batch pin leaks
+            # dispatched device batches' link round trip; a host failure
+            # must release the in-flight handles or their batch pins leak
             try:
                 host_results = [self.host.execute_segment(q, s)
                                 for s in host_segs]
             except BaseException:
-                if device_handle is not None:
-                    device_handle.release()
+                for h, _ in device_handles:
+                    h.release()
                 raise
 
         def fetch():
             res = list(results)
-            if device_handle is not None:
+            if device_handles:
                 from pinot_tpu.engine.device import DeviceUnsupported
 
-                try:
-                    res.append(device_handle.fetch())
-                except DeviceUnsupported:
-                    # fetch-time fallback (sorted group-table overflow):
-                    # the device must never shape truncation policy. The
-                    # host re-scan is heavy CPU work — route it through
-                    # the caller's admission gate when one is provided
-                    def _host_rerun():
-                        return [self.host.execute_segment(q, s)
-                                for s in device_segs]
+                for handle, segs_of_handle in device_handles:
+                    try:
+                        res.append(handle.fetch())
+                    except DeviceUnsupported:
+                        # fetch-time fallback (sorted group-table
+                        # overflow): the device must never shape
+                        # truncation policy. The host re-scan is heavy
+                        # CPU work — route it through the caller's
+                        # admission gate when one is provided
+                        def _host_rerun(_segs=segs_of_handle):
+                            return [self.host.execute_segment(q, s)
+                                    for s in _segs]
 
-                    res.extend(_host_rerun() if fallback_gate is None
-                               else fallback_gate(_host_rerun))
+                        res.extend(_host_rerun() if fallback_gate is None
+                                   else fallback_gate(_host_rerun))
             res.extend(host_results)
             ran = executed
             if not res:

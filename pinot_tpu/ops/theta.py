@@ -15,6 +15,8 @@ and (future) device builders agree bit-for-bit.
 
 from __future__ import annotations
 
+import re
+
 import numpy as np
 
 from pinot_tpu.ops.hll import hash32_np
@@ -73,3 +75,109 @@ def estimate(theta: int, hashes) -> float:
     if theta >= int(MAX_HASH):
         return float(n)  # exact mode: never trimmed
     return n / (theta / float(MAX_HASH))
+
+
+# ---------------------------------------------------------------------------
+# set algebra (the reference's Intersection / AnotB / Union post-aggregation
+# over theta sketches: DistinctCountThetaSketchAggregationFunction's
+# filtered-sketch + set-expression form)
+# ---------------------------------------------------------------------------
+
+
+def intersect(theta_a: int, ha: np.ndarray, theta_b: int, hb: np.ndarray) -> tuple:
+    """Sketch intersection (DataSketches Intersection semantics): both
+    sides are uniform samples below their thetas, so the common hashes
+    below min(theta) are a uniform sample of the value intersection."""
+    theta = min(int(theta_a), int(theta_b))
+    common = np.intersect1d(np.asarray(ha, dtype=np.int64),
+                            np.asarray(hb, dtype=np.int64))
+    return theta, common[common < theta]
+
+
+def a_not_b(theta_a: int, ha: np.ndarray, theta_b: int, hb: np.ndarray) -> tuple:
+    """Sketch difference (DataSketches AnotB semantics)."""
+    theta = min(int(theta_a), int(theta_b))
+    ha = np.asarray(ha, dtype=np.int64)
+    keep = ha[ha < theta]
+    return theta, np.setdiff1d(keep, np.asarray(hb, dtype=np.int64))
+
+
+_SET_TOKEN = re.compile(
+    r"\s*(SET_INTERSECT|SET_UNION|SET_DIFF|\$\d+|[(),])", re.IGNORECASE)
+
+
+def parse_set_expression(s: str):
+    """'SET_INTERSECT($1, $2)' → nested AST of ('ref', i) leaves and
+    ('SET_INTERSECT'|'SET_UNION'|'SET_DIFF', child...) nodes. $1 is the
+    FIRST filtered sketch (reference numbering)."""
+    toks, pos = [], 0
+    while pos < len(s):
+        if s[pos:].strip() == "":
+            break
+        m = _SET_TOKEN.match(s, pos)
+        if m is None:
+            raise ValueError(f"bad theta set expression at {pos}: {s!r}")
+        toks.append(m.group(1))
+        pos = m.end()
+
+    def parse(i):
+        if i >= len(toks):
+            raise ValueError(f"truncated theta set expression: {s!r}")
+        t = toks[i]
+        if t.startswith("$"):
+            ref = int(t[1:])
+            if ref < 1:
+                raise ValueError(f"sketch refs are 1-based: {t}")
+            return ("ref", ref - 1), i + 1
+        op = t.upper()
+        if op not in ("SET_INTERSECT", "SET_UNION", "SET_DIFF"):
+            raise ValueError(f"unknown theta set operator {t!r}")
+        if i + 1 >= len(toks) or toks[i + 1] != "(":
+            raise ValueError(f"{op} needs parenthesized args: {s!r}")
+        args, i = [], i + 2
+        while True:
+            node, i = parse(i)
+            args.append(node)
+            if i >= len(toks):
+                raise ValueError(f"unclosed {op} in {s!r}")
+            if toks[i] == ",":
+                i += 1
+                continue
+            if toks[i] == ")":
+                i += 1
+                break
+            raise ValueError(f"bad token {toks[i]!r} in {s!r}")
+        if len(args) < 2:
+            raise ValueError(f"{op} needs at least two args: {s!r}")
+        if op == "SET_DIFF" and len(args) != 2:
+            raise ValueError(f"SET_DIFF is binary: {s!r}")
+        return (op,) + tuple(args), i
+
+    node, i = parse(0)
+    if i != len(toks):
+        raise ValueError(f"trailing tokens in theta set expression: {s!r}")
+    return node
+
+
+def max_ref(node) -> int:
+    """Highest 0-based sketch index referenced by a parsed set AST."""
+    if node[0] == "ref":
+        return node[1]
+    return max(max_ref(c) for c in node[1:])
+
+
+def evaluate_set(node, sketches: list, k: int) -> tuple:
+    """Parsed AST + [(theta, hashes)] per filter → (theta, hashes)."""
+    op = node[0]
+    if op == "ref":
+        return sketches[node[1]]
+    parts = [evaluate_set(c, sketches, k) for c in node[1:]]
+    th, h = parts[0]
+    for th2, h2 in parts[1:]:
+        if op == "SET_UNION":
+            th, h = merge(th, h, th2, h2, k)
+        elif op == "SET_INTERSECT":
+            th, h = intersect(th, h, th2, h2)
+        else:
+            th, h = a_not_b(th, h, th2, h2)
+    return th, h

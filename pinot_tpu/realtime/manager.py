@@ -213,27 +213,33 @@ class RealtimePartitionManager:
                         pass
                     consumer = self.factory.create_partition_consumer(self.partition)
                     continue
-                for msg in batch.messages:
-                    # poison messages must not wedge the partition: skip and
-                    # count (the reference skips undecodable rows the same
-                    # way); the offset still advances past them. Transform
-                    # failures are CONFIG bugs, not bad data — those kill
-                    # the partition loudly (ERROR state) instead of
-                    # silently draining the stream
-                    try:
-                        row = self.decoder(msg.payload)
-                        self._index_row(row, msg)
-                    except TransformError:
-                        raise
-                    except Exception as e:  # noqa: BLE001
-                        self.index_errors += 1
-                        if self.index_errors <= 10 or self.index_errors % 1000 == 0:
-                            log.warning(
-                                "partition %s: dropping bad message at %s: %s",
-                                self.partition, msg.offset, e,
-                            )
+                if self.upsert is None:
+                    # columnar batch path (chunklet subsystem ingest basis):
+                    # decode + transform per row, ONE index_batch per fetch
+                    self._index_message_batch(batch.messages)
+                else:
+                    # upsert: the primary-key CAS is inherently per-row
+                    for msg in batch.messages:
+                        # poison messages must not wedge the partition: skip
+                        # and count (the reference skips undecodable rows
+                        # the same way); the offset still advances past
+                        # them. Transform failures are CONFIG bugs, not bad
+                        # data — those kill the partition loudly (ERROR
+                        # state) instead of silently draining the stream
+                        try:
+                            row = self.decoder(msg.payload)
+                            self._index_row(row, msg)
+                        except TransformError:
+                            raise
+                        except Exception as e:  # noqa: BLE001
+                            self._note_bad_message(msg, e)
                 if len(batch) > 0:
                     self._offset = batch.next_offset
+                    ci = self.segment.chunklet_index
+                    if ci is not None:
+                        # incremental seal: promote every full frozen block
+                        # so queries ride the device path while consuming
+                        ci.promote()
                 else:
                     time.sleep(self.idle_sleep_s)
                 if self._should_flush():
@@ -246,6 +252,44 @@ class RealtimePartitionManager:
             log.exception("partition %s consume loop died", self.partition)
         finally:
             consumer.close()
+
+    def _note_bad_message(self, msg, e) -> None:
+        self.index_errors += 1
+        if self.index_errors <= 10 or self.index_errors % 1000 == 0:
+            log.warning(
+                "partition %s: dropping bad message at %s: %s",
+                self.partition, getattr(msg, "offset", "?"), e,
+            )
+
+    def _index_message_batch(self, messages) -> None:
+        """Non-upsert fetch handling: decode + transform row by row (poison
+        rows skip, TransformError still kills the partition), then index
+        the survivors through ONE columnar index_batch. A batch-level
+        failure falls back to row-at-a-time so a single bad row is counted
+        alone instead of dropping its whole fetch."""
+        rows = []
+        for msg in messages:
+            try:
+                row = self.decoder(msg.payload)
+                if self.record_transformer.active:
+                    row = self.record_transformer.apply_row(row)
+                    if row is None:
+                        continue  # filter_function dropped the record
+                rows.append(row)
+            except TransformError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                self._note_bad_message(msg, e)
+        if not rows:
+            return
+        try:
+            self.segment.index_batch(rows)
+        except Exception:  # noqa: BLE001 — isolate the poison row
+            for row in rows:
+                try:
+                    self.segment.index(row)
+                except Exception as e:  # noqa: BLE001
+                    self._note_bad_message(None, e)
 
     def _index_row(self, row: dict, msg) -> None:
         if self.record_transformer.active:

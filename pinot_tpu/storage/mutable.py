@@ -61,19 +61,23 @@ class MutableColumn:
         if self.max_value is None or v > self.max_value:
             self.max_value = v
 
+    def _mv_row(self, value) -> np.ndarray:
+        dt = self.spec.data_type
+        entries = value if isinstance(value, (list, tuple, np.ndarray)) \
+            else [value]
+        if dt.is_string_like:
+            return np.asarray([str(v) for v in entries], dtype=np.str_)
+        return np.asarray([dt.convert(v) for v in entries], dtype=dt.np_dtype)
+
+    def _append_mv_row(self, row: np.ndarray) -> None:
+        self._rows.append(row)
+        self.total_entries += len(row)
+        for v in row.tolist():
+            self._track(v)
+
     def append(self, value, row_idx: int) -> None:
         if not self.single_value:
-            dt = self.spec.data_type
-            entries = value if isinstance(value, (list, tuple, np.ndarray)) \
-                else [value]
-            if dt.is_string_like:
-                row = np.asarray([str(v) for v in entries], dtype=np.str_)
-            else:
-                row = np.asarray([dt.convert(v) for v in entries], dtype=dt.np_dtype)
-            self._rows.append(row)
-            self.total_entries += len(row)
-            for v in row.tolist():
-                self._track(v)
+            self._append_mv_row(self._mv_row(value))
             return
         self._grow(row_idx)
         if self.dict_encoded:
@@ -89,20 +93,115 @@ class MutableColumn:
             self._data[row_idx] = v
         self._track(v)
 
+    # ---- columnar batch path (chunklet subsystem ingest basis) -----------
+    def prepare_batch(self, vals: list):
+        """Stage a batch WITHOUT mutating column state: all conversion and
+        validation (the failure-prone part) happens here, so one bad row
+        can never leave partial appends behind — ``commit_batch`` only
+        publishes already-validated arrays."""
+        try:  # C-level membership scan; nulls are the rare case
+            has_null = None in vals
+        except ValueError:
+            # `in` compares elementwise against ndarray payloads (MV rows);
+            # fall back to the identity scan the row path implies
+            has_null = any(v is None for v in vals)
+        if has_null:
+            null_rows = [i for i, v in enumerate(vals) if v is None]
+            vals = list(vals)
+            fill = [] if not self.single_value else self.spec.null_value()
+            for i in null_rows:
+                vals[i] = fill
+        else:
+            null_rows = ()
+        if not self.single_value:
+            return ("mv", null_rows, [self._mv_row(v) for v in vals])
+        dt = self.spec.data_type
+        if self.dict_encoded:
+            # vectorized dictionary growth: one np.unique over the batch,
+            # then ONE dict probe per distinct value instead of per row.
+            # Strings sort as a native U array (faster comparator); BYTES
+            # stay object-typed — an 'S' array would strip trailing NULs.
+            if dt is DataType.BYTES:
+                arr = np.asarray([bytes(v) for v in vals], dtype=object)
+            else:
+                arr = np.asarray(vals)
+                if arr.dtype.kind != "U":  # non-str payloads: coerce per value
+                    arr = np.asarray([str(v) for v in vals])
+            uniq, inv = np.unique(arr, return_inverse=True)
+            return ("dict", null_rows, uniq, inv.astype(np.int32))
+        try:
+            arr = np.asarray(vals, dtype=dt.np_dtype)
+        except (TypeError, ValueError):
+            # heterogenous payloads (e.g. numeric strings): per-value coerce
+            arr = np.asarray([dt.convert(v) for v in vals], dtype=dt.np_dtype)
+        return ("raw", null_rows, arr)
+
+    def commit_batch(self, staged, row0: int) -> None:
+        """Publish a staged batch at doc ids [row0, row0+n)."""
+        kind = staged[0]
+        for i in staged[1]:
+            self.null_docs.append(row0 + i)
+        if kind == "mv":
+            for row in staged[2]:
+                self._append_mv_row(row)
+            return
+        if kind == "dict":
+            _, _, uniq, inv = staged
+            n = len(inv)
+            if n == 0:
+                return
+            self._grow(row0 + n - 1)
+            uvals = uniq.tolist()  # python values, like the row path stores
+            ids = np.empty(len(uvals), dtype=np.int32)
+            for j, v in enumerate(uvals):
+                did = self._dict.get(v)
+                if did is None:
+                    did = len(self._dict_values)
+                    self._dict[v] = did
+                    self._dict_values.append(v)
+                ids[j] = did
+            self._data[row0:row0 + n] = ids[inv]
+            # uniq is sorted: batch min/max are its ends
+            self._track(uvals[0])
+            self._track(uvals[-1])
+            return
+        arr = staged[2]
+        n = len(arr)
+        if n == 0:
+            return
+        self._grow(row0 + n - 1)
+        self._data[row0:row0 + n] = arr
+        self._track(arr.min().item())
+        self._track(arr.max().item())
+
+    def dict_table(self) -> np.ndarray:
+        """Snapshot of the insertion-ordered dictionary values as an array
+        (the dict list only appends, so a slice-copy is a safe snapshot).
+        BYTES values stay object-typed — an 'S' array would strip trailing
+        NUL bytes on the way through."""
+        vals = self._dict_values[:]
+        if vals and isinstance(vals[0], bytes):
+            return np.asarray(vals, dtype=object)
+        return np.asarray(vals)
+
     def values(self, n: int) -> np.ndarray:
         """Decoded raw values for the first n docs (reader snapshot); MV
         columns return an object array of per-row arrays."""
+        return self.values_range(0, n)
+
+    def values_range(self, start: int, stop: int) -> np.ndarray:
+        """Decoded raw values for docs [start, stop) — the tail-view form:
+        decoding a 64k-row tail must not pay a full-segment dictionary
+        take (realtime/chunklet.py MutableTailView)."""
         if not self.single_value:
-            out = np.empty(n, dtype=object)
-            rows = self._rows  # grow-only list: indexes < n are stable
-            for i in range(n):
-                out[i] = rows[i]
+            out = np.empty(stop - start, dtype=object)
+            rows = self._rows  # grow-only list: indexes < stop are stable
+            for i in range(start, stop):
+                out[i - start] = rows[i]
             return out
         if self.dict_encoded:
-            # snapshot the dict list first: it only appends
-            table = np.asarray(self._dict_values[:])
-            return table[self._data[:n]]
-        return self._data[:n]
+            return self.dict_table()[self._data[start:stop]]
+        return self._data[start:stop]
 
     @property
     def cardinality(self) -> int:
@@ -135,6 +234,18 @@ class MutableSegment:
         self._valid = np.ones(_INITIAL_CAPACITY, dtype=bool) if enable_upsert else None
         self.start_offset = None
         self.end_offset = None
+        # chunklet subsystem (realtime/chunklet.py): frozen-prefix promotion
+        # into sealed device-eligible blocks. Created eagerly from config so
+        # the consume loop / engine never check config themselves; MV
+        # columns keep the whole segment on the host scan path (the device
+        # batch layer rejects MV consuming data anyway).
+        self.chunklet_index = None
+        ck_cfg = getattr(self.table_config, "chunklets", None)
+        if ck_cfg is not None and ck_cfg.enabled and all(
+                schema.field(n).single_value for n in schema.column_names()):
+            from pinot_tpu.realtime.chunklet import ChunkletIndex
+
+            self.chunklet_index = ChunkletIndex(self, ck_cfg)
 
     # ---- write path ------------------------------------------------------
     def index(self, row: dict) -> int:
@@ -158,11 +269,43 @@ class MutableSegment:
             self._count = doc_id + 1  # publish: readers never see doc_id
             return doc_id
 
+    def index_batch(self, rows) -> int:
+        """Columnar batch indexing (the chunklet subsystem's ingest basis):
+        one vectorized append per column instead of n per-row dict walks.
+        Conversion is staged for EVERY column before any state mutates, so
+        a bad row fails the whole batch atomically — callers fall back to
+        row-at-a-time ``index`` to isolate poison rows. Returns the first
+        doc id of the batch. Upsert tables keep the per-row path (the
+        primary-key CAS is inherently row-at-a-time)."""
+        rows = rows if isinstance(rows, list) else list(rows)
+        with self._lock:
+            row0 = self._count
+            n = len(rows)
+            if n == 0:
+                return row0
+            staged = {
+                name: col.prepare_batch([r.get(name) for r in rows])
+                for name, col in self._cols.items()
+            }
+            for name, col in self._cols.items():
+                col.commit_batch(staged[name], row0)
+            if self._valid is not None:
+                while row0 + n > len(self._valid):
+                    new = np.ones(len(self._valid) * 2, dtype=bool)
+                    new[: len(self._valid)] = self._valid
+                    self._valid = new
+            self._count = row0 + n  # publish the whole batch at once
+            return row0
+
     def invalidate(self, doc_id: int) -> None:
         """Upsert: flip this doc out of validDocIds
         (ThreadSafeMutableRoaringBitmap analog)."""
         if self._valid is not None:
             self._valid[doc_id] = False
+            if self.chunklet_index is not None:
+                # a promoted chunklet covering this doc can no longer run
+                # unmasked on the device path
+                self.chunklet_index.note_invalidated(doc_id)
 
     # ---- reader protocol (host executor duck type) -----------------------
     @property
@@ -254,7 +397,15 @@ class MutableSegment:
         from pinot_tpu.storage.segment import ImmutableSegment
 
         n = self._count
-        columns = {name: self._cols[name].values(n) for name in self._cols}
+        ci = self.chunklet_index
+        if ci is not None and ci.chunklets:
+            # reuse the already-sealed chunklet column blocks for the frozen
+            # prefix: only the unfrozen tail decodes through the insertion-
+            # ordered dictionary here
+            columns = {name: ci.column_with_tail(name, n)
+                       for name in self._cols}
+        else:
+            columns = {name: self._cols[name].values(n) for name in self._cols}
         null_masks = {}
         for name in self._cols:
             nv = self.null_vector(name)

@@ -93,6 +93,17 @@ class MemoryPartitionConsumer(PartitionGroupConsumer):
         ]
         return MessageBatch(messages, StreamPartitionMsgOffset(start + len(payloads)))
 
+    def fetch_payload_batch(self, start_offset: StreamPartitionMsgOffset,
+                            max_count: int):
+        """Columnar-ingest fast path (realtime/chunklet.py): raw payloads +
+        next offset, skipping per-message StreamMessage/offset object
+        construction (~2.5us/message — above the whole columnar index cost
+        per row). Optional SPI surface: consumers without it fall back to
+        fetch_messages."""
+        start = start_offset.value
+        payloads = self._topic.read(self._partition, start, max_count)
+        return payloads, StreamPartitionMsgOffset(start + len(payloads))
+
 
 class MemoryStreamConsumerFactory(StreamConsumerFactory):
     def partition_count(self) -> int:

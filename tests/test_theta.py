@@ -115,3 +115,96 @@ class TestThetaThroughEngine:
         est = r["resultTable"]["rows"][0][0]
         truth = len(np.unique(us))
         assert abs(est - truth) / truth < 0.05
+
+class TestThetaSetOps:
+    """Set-operation form: filtered per-predicate sketches + post-merge set
+    expression (the reference's DistinctCountThetaSketch filter/postAgg
+    arguments), oracle-checked against exact set counts."""
+
+    def test_set_algebra_primitives(self):
+        rng = np.random.default_rng(11)
+        a = np.unique(rng.integers(0, 50_000, 30_000))
+        b = np.unique(rng.integers(25_000, 75_000, 30_000))
+        k = 4096
+        tha, ha = theta.build(a, k)
+        thb, hb = theta.build(b, k)
+        th, h = theta.intersect(tha, ha, thb, hb)
+        exact = len(np.intersect1d(a, b))
+        assert abs(theta.estimate(th, h) - exact) / exact < 0.1
+        th, h = theta.a_not_b(tha, ha, thb, hb)
+        exact = len(np.setdiff1d(a, b))
+        assert abs(theta.estimate(th, h) - exact) / exact < 0.1
+
+    def test_parse_set_expression(self):
+        ast = theta.parse_set_expression("SET_INTERSECT($1, SET_UNION($2,$3))")
+        assert ast == ("SET_INTERSECT", ("ref", 0),
+                       ("SET_UNION", ("ref", 1), ("ref", 2)))
+        assert theta.max_ref(ast) == 2
+        with pytest.raises(ValueError):
+            theta.parse_set_expression("SET_DIFF($1,$2,$3)")  # binary only
+        with pytest.raises(ValueError):
+            theta.parse_set_expression("SET_FROB($1,$2)")
+
+    def _engine(self, rows):
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="ev",
+            dimensions=[("dim", DataType.STRING), ("uid", DataType.INT)],
+            metrics=[("m", DataType.INT)],
+        )
+        seg = MutableSegment(schema, "s")
+        seg.index_batch(rows)
+        eng = QueryEngine(device_executor=None)
+        eng.table("ev").add_segment(seg)
+        return eng
+
+    def test_sql_set_ops_exact_mode_match_oracle(self):
+        rng = np.random.default_rng(3)
+        rows = []
+        for i in range(20_000):
+            uid = int(rng.integers(0, 5000))
+            dim = "books" if (i % 2 == 0 and uid % 3 == 0) else (
+                "tools" if uid % 5 == 0 else "other")
+            rows.append({"dim": dim, "uid": uid, "m": i % 2})
+        books = {r["uid"] for r in rows if r["dim"] == "books"}
+        tools = {r["uid"] for r in rows if r["dim"] == "tools"}
+        eng = self._engine(rows)
+        # k far above the cardinalities -> exact mode -> exact equality
+        for setex, want in [
+            ("SET_INTERSECT($1,$2)", len(books & tools)),
+            ("SET_UNION($1,$2)", len(books | tools)),
+            ("SET_DIFF($1,$2)", len(books - tools)),
+            ("SET_INTERSECT(SET_UNION($1,$2),$1)", len(books)),
+        ]:
+            sql = ("SELECT DISTINCTCOUNTTHETASKETCH(uid, "
+                   "'nominalEntries=65536', 'dim = ''books''', "
+                   f"'dim = ''tools''', '{setex}') FROM ev")
+            r = eng.execute(sql)
+            assert not r.get("exceptions"), r
+            assert r["resultTable"]["rows"][0][0] == want, (setex, r)
+
+    def test_sql_set_ops_groupby_and_approx(self):
+        rng = np.random.default_rng(4)
+        rows = []
+        for i in range(30_000):
+            uid = int(rng.integers(0, 8000))
+            dim = "books" if uid % 2 == 0 else ("tools" if uid % 3 == 0 else "x")
+            rows.append({"dim": dim, "uid": uid, "m": i % 2})
+        eng = self._engine(rows)
+        sql = ("SELECT m, DISTINCTCOUNTTHETASKETCH(uid, 'nominalEntries=1024',"
+               " 'dim = ''books''', 'dim = ''tools''', "
+               "'SET_UNION($1,$2)') FROM ev GROUP BY m ORDER BY m")
+        r = eng.execute(sql)
+        assert not r.get("exceptions"), r
+        for m_val, est in r["resultTable"]["rows"]:
+            exact = len({row["uid"] for row in rows
+                         if row["m"] == m_val and row["dim"] in ("books", "tools")})
+            assert abs(est - exact) / exact < 3 / np.sqrt(1024) + 0.05, (m_val, est, exact)
+
+    def test_bad_ref_rejected(self):
+        eng = self._engine([{"dim": "a", "uid": 1, "m": 0}])
+        r = eng.execute(
+            "SELECT DISTINCTCOUNTTHETASKETCH(uid, '', 'dim = ''a''', "
+            "'SET_INTERSECT($1,$2)') FROM ev")
+        assert r.get("exceptions"), r  # $2 with one filter is an error
