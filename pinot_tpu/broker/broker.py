@@ -366,6 +366,7 @@ class Broker:
         scatter = []  # (instance, physical table, segments, time_filter)
         n_servers = set()
         num_pruned = 0
+        num_pruned_value = 0  # excluded by per-column min/max stats alone
         fully_pruned = []  # fallback: keep one segment so reduce sees a shape
         for physical, time_filter in self._physical_tables(q.table_name):
             routing = self.routing.routing_table(physical)
@@ -375,8 +376,10 @@ class Broker:
             cfg = self.registry.table_config(physical)
             time_col = cfg.time_column if cfg is not None else None
             for inst, segs in routing.items():
-                kept, pruned = prune_segments(q, records, segs, time_col, time_filter)
+                kept, pruned, by_value = prune_segments(
+                    q, records, segs, time_col, time_filter)
                 num_pruned += pruned
+                num_pruned_value += by_value
                 if kept:
                     scatter.append((inst, physical, kept, time_filter))
                     n_servers.add(inst)
@@ -388,6 +391,10 @@ class Broker:
             # result instead of a synthesized one
             inst, phys, segs, tf = fully_pruned[0]
             num_pruned -= len(segs)
+            # the re-queried segment no longer counts as pruned in EITHER
+            # number; the clamp is exact — by-value can only exceed the new
+            # total when the re-added segment itself was value-pruned
+            num_pruned_value = min(num_pruned_value, max(0, num_pruned))
             scatter.append((inst, phys, segs, tf))
             n_servers.add(inst)
         if not scatter:
@@ -493,6 +500,9 @@ class Broker:
                 "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,
                 "numSegmentsQueried": stats.num_segments_queried,
                 "numSegmentsPrunedByBroker": num_pruned,
+                "numSegmentsPrunedByValue": num_pruned_value,
+                "numSegmentsPrunedByServer": stats.num_segments_pruned,
+                "numBlocksPruned": stats.num_blocks_pruned,
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,

@@ -7,10 +7,14 @@ scattering, drop segments whose recorded partition-id set or time range
 provably cannot satisfy the query filter. Pruning is conservative — a segment
 survives unless the filter *provably* excludes every one of its docs.
 
-The evaluation walks the filter tree bottom-up with tri-state semantics
-collapsed to "may match" booleans: AND may-match iff every child may match,
-OR iff any child may match, NOT is always "may match" (the complement of a
-partial exclusion proves nothing about the segment).
+The evaluation walks the filter tree bottom-up over a three-value verdict
+lattice (structural-no-match < stats-no-match < may-match): AND takes the
+minimum, OR the maximum, NOT is always "may match" (the complement of a
+partial exclusion proves nothing about the segment). The middle value
+attributes prunes that ONLY the per-column min/max stats produced
+(numSegmentsPrunedByValue) in a single walk; partition/time/FALSE prunes
+are structural. Value comparisons ride the shared interval algebra
+(common/pruning.py) so broker and server can never drift.
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ from __future__ import annotations
 from typing import Optional
 
 from pinot_tpu.cluster.registry import SegmentRecord
+from pinot_tpu.common.pruning import interval_may_match
 from pinot_tpu.query.context import (
     FilterNode,
     FilterNodeType,
@@ -35,10 +40,29 @@ def _value_in_time_range(v, lo, hi) -> bool:
         return True  # incomparable literal: cannot prune
 
 
-def _predicate_may_match(p: Predicate, rec: SegmentRecord,
-                         time_column: Optional[str]) -> bool:
-    if not p.lhs.is_identifier:
+def _stats_may_match(p: Predicate, rec: SegmentRecord) -> bool:
+    """Per-column min/max pruning on ANY column the record carries stats
+    for — the shared interval algebra (common/pruning.py), so broker and
+    server can never drift on bound or coercion semantics."""
+    stats = (rec.column_stats or {}).get(p.lhs.name)
+    if not stats:
         return True
+    return interval_may_match(p, stats.get("min"), stats.get("max"))
+
+
+# prune verdicts form a lattice: AND takes the minimum, OR the maximum.
+# STATS_NO separates "only the value stats excluded it" (the reference's
+# numSegmentsPrunedByValue breakdown) from structural partition/time/FALSE
+# prunes in ONE tree walk — under AND a structural exclusion wins (the
+# prune would happen without stats), under OR a stats child keeps the
+# whole disjunct attributable to stats.
+_STRUCT_NO, _STATS_NO, _MAY = 0, 1, 2
+
+
+def _predicate_verdict(p: Predicate, rec: SegmentRecord,
+                       time_column: Optional[str]) -> int:
+    if not p.lhs.is_identifier:
+        return _MAY
     col = p.lhs.name
 
     # ---- partition pruning (SinglePartitionColumnSegmentPruner) ----------
@@ -56,10 +80,10 @@ def _predicate_may_match(p: Predicate, rec: SegmentRecord,
         try:
             if p.type is PredicateType.EQ:
                 if pid(p.value) not in pids:
-                    return False
+                    return _STRUCT_NO
             elif p.type is PredicateType.IN and p.values:
                 if all(pid(v) not in pids for v in p.values):
-                    return False
+                    return _STRUCT_NO
         except Exception:  # noqa: BLE001 — unhashable/odd literal: no pruning
             pass
 
@@ -73,35 +97,44 @@ def _predicate_may_match(p: Predicate, rec: SegmentRecord,
         lo, hi = rec.start_time, rec.end_time
         try:
             if p.type is PredicateType.EQ:
-                return _value_in_time_range(p.value, lo, hi)
-            if p.type is PredicateType.IN and p.values:
-                return any(_value_in_time_range(v, lo, hi) for v in p.values)
-            if p.type is PredicateType.RANGE:
+                if not _value_in_time_range(p.value, lo, hi):
+                    return _STRUCT_NO
+            elif p.type is PredicateType.IN and p.values:
+                if not any(_value_in_time_range(v, lo, hi)
+                           for v in p.values):
+                    return _STRUCT_NO
+            elif p.type is PredicateType.RANGE:
                 if p.lower is not None:
                     if p.lower > hi or (p.lower == hi and not p.lower_inclusive):
-                        return False
+                        return _STRUCT_NO
                 if p.upper is not None:
                     if p.upper < lo or (p.upper == lo and not p.upper_inclusive):
-                        return False
+                        return _STRUCT_NO
         except TypeError:
-            return True
-    return True
+            pass  # incomparable: fall through to the stats check
+
+    # ---- per-column value stats (min/max on any column) ------------------
+    if not _stats_may_match(p, rec):
+        return _STATS_NO
+    return _MAY
 
 
-def _filter_may_match(f: FilterNode, rec: SegmentRecord,
-                      time_column: Optional[str]) -> bool:
+def _filter_verdict(f: FilterNode, rec: SegmentRecord,
+                    time_column: Optional[str]) -> int:
     if f.type is FilterNodeType.PREDICATE:
-        return _predicate_may_match(f.predicate, rec, time_column)
+        return _predicate_verdict(f.predicate, rec, time_column)
     if f.type is FilterNodeType.AND:
-        return all(_filter_may_match(c, rec, time_column) for c in f.children)
+        return min((_filter_verdict(c, rec, time_column)
+                    for c in f.children), default=_MAY)
     if f.type is FilterNodeType.OR:
         if not f.children:
-            return True  # degenerate OR: never prune on it
-        return any(_filter_may_match(c, rec, time_column) for c in f.children)
+            return _MAY  # degenerate OR: never prune on it
+        return max(_filter_verdict(c, rec, time_column)
+                   for c in f.children)
     if f.type is FilterNodeType.CONSTANT_FALSE:
-        return False
+        return _STRUCT_NO
     # NOT / CONSTANT_TRUE: conservative
-    return True
+    return _MAY
 
 
 def _hybrid_boundary_filter(time_filter: Optional[dict]) -> Optional[FilterNode]:
@@ -127,8 +160,12 @@ def prune_segments(
     segments: list[str],
     time_column: Optional[str],
     time_filter: Optional[dict] = None,
-) -> tuple[list[str], int]:
-    """Return (surviving segments, pruned count) for one routed instance."""
+) -> tuple[list[str], int, int]:
+    """Return (surviving segments, pruned count, pruned-by-value count) for
+    one routed instance. ``pruned-by-value`` counts the segments only the
+    per-column min/max stats excluded (the reference's
+    numSegmentsPrunedByValue breakdown) — partition/time prunes report in
+    the total alone."""
     filters = []
     if q is not None and q.filter is not None:
         filters.append(q.filter)
@@ -136,11 +173,15 @@ def prune_segments(
     if bf is not None:
         filters.append(bf)
     if not filters:
-        return segments, 0
+        return segments, 0, 0
     tree = filters[0] if len(filters) == 1 else FilterNode.and_(*filters)
     out = []
+    by_value = 0
     for s in segments:
         rec = records.get(s)
-        if rec is None or _filter_may_match(tree, rec, time_column):
+        v = _MAY if rec is None else _filter_verdict(tree, rec, time_column)
+        if v == _MAY:
             out.append(s)
-    return out, len(segments) - len(out)
+        elif v == _STATS_NO:
+            by_value += 1  # only the value stats excluded it
+    return out, len(segments) - len(out), by_value

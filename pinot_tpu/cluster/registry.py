@@ -79,6 +79,9 @@ class SegmentRecord:
     num_partitions: Optional[int] = None
     crc: Optional[str] = None
     push_time_ms: int = 0
+    # per-column {"min": v, "max": v} from segment metadata (JSON-plain
+    # values) — broker-side value pruning (broker/segment_pruner.py)
+    column_stats: Optional[dict] = None
 
 
 def _to_json(state: dict) -> dict:

@@ -17,6 +17,8 @@ import time
 import zlib
 from typing import Optional
 
+import numpy as np
+
 from pinot_tpu.cluster.registry import (
     ClusterRegistry,
     InstanceInfo,
@@ -29,6 +31,25 @@ from pinot_tpu.common.table_config import TableConfig, TableType
 from pinot_tpu.storage.segment import ImmutableSegment
 
 log = logging.getLogger("pinot_tpu.controller")
+
+
+def _column_stats_fields(meta) -> dict:
+    """Per-column min/max from segment metadata, JSON-plain, for the
+    SegmentRecord the broker prunes on (SegmentZKMetadata's column
+    min/max role). Non-scalar values (bytes) are skipped — the broker
+    treats missing stats as "may match"."""
+    stats = {}
+    for cm in meta.columns.values():
+        mn, mx = cm.min_value, cm.max_value
+        if isinstance(mn, np.generic):
+            mn = mn.item()
+        if isinstance(mx, np.generic):
+            mx = mx.item()
+        if mn is None or mx is None or \
+                isinstance(mn, bytes) or isinstance(mx, bytes):
+            continue
+        stats[cm.name] = {"min": mn, "max": mx}
+    return {"column_stats": stats} if stats else {}
 
 
 def _partition_record_fields(meta) -> dict:
@@ -437,6 +458,7 @@ class Controller:
             state=SegmentState.ONLINE, start_time=meta.start_time,
             end_time=meta.end_time, crc=meta.crc,
             **_partition_record_fields(meta),
+            **_column_stats_fields(meta),
         )
         instances = self.assigner.assign(self._table_replication(cfg))
         self.registry.add_segment(record, instances)

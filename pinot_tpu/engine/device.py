@@ -38,6 +38,7 @@ from pinot_tpu.engine.params import (
 )
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
 from pinot_tpu.ops import agg as agg_ops
+from pinot_tpu.ops import blockskip as bs_ops
 from pinot_tpu.ops import hll as hll_ops
 from pinot_tpu.ops import masks as mask_ops
 from pinot_tpu.ops import radix_groupby as radix_ops
@@ -447,6 +448,35 @@ def _out_layout(out_shapes) -> list:
     return layout
 
 
+def _neutral_fill(name: str, dt):
+    """The kernels' empty/masked fill for an output leaf, by naming
+    convention — ONE copy shared by the fully-pruned synthesis
+    (_neutral_outs), the blockskip cond-branch table padding (_pad_table
+    in build_pipeline), and the sorted-regime empty-slot fills, so the
+    three sites can't drift: extremal sentinels for min/max/time planes,
+    -inf for the arg-time value planes ("no winner" encoding), the radix
+    key sentinel for sorted tables, zero elsewhere."""
+    kind = np.dtype(dt).kind
+    if name == "skeys":
+        return radix_ops.INT64_SENTINEL
+    if name.endswith(("_vtmin", "_vtmax")):
+        return -np.inf
+    if name.endswith(("_min", "_tmin")):
+        return np.iinfo(dt).max if kind in "iu" else np.inf
+    if name.endswith(("_max", "_tmax")):
+        return np.iinfo(dt).min if kind in "iu" else -np.inf
+    return 0
+
+
+def _neutral_outs(layout) -> dict:
+    """Host-synthesized pipeline outputs for a FULLY-pruned launch: every
+    leaf takes the exact fill its kernel produces under an all-false mask,
+    keyed off the eval_shape layout so dtypes match the compiled pipeline
+    bit-for-bit."""
+    return {name: np.full(shp, _neutral_fill(name, dt), dtype=dt)
+            for name, dt, shp, _which, _off, _size in layout}
+
+
 def _unpack_outs(bufs: dict, layout) -> dict:
     outs = {}
     for name, dt, shp, which, off, size in layout:
@@ -459,7 +489,7 @@ def _unpack_outs(bufs: dict, layout) -> dict:
 
 
 def build_pipeline(template, mm_mode: str = "auto",
-                   sorted_hll_ok: bool = False):
+                   sorted_hll_ok: bool = False, blockskip: bool = False):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
     ``mm_mode``: "auto" → the factored one-hot matmul kernel
@@ -471,6 +501,19 @@ def build_pipeline(template, mm_mode: str = "auto",
     with ``sorted_hll_ok`` (single-device executors only — the sorted
     sums are not shard-mergeable) a final template routes large-G HLL
     through the register-free sorted build (_hll_sorted_sums).
+
+    ``blockskip``: compile the zone-map block-skip form (ops/blockskip.py):
+    per-block verdicts from (S, NB) zone arrays, static-bound candidate
+    compaction, and a gathered (B, R) filter+aggregation — with the dense
+    form as the in-kernel overflow fallback (lax.cond), so an unselective
+    query costs only the verdict + compaction work extra. The executor
+    requests it for templates whose filter has interval structure.
+
+    Every pipeline honors the optional ``ps_alive`` param — the per-query
+    (S,) segment-alive vector from launch-time stats pruning (Level 1).
+    It is a PARAM, not part of the batch: the (S, L) batch, its compiled
+    templates, and the cohort coalescer key stay stable across queries
+    that prune different segment subsets.
     """
     shape, filter_tpl, group_cols, group_cards, aggs, sorted_k, _final = template
     mm_mode = _resolve_mm_mode(mm_mode)
@@ -479,16 +522,105 @@ def build_pipeline(template, mm_mode: str = "auto",
         num_groups *= c
 
     def pipeline(cols, n_docs, params):
-        # sk:: sorted projections are 1-D and must not drive the (S, L)
-        # shape inference
-        any_col = next(v for k, v in cols.items()
+        # zone cols are (S, NB) and sk:: sorted projections are 1-D — the
+        # (S, L) shape inference must skip both
+        data_cols = {k: v for k, v in cols.items()
+                     if not k.startswith((bs_ops.ZLO, bs_ops.ZHI))}
+        any_col = next(v for k, v in data_cols.items()
                        if not k.startswith("sk::"))
-        sl = any_col.shape[:2]  # MV blocks are (S, L, K); masks are (S, L)
-        valid = mask_ops.valid_mask(n_docs, sl[1], batched=True)
-        mask = _eval_filter(filter_tpl, cols, params, sl) & valid
-        seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)  # (S,) for stats
-        outs = {"doc_count": jnp.sum(seg_matched), "seg_matched": seg_matched}
+        S, L = any_col.shape[:2]  # MV blocks are (S, L, K); masks are (S, L)
+        alive = params.get("ps_alive")
+        alive_b = jnp.ones((S,), dtype=bool) if alive is None \
+            else alive.astype(bool)
+        nd64 = n_docs.astype(jnp.int64)
+        R = bs_ops.BLOCK_ROWS
 
+        def _stat_outs(seg_matched, rows_filter, blocks_total, blocks_scanned):
+            """Observability leaves every branch emits identically (mesh:
+            seg_matched reassembles per-shard, the rest psum)."""
+            return {
+                "doc_count": jnp.sum(seg_matched),
+                "seg_matched": seg_matched,
+                "n_alive": jnp.sum(alive_b, dtype=jnp.int64),
+                "rows_filter": rows_filter,
+                "blocks_total": blocks_total,
+                "blocks_scanned": blocks_scanned,
+            }
+
+        def dense(blocks_total):
+            valid = mask_ops.valid_mask(n_docs, L, batched=True) \
+                & alive_b[:, None]
+            mask = _eval_filter(filter_tpl, data_cols, params, (S, L)) & valid
+            seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)
+            outs = _stat_outs(
+                seg_matched, jnp.sum(jnp.where(alive_b, nd64, 0)),
+                blocks_total, blocks_total)
+            return _aggregate(data_cols, params, mask, outs)
+
+        if not blockskip or L % R:
+            return dense(jnp.int64(0))
+
+        # ---- zone-map block skip (ops/blockskip.py) ----------------------
+        NB = L // R
+        blocks_total = jnp.sum(jnp.where(alive_b, (nd64 + R - 1) // R, 0))
+        verdict = bs_ops.zone_verdict(filter_tpl, cols, params, (S, NB))
+        block_start = jnp.arange(NB, dtype=jnp.int32) * R
+        verdict = verdict & (block_start[None, :] < n_docs[:, None]) \
+            & alive_b[:, None]
+        flat = verdict.reshape(-1)
+        total = S * NB
+        B = min(total, max(1, -(-total // bs_ops.CAND_FRACTION)))
+        n_cand = jnp.sum(flat, dtype=jnp.int32)
+        cand, cand_valid = bs_ops.compact_candidates(flat, B)
+
+        def skip():
+            seg_of = cand // NB
+            row_idx = ((cand % NB) * R)[:, None] \
+                + jnp.arange(R, dtype=jnp.int32)[None, :]
+            rvalid = cand_valid[:, None] & (row_idx < n_docs[seg_of][:, None])
+            g_cols = {k: bs_ops.gather_blocks(v, cand, NB, R)
+                      for k, v in data_cols.items()}
+            mask = _eval_filter(filter_tpl, g_cols, params, (B, R)) & rvalid
+            block_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)
+            seg_matched = jnp.zeros(S + 1, dtype=jnp.int64).at[
+                jnp.where(cand_valid, seg_of, S)].add(block_matched)[:S]
+            outs = _stat_outs(
+                seg_matched, jnp.sum(rvalid, dtype=jnp.int64),
+                blocks_total, n_cand.astype(jnp.int64))
+            return _aggregate(g_cols, params, mask, outs)
+
+        def _pad_table(outs):
+            """Sorted-regime (radix) tables size as min(rows, K), and the
+            cond's branches see different row counts — pad both to the
+            template K with each reduction's NEUTRAL fill (identical to
+            the kernel's own empty-slot fills, so merges see nothing
+            new). Non-sorted shapes are already K-independent."""
+            if shape != "groupby_sorted":
+                return outs
+            stat_keys = ("doc_count", "seg_matched", "n_alive",
+                         "rows_filter", "blocks_total", "blocks_scanned",
+                         "n_groups_total")
+            out2 = {}
+            for k, v in outs.items():
+                if k in stat_keys or v.ndim == 0 or v.shape[0] >= sorted_k:
+                    out2[k] = v
+                    continue
+                fill = _neutral_fill(k, v.dtype)
+                out2[k] = jnp.concatenate(
+                    [v, jnp.full((sorted_k - v.shape[0],), fill, v.dtype)])
+            return out2
+
+        # overflow (candidates past the static bound) falls back to the
+        # DENSE branch of the same compiled kernel — no host round trip,
+        # no result-shape change; just the verdict work wasted
+        return jax.lax.cond(n_cand > B,
+                            lambda: _pad_table(dense(blocks_total)),
+                            lambda: _pad_table(skip()))
+
+    def _aggregate(cols, params, mask, outs):
+        """Filter mask → aggregation outputs; shape-agnostic over the row
+        layout (dense (S, L) or gathered (B, R) — every reduction lands in
+        template-shaped accumulators either way)."""
         if shape == "groupby_sorted":
             # RADIX-PARTITIONED high-cardinality regime (the MAP_BASED
             # analog of DictionaryBasedGroupKeyGenerator): dense
@@ -545,19 +677,18 @@ def build_pipeline(template, mm_mode: str = "auto",
                 if name == "count":
                     continue
                 pname = pname_of[argt]
-                is_int = payloads[pname][1] == "int"
                 if name in ("sum", "avg"):
                     s = tbl["sum::" + pname]
                     outs[f"{k}_sum"] = jnp.where(
                         empty, jnp.zeros((), s.dtype), s)
                 if name in ("min", "minmaxrange"):
-                    lo_fill = jnp.iinfo(jnp.int64).max if is_int else jnp.inf
+                    col = tbl["min::" + pname]
                     outs[f"{k}_min"] = jnp.where(
-                        empty, lo_fill, tbl["min::" + pname])
+                        empty, _neutral_fill(f"{k}_min", col.dtype), col)
                 if name in ("max", "minmaxrange"):
-                    hi_fill = jnp.iinfo(jnp.int64).min if is_int else -jnp.inf
+                    col = tbl["max::" + pname]
                     outs[f"{k}_max"] = jnp.where(
-                        empty, hi_fill, tbl["max::" + pname])
+                        empty, _neutral_fill(f"{k}_max", col.dtype), col)
             return outs
 
         if shape == "groupby":
@@ -728,6 +859,9 @@ class DeviceExecutor:
         self.profile_enabled = False
         self._last_launch = None
         self.last_get_wait_s = None
+        # stateless launch-time stats pruner (engine.SegmentPruner), built
+        # lazily to keep the engine module import one-directional
+        self._stats_pruner = None
         # NOTE: predicate-literal device caching lives in params._slot —
         # keyed on host bytes BEFORE upload (keying device arrays here
         # would cost a blocking device→host read per literal)
@@ -918,7 +1052,7 @@ class DeviceExecutor:
         return (name, argt, rpb)
 
     def launch(self, q: QueryContext, segments,
-               final: bool = False) -> InflightLaunch:
+               final: bool = False, alive=None) -> InflightLaunch:
         """LAUNCH phase: template build + column gather + NON-BLOCKING XLA
         dispatch (JAX dispatch is async; only device_get blocks). Returns
         an InflightLaunch whose ``fetch()`` resolves the packed output
@@ -926,7 +1060,11 @@ class DeviceExecutor:
         instead of serializing them. Under concurrency, same-cohort
         launches (one batch, one template, same param shapes) coalesce
         into a single vmapped dispatch (engine/inflight.py). Raises
-        DeviceUnsupported for shapes the device path doesn't cover."""
+        DeviceUnsupported for shapes the device path doesn't cover.
+
+        ``alive``: optional per-segment bool sequence from a caller that
+        already ran the stats pruner (engine.execute_segments_async) —
+        skips re-deriving Level-1 verdicts here. None = derive them."""
         aggs = q.aggregations()
         if q.distinct:
             # DISTINCT == group-by over the select columns with no aggs:
@@ -952,13 +1090,13 @@ class DeviceExecutor:
         batch_key = self._batch_key(segments)
         try:
             return self._launch_pinned(q, ctx, batch_key, segments,
-                                       aggs, final)
+                                       aggs, final, alive)
         except BaseException:
             self._release_launch(batch_key)
             raise
 
     def _launch_pinned(self, q, ctx, batch_key, segments, aggs,
-                       final) -> InflightLaunch:
+                       final, alive_hint=None) -> InflightLaunch:
         params: dict = {}
         counter = [0]
 
@@ -1022,13 +1160,49 @@ class DeviceExecutor:
         template = (shape, filter_tpl, group_cols, group_cards, agg_tpls,
                     sorted_k, final)
 
-        entry = self._pipeline_entry(template, agg_tpls, final)
+        opts = q.options_ci()
+
+        # Level-2 eligibility: the filter has interval structure the zone
+        # maps can act on, the batch is block-aligned, and the query didn't
+        # opt out (SET useBlockSkip = false — the force-dense form the
+        # differential parity suite compares against)
+        use_bs, zone_cols = False, set()
+        if filter_tpl[0] not in ("true", "false") \
+                and opts.get("useblockskip") is not False \
+                and ctx.pad_to % bs_ops.BLOCK_ROWS == 0:
+            prunable, zone_cols = bs_ops.prunable_columns(filter_tpl)
+            use_bs = prunable and bool(zone_cols)
+
+        entry = self._pipeline_entry(template, agg_tpls, final, use_bs)
+
+        # Level-1 launch-time segment skip: evaluate the filter tree against
+        # per-segment column stats (min/max, dictionary membership, bloom
+        # for EQ/IN) with the broker pruner's conservative tri-state
+        # semantics. The result is a per-query VECTOR PARAM, not a batch
+        # key: pruned members stay in the (S, L) batch, dead.
+        if alive_hint is not None:
+            alive = np.asarray(alive_hint, dtype=bool)
+        else:
+            alive = np.ones(ctx.S, dtype=bool)
+            if q.filter is not None:
+                pruner = self._stats_pruner
+                if pruner is None:
+                    from pinot_tpu.engine.engine import SegmentPruner
+
+                    pruner = self._stats_pruner = SegmentPruner()
+                for i, s in enumerate(segments):
+                    alive[i] = not pruner.prune(q, s)
+        params["ps_alive"] = jnp.asarray(alive)
 
         # SET useSortedProjection=false keeps the per-query in-pipeline
         # sort (the cold-scan measurement form); default taps the batch's
         # cached sorted projection for filterless terminal HLL
-        sorted_proj_ok = q.options_ci().get("usesortedprojection") is not False
+        sorted_proj_ok = opts.get("usesortedprojection") is not False
         needed = self._needed_columns(filter_tpl) | set(group_cols)
+        if use_bs:
+            for zc in zone_cols:
+                needed.add(bs_ops.ZLO + zc)
+                needed.add(bs_ops.ZHI + zc)
         for name, argt, extra in agg_tpls:
             if name == "distinctcount":
                 needed.add(argt)
@@ -1049,7 +1223,11 @@ class DeviceExecutor:
                 needed |= self._needed_columns(argt)
         cols = {}
         for c in sorted(needed):
-            if c.startswith("dv::"):
+            if c.startswith(bs_ops.ZLO):
+                cols[c] = ctx.zone_map(c[len(bs_ops.ZLO):])[0]
+            elif c.startswith(bs_ops.ZHI):
+                cols[c] = ctx.zone_map(c[len(bs_ops.ZHI):])[1]
+            elif c.startswith("dv::"):
                 cols[c] = ctx.decoded_column(c[4:])
             elif c.startswith("sk::"):
                 _, colname, l2m = c.split("::")
@@ -1080,31 +1258,52 @@ class DeviceExecutor:
         # round trip (measured ~100ms each on the bench tunnel). The layout
         # is shape-deterministic per (template, batch shapes) — eval_shape
         # traces without touching the device.
-        lkey = (ctx.S, next(v for k, v in cols.items()
-                            if not k.startswith("sk::")).shape[1])
+        lkey = (ctx.S, next(
+            v for k, v in cols.items()
+            if not k.startswith(("sk::", bs_ops.ZLO, bs_ops.ZHI))).shape[1])
         layout = entry["layouts"].get(lkey)
         if layout is None:
             layout = _out_layout(
                 jax.eval_shape(entry["inner"], cols, n_docs, params))
             with self._lock:
                 entry["layouts"][lkey] = layout
+        if not alive.any():
+            # FULLY pruned: skip the device launch (and its link round
+            # trip) entirely — synthesize the outputs host-side from the
+            # layout with the kernels' own all-masked fills, so pruned vs
+            # force-dense results stay bit-identical
+            synth = _neutral_outs(layout)
+            return InflightLaunch(self, q, ctx, template, aggs, batch_key,
+                                  lambda: synth)
         resolve = self._dispatch(
             entry, batch_key, cols, n_docs, params, lkey, layout)
         return InflightLaunch(self, q, ctx, template, aggs, batch_key, resolve)
 
     # ---- dispatch: solo vs coalesced -------------------------------------
-    def _pipeline_entry(self, template, agg_tpls, final) -> dict:
-        """Compiled-pipeline cache entry for (template, mm_mode): the solo
-        jitted pipeline, the pre-pack inner fn (eval_shape layouts), the
-        raw pipeline (cohort rebuilds compose vmap/mesh from it), and the
-        layout caches. Built under the executor lock so concurrent
-        same-template launches share ONE entry (the coalescer keys on it)."""
+    def _pipeline_entry(self, template, agg_tpls, final,
+                        blockskip: bool = False) -> dict:
+        """Compiled-pipeline cache entry for (template, mm_mode, blockskip):
+        the solo jitted pipeline, the pre-pack inner fn (eval_shape
+        layouts), the raw pipeline (cohort rebuilds compose vmap/mesh from
+        it), and the layout caches. Built under the executor lock so
+        concurrent same-template launches share ONE entry (the coalescer
+        keys on it)."""
         with self._lock:
-            entry = self._pipelines.get((template, self.mm_mode))
+            entry = self._pipelines.get((template, self.mm_mode, blockskip))
             if entry is not None:
                 return entry
             raw = build_pipeline(template, self.mm_mode,
-                                 sorted_hll_ok=(self.mesh is None))
+                                 sorted_hll_ok=(self.mesh is None),
+                                 blockskip=blockskip)
+            # cohorts vmap the pipeline over stacked member params, and a
+            # vmapped lax.cond lowers to select — BOTH branches would run
+            # for every member. Cohorts therefore ride the DENSE form;
+            # per-member ps_alive still applies Level-1 segment pruning
+            # inside the vmap, so members pruning different segment
+            # subsets stay correct.
+            raw_cohort = build_pipeline(
+                template, self.mm_mode, sorted_hll_ok=(self.mesh is None),
+            ) if blockskip else raw
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
 
@@ -1123,11 +1322,11 @@ class DeviceExecutor:
                     inner(cols, n_docs, params))
             )
             entry = {
-                "pipeline": pipeline, "inner": inner, "raw": raw,
+                "pipeline": pipeline, "inner": inner, "raw": raw_cohort,
                 "agg_tpls": agg_tpls, "final": final,
                 "layouts": {}, "cohort": None, "cohort_layouts": {},
             }
-            self._pipelines[(template, self.mm_mode)] = entry
+            self._pipelines[(template, self.mm_mode, blockskip)] = entry
             return entry
 
     def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout):
@@ -1264,20 +1463,32 @@ class DeviceExecutor:
         shape, _, group_cols, group_cards, agg_tpls, sorted_k, _final = template
         doc_count = int(outs["doc_count"])
         # mirror the host executor's stats accounting so responses are
-        # backend-independent (host.py execute_segment)
+        # backend-independent (host.py execute_segment) — HONEST under
+        # pruning: entries count only alive segments' rows, and only the
+        # gathered blocks' rows when the block-skip path ran
+        n_alive = min(int(outs["n_alive"]), ctx.S) \
+            if "n_alive" in outs else ctx.S
         entries_in_filter = 0
         if q.filter is not None:
-            entries_in_filter = int(ctx.n_docs.sum()) * len(q.filter.columns())
+            rows_filter = int(outs["rows_filter"]) if "rows_filter" in outs \
+                else int(ctx.n_docs.sum())
+            entries_in_filter = rows_filter * len(q.filter.columns())
         entries_post = sum(
             doc_count * len(aggspec.make_spec(a).args) for a in q.aggregations()
         )
+        blocks_total = int(outs.get("blocks_total", 0))
+        blocks_scanned = int(outs.get("blocks_scanned", 0))
         stats = ExecutionStats(
             num_docs_scanned=doc_count,
             num_entries_scanned_in_filter=entries_in_filter,
             num_entries_scanned_post_filter=entries_post,
-            num_segments_processed=ctx.S,
+            num_segments_processed=n_alive,
             num_segments_queried=ctx.S,
             num_segments_matched=int((outs["seg_matched"] > 0).sum()),
+            num_segments_pruned=ctx.S - n_alive,
+            num_blocks_pruned=max(0, blocks_total - blocks_scanned),
+            # pruned segments still count toward totalDocs (reference
+            # semantics)
             total_docs=int(ctx.n_docs.sum()),
         )
 

@@ -29,7 +29,6 @@ from pinot_tpu.query.context import (
 )
 from pinot_tpu.query.optimizer import optimize_query
 from pinot_tpu.sql.compiler import compile_query
-from pinot_tpu.storage.bloom import BloomFilter
 from pinot_tpu.storage.segment import ImmutableSegment
 
 log = logging.getLogger("pinot_tpu.engine")
@@ -59,33 +58,30 @@ class SegmentPruner:
         if not p.lhs.is_identifier or p.lhs.name not in seg.metadata.columns:
             return False
         meta = seg.column_metadata(p.lhs.name)
-        mn, mx = meta.min_value, meta.max_value
-        try:
-            if p.type is PredicateType.EQ and mn is not None:
-                if self._lt(p.value, mn) or self._lt(mx, p.value):
-                    return True
-                bloom = seg.bloom(p.lhs.name)
-                if bloom is not None and not BloomFilter(bloom).might_contain(p.value):
-                    return True
-            elif p.type is PredicateType.IN and mn is not None:
-                if all(self._lt(v, mn) or self._lt(mx, v) for v in p.values):
-                    return True
-            elif p.type is PredicateType.RANGE and mn is not None:
-                if p.lower is not None:
-                    if self._lt(mx, p.lower) or (mx == p.lower and not p.lower_inclusive):
-                        return True
-                if p.upper is not None:
-                    if self._lt(p.upper, mn) or (mn == p.upper and not p.upper_inclusive):
-                        return True
-        except TypeError:
-            return False  # incomparable types: don't prune
+        # min/max interval exclusion: the SAME algebra the broker prunes
+        # routing with (common/pruning.py) — strict about incomparable
+        # literals, so a mis-typed literal surfaces from the scan instead
+        # of silently pruning to empty
+        from pinot_tpu.common.pruning import interval_may_match
+
+        if p.type in (PredicateType.EQ, PredicateType.IN,
+                      PredicateType.RANGE):
+            if not interval_may_match(p, meta.min_value, meta.max_value):
+                return True
+        if p.type is PredicateType.EQ and \
+                self._provably_absent(seg, p.lhs.name, [p.value]):
+            return True
+        if p.type is PredicateType.IN and p.values and \
+                self._provably_absent(seg, p.lhs.name, list(p.values)):
+            return True
         return False
 
     @staticmethod
-    def _lt(a, b) -> bool:
-        if isinstance(a, str) != isinstance(b, str):
-            a, b = str(a), str(b)
-        return a < b
+    def _provably_absent(seg, col: str, values: list) -> bool:
+        from pinot_tpu.common.pruning import provably_absent
+
+        return provably_absent(seg, col, values)
+
 
 
 class TableDataManager:
@@ -207,6 +203,7 @@ class QueryEngine:
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "numSegmentsPrunedByServer": stats.num_segments_pruned,
+                "numBlocksPruned": stats.num_blocks_pruned,
                 "numGroupsLimitReached": stats.num_groups_limit_reached,
                 "totalDocs": stats.total_docs,
                 "timeUsedMs": round((time.time() - t0) * 1000, 3),
@@ -259,16 +256,15 @@ class QueryEngine:
         the gate a fallback storm would escape the concurrency cap."""
         q = self._expand_star(q, segments[0])
 
-        kept, pruned = [], 0
-        for s in segments:
-            if self.pruner.prune(q, s):
-                pruned += 1
-            else:
-                kept.append(s)
+        from pinot_tpu.engine.device import DeviceUnsupported, \
+            segment_device_eligible
 
         results = []
-        executed = list(kept)
-        if kept:
+        executed = []
+        scan = []
+        scan_pruned: set = set()  # id(s) of scan segments the pruner excluded
+        pruned = 0                # segments dropped HERE (non-device paths)
+        if segments:
             # per-segment fast paths first: metadata-only aggregation, then
             # star-tree substitution (AggregationPlanNode.java:186-210).
             # Star-tree-eligible segments are GROUPED by tree signature and
@@ -282,19 +278,38 @@ class QueryEngine:
 
             remaining = []
             st_groups: dict = {}
-            for s in kept:
-                r = try_metadata_only(q, s)
-                if r is not None:
-                    results.append(r)
-                    continue
+            for s in segments:
+                is_pruned = self.pruner.prune(q, s)
+                if not is_pruned:
+                    r = try_metadata_only(q, s)
+                    if r is not None:
+                        results.append(r)
+                        executed.append(s)
+                        continue
                 hit = fitting_tree(q, s)
                 if hit is not None:
+                    if is_pruned:
+                        pruned += 1
+                        continue
                     sig, meta, st_seg = hit
                     grp = st_groups.setdefault(sig, {"meta": meta, "sts": [], "docs": 0})
                     grp["sts"].append(st_seg)
                     grp["docs"] += s.n_docs
-                else:
-                    remaining.append(s)
+                    executed.append(s)
+                    continue
+                if is_pruned:
+                    # device-eligible sealed segments STAY in the scan batch,
+                    # alive-masked at launch (DeviceExecutor Level-1) — the
+                    # (S, L) batch key, its compiled templates, and the
+                    # cohort coalescer key must not depend on which filter
+                    # literals pruned what. Other backends drop them here.
+                    if not (self.device is not None
+                            and segment_device_eligible(s)):
+                        pruned += 1
+                        continue
+                    scan_pruned.add(id(s))
+                remaining.append(s)
+                executed.append(s)
             # a lone star-tree group with nothing to merge against stays
             # terminal: its cube execution may finalize sketches on device
             st_terminal = (terminal and not results and not remaining
@@ -305,8 +320,6 @@ class QueryEngine:
                                             grp["docs"], terminal=st_terminal)
                 )
             scan = remaining
-        else:
-            scan = []
         device_handles, host_results = [], []
         if scan:
             # consuming (mutable) and upsert-masked segments run on the host
@@ -319,8 +332,6 @@ class QueryEngine:
             # batch: promotion changes the chunklet set every 64k rows, and
             # a combined batch key would evict + re-upload the (stable)
             # sealed columns on every promotion.
-            from pinot_tpu.engine.device import DeviceUnsupported, \
-                segment_device_eligible
             from pinot_tpu.realtime.chunklet import split_for_query
 
             device_sealed, device_chunklets, host_segs = [], [], []
@@ -343,14 +354,30 @@ class QueryEngine:
                          and len(groups) == 1)
                 try:
                     for g in groups:
+                        # the sealed group's Level-1 verdicts were already
+                        # computed by self.pruner above — hand them to the
+                        # launch so it doesn't re-derive them. Chunklet
+                        # groups compute their OWN per-chunklet verdicts
+                        # (the engine pruned the consuming segment as a
+                        # whole, not per block).
+                        hint = [id(s) not in scan_pruned for s in g] \
+                            if g is device_sealed else None
                         device_handles.append(
-                            (self.device.launch(q, g, final=final), g))
+                            (self.device.launch(q, g, final=final,
+                                                alive=hint), g))
                 except DeviceUnsupported:
                     for h, _ in device_handles:
                         h.release()
                     device_handles = []
             if not device_handles:
-                host_segs = scan  # launch refused: whole scan on the host
+                # launch refused: whole scan on the host — segments the
+                # metadata pruner excluded (kept only for device batch-key
+                # stability) drop back out rather than host-scan for nothing
+                host_segs = [s for s in scan if id(s) not in scan_pruned]
+                pruned += len(scan) - len(host_segs)
+                if scan_pruned:
+                    executed = [s for s in executed
+                                if id(s) not in scan_pruned]
             # host partials execute in the launch phase, overlapping the
             # dispatched device batches' link round trip; a host failure
             # must release the in-flight handles or their batch pins leak
@@ -364,9 +391,9 @@ class QueryEngine:
 
         def fetch():
             res = list(results)
+            ran = executed
+            fallback_pruned = []  # stats-pruned members of fallen-back handles
             if device_handles:
-                from pinot_tpu.engine.device import DeviceUnsupported
-
                 for handle, segs_of_handle in device_handles:
                     try:
                         res.append(handle.fetch())
@@ -375,15 +402,27 @@ class QueryEngine:
                         # overflow): the device must never shape
                         # truncation policy. The host re-scan is heavy
                         # CPU work — route it through the caller's
-                        # admission gate when one is provided
-                        def _host_rerun(_segs=segs_of_handle):
+                        # admission gate when one is provided. Members the
+                        # metadata pruner already proved empty (kept in
+                        # the batch only for batch-key stability) don't
+                        # re-scan; they count as pruned like the
+                        # launch-refused path.
+                        live = [s for s in segs_of_handle
+                                if id(s) not in scan_pruned]
+                        fallback_pruned.extend(
+                            s for s in segs_of_handle
+                            if id(s) in scan_pruned)
+
+                        def _host_rerun(_segs=live):
                             return [self.host.execute_segment(q, s)
                                     for s in _segs]
 
                         res.extend(_host_rerun() if fallback_gate is None
                                    else fallback_gate(_host_rerun))
+            if fallback_pruned:
+                dropped = {id(s) for s in fallback_pruned}
+                ran = [s for s in ran if id(s) not in dropped]
             res.extend(host_results)
-            ran = executed
             if not res:
                 # everything pruned: empty result over first segment's schema
                 ran = [segments[0]]
@@ -391,7 +430,9 @@ class QueryEngine:
                     _impossible(q), segments[0]))
 
             merged = merge_intermediates(q, res)
-            merged.stats.num_segments_pruned = pruned
+            # device partials carry their own launch-level pruned counts
+            # (alive-masked batch members); add the segments dropped here
+            merged.stats.num_segments_pruned += pruned + len(fallback_pruned)
             merged.stats.num_segments_queried = len(segments)
             # pruned segments still count toward totalDocs (reference
             # semantics)

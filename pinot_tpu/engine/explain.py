@@ -54,10 +54,26 @@ def explain_plan(engine, q: QueryContext) -> dict:
         # index choice is per-segment; EXPLAIN (like the reference's
         # non-verbose mode) describes it against one representative segment
         seg = None
+        segs = []
         tdm = engine.tables.get(q.table_name)
         if tdm is not None and tdm.segments:
-            seg = next(iter(tdm.segments.values()))
-        _filter_lines(q.filter, 2, lines, seg)
+            segs = list(tdm.segments.values())
+            seg = segs[0]
+        # server-side stats pruning (min/max + dictionary membership +
+        # bloom, engine.SegmentPruner — the same tri-state the device
+        # launch masks segments with): provably-false-everywhere renders
+        # as FILTER_EMPTY, partial prunes as a PRUNE line under the tree
+        n_pruned = 0
+        pruner = getattr(engine, "pruner", None)
+        if pruner is not None and segs:
+            n_pruned = sum(1 for s in segs if pruner.prune(q, s))
+        if segs and n_pruned == len(segs):
+            lines.append("    FILTER_EMPTY")
+        else:
+            _filter_lines(q.filter, 2, lines, seg)
+            if n_pruned:
+                lines.append(
+                    f"      PRUNE(zone-map: {n_pruned}/{len(segs)} segments)")
     else:
         lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
     lines.append("    PROJECT(" + ", ".join(sorted(q.columns())) + ")")

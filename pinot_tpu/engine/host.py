@@ -312,6 +312,21 @@ class SegmentEvaluator:
                 p.type not in (PredicateType.IS_NULL, PredicateType.IS_NOT_NULL):
             # evolved MV column: zero entries per doc, match-any matches none
             return np.zeros(self.n, dtype=bool)
+        # bloom short-circuit: EQ/IN on a bloom-indexed column can prove the
+        # segment empty BEFORE the dictionary or forward index is ever
+        # decoded (ColumnValueSegmentPruner's bloom check, applied at the
+        # predicate level so OR branches benefit too — the segment-level
+        # pruner only sees top-level conjuncts)
+        if lhs.is_identifier and lhs.name in self.seg.metadata.columns and \
+                p.type in (PredicateType.EQ, PredicateType.IN) and \
+                getattr(self.seg.column_metadata(lhs.name), "has_bloom",
+                        False):
+            from pinot_tpu.common.pruning import provably_absent
+
+            vals = [p.value] if p.type is PredicateType.EQ \
+                else list(p.values)
+            if vals and provably_absent(self.seg, lhs.name, vals):
+                return np.zeros(self.n, dtype=bool)
         # dictionary-space fast path
         if lhs.is_identifier and lhs.name in self.seg.metadata.columns:
             meta = self.seg.column_metadata(lhs.name)

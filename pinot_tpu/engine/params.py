@@ -41,7 +41,11 @@ from pinot_tpu.query.context import (
 )
 from pinot_tpu.storage.device import padded_len
 from pinot_tpu.storage.dictionary import Dictionary
-from pinot_tpu.storage.segment import Encoding
+from pinot_tpu.storage.segment import (
+    ZONE_BLOCK_ROWS,
+    Encoding,
+    build_zone_map,
+)
 
 import jax.numpy as jnp
 
@@ -60,6 +64,10 @@ class BatchContext:
 
     def __init__(self, segments: list, pad_multiple: int = 1024):
         self.segments = list(segments)
+        # pad to a whole number of zone-map blocks so the block-skip path
+        # (ops/blockskip.py) can reshape (S, L) -> (S * n_blocks, R) without
+        # a second padding pass; worst case +3072 pad rows per segment
+        pad_multiple = max(pad_multiple, ZONE_BLOCK_ROWS)
         self.pad_to = max(padded_len(s.n_docs, pad_multiple) for s in self.segments)
         self.S = len(self.segments)
         self.n_docs = np.array([s.n_docs for s in self.segments], dtype=np.int32)
@@ -71,6 +79,11 @@ class BatchContext:
         self._prehashed: dict[str, object] = {}     # name -> (S, L) value hashes
         self._mv_columns: dict[str, object] = {}    # name -> (S, L, K) id blocks
         self._sorted_hll: dict = {}   # (group_cols, hash_col, log2m) -> sorted keys
+        # col key -> ((S, NB) lo, (S, NB) hi) device zone maps in the
+        # column's device value space (global ids / decoded / raw); built
+        # eagerly alongside the column block (the host data is in hand
+        # there — rebuilding later would repeat the remap gather)
+        self._zone_maps: dict = {}
         # concurrent queries share one cached BatchContext (the executor's
         # batch LRU): lazy materialization is locked so two threads never
         # build the same block twice. RLock: sorted_hll_keys re-enters
@@ -164,22 +177,92 @@ class BatchContext:
             if enc == Encoding.DICT:
                 gdict = self.global_dict(name)
                 blocks = np.full((self.S, self.pad_to), -1, dtype=np.int32)
+                zlo, zhi = self._zone_fills(np.int32)
                 for i, s in enumerate(self.segments):
                     d = s.dictionary(name)
                     remap = np.searchsorted(
                         gdict.values, np.asarray(d.values)
                     ).astype(np.int32)
                     fwd = np.asarray(s.forward(name))
-                    blocks[i, : len(fwd)] = remap[fwd]
+                    gids = remap[fwd]
+                    blocks[i, : len(fwd)] = gids
+                    zm = self._reader_zone_map(s, name, len(fwd))
+                    # local->global id remap is monotone (both dictionaries
+                    # are sorted), so per-block min/max ids survive it
+                    z = remap[np.asarray(zm)] if zm is not None \
+                        else build_zone_map(gids)
+                    zlo[i, : z.shape[1]] = z[0]
+                    zhi[i, : z.shape[1]] = z[1]
             else:
                 from pinot_tpu.storage.device import host_column_block
 
                 blocks = np.stack(
                     [host_column_block(s, name, self.pad_to) for s in self.segments]
                 )
+                zlo, zhi = self._zone_fills(blocks.dtype)
+                for i, s in enumerate(self.segments):
+                    zm = self._reader_zone_map(s, name, s.n_docs)
+                    # astype matches the device narrowing (round-to-nearest
+                    # is monotone, so narrowed bounds still bound the
+                    # narrowed column values)
+                    z = np.asarray(zm).astype(blocks.dtype) if zm is not None \
+                        else build_zone_map(blocks[i, : s.n_docs])
+                    zlo[i, : z.shape[1]] = z[0]
+                    zhi[i, : z.shape[1]] = z[1]
             self._columns[name] = jnp.asarray(blocks)
             self._note_resident(self._columns[name])
+            self._store_zone_map(name, zlo, zhi)
         return self._columns[name]
+
+    # ---- zone maps (device block-skip basis, ops/blockskip.py) ----------
+    def _zone_fills(self, dtype):
+        """(S, NB) lo/hi arrays pre-filled with never-match sentinels (lo =
+        dtype max, hi = dtype min) so padding blocks past a segment's data
+        satisfy no interval predicate."""
+        nb = self.pad_to // ZONE_BLOCK_ROWS
+        dtype = np.dtype(dtype)
+        if dtype.kind in ("i", "u"):
+            lof, hif = np.iinfo(dtype).max, np.iinfo(dtype).min
+        else:
+            lof, hif = np.finfo(dtype).max, np.finfo(dtype).min
+        return (np.full((self.S, nb), lof, dtype=dtype),
+                np.full((self.S, nb), hif, dtype=dtype))
+
+    @staticmethod
+    def _reader_zone_map(seg, name: str, n: int):
+        """Segment-provided (2, n_blocks) zone map (sealed: <col>.zmap.npy;
+        chunklets: computed at promotion), or None -> recompute from the
+        column block (pre-zone-map segments)."""
+        fn = getattr(seg, "zone_map", None)
+        if fn is None:
+            return None
+        try:
+            zm = fn(name)
+        except Exception:  # noqa: BLE001 — corrupt file: recompute instead
+            return None
+        if zm is None:
+            return None
+        zm = np.asarray(zm)
+        if zm.shape != (2, -(-n // ZONE_BLOCK_ROWS)):
+            return None  # stale granularity: recompute
+        return zm
+
+    def _store_zone_map(self, key: str, zlo, zhi) -> None:
+        self._zone_maps[key] = (jnp.asarray(zlo), jnp.asarray(zhi))
+        for a in self._zone_maps[key]:
+            self._note_resident(a)
+
+    def zone_map(self, key: str):
+        """((S, NB) lo, (S, NB) hi) device zone arrays for a cols-dict key
+        (bare name -> global dict ids or raw values; "dv::name" -> decoded
+        values), materializing the backing column on first use."""
+        with self._lock:
+            if key not in self._zone_maps:
+                if key.startswith("dv::"):
+                    self._decoded_column_locked(key[4:])
+                else:
+                    self._column_locked(key)
+            return self._zone_maps[key]
 
     def global_dict(self, name: str) -> Dictionary:
         """Sorted union of per-segment dictionary values (global id space)."""
@@ -228,11 +311,22 @@ class BatchContext:
             else:
                 dt = np.int32
             blocks = np.zeros((self.S, self.pad_to), dtype=dt)
+            zlo, zhi = self._zone_fills(dt)
             for i, (s, vals) in enumerate(zip(self.segments, per_seg)):
                 fwd = np.asarray(s.forward(name))
-                blocks[i, : len(fwd)] = vals[fwd]
+                decoded = vals.astype(dt)[fwd]
+                blocks[i, : len(fwd)] = decoded
+                zm = self._reader_zone_map(s, name, len(fwd))
+                # id zone -> value zone through the sorted dictionary (id
+                # order == value order, so min/max ids decode to min/max
+                # values)
+                z = vals[np.asarray(zm)].astype(dt) if zm is not None \
+                    else build_zone_map(decoded)
+                zlo[i, : z.shape[1]] = z[0]
+                zhi[i, : z.shape[1]] = z[1]
             self._decoded[name] = jnp.asarray(blocks)
             self._note_resident(self._decoded[name])
+            self._store_zone_map("dv::" + name, zlo, zhi)
         return self._decoded[name]
 
     def prehashed_column(self, name: str):

@@ -26,6 +26,9 @@ class ExecutionStats:
     num_segments_processed: int = 0
     num_segments_matched: int = 0
     num_segments_pruned: int = 0
+    # zone-map blocks the device block-skip path never gathered
+    # (engine/device.py; 0 when the dense path ran or pruning was off)
+    num_blocks_pruned: int = 0
     total_docs: int = 0
     time_used_ms: float = 0.0
     # per-query resource accounting (reference: DataTable V3 metadata
@@ -44,6 +47,7 @@ class ExecutionStats:
         self.num_segments_processed += other.num_segments_processed
         self.num_segments_matched += other.num_segments_matched
         self.num_segments_pruned += other.num_segments_pruned
+        self.num_blocks_pruned += other.num_blocks_pruned
         self.total_docs += other.total_docs
         self.thread_cpu_time_ns += other.thread_cpu_time_ns
         self.scheduler_wait_ms += other.scheduler_wait_ms

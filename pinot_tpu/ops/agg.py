@@ -25,6 +25,13 @@ POS_INF = float("inf")
 
 DEFAULT_ROWS_PER_BLOCK = 1 << 15
 
+# float group sums: below this row count the two-stage f32 block scatter
+# saves nothing (the scatter is sub-ms either way) but costs precision —
+# stay on the exact single-stage f64 scatter. Keeps small launches (e.g.
+# star-tree cube batches, gathered block-skip row sets) bit-stable across
+# padding changes.
+FLOAT_TWO_STAGE_MIN_ROWS = 1 << 20
+
 
 def rows_per_block_for(max_abs_value: float):
     """Largest power-of-two block size whose int32 block-sum cannot overflow,
@@ -112,9 +119,11 @@ def group_sum(gids, values, num_groups: int,
     stage2_dt = jnp.int64 if integer else jnp.float64
     nb = (n + rows_per_block - 1) // rows_per_block
     stride = num_groups + 1
-    if nb <= 1 or nb * stride >= 2**31:
-        # single block, or block-slot space would overflow int32 indexing:
-        # exact single-stage 64-bit scatter
+    if nb <= 1 or nb * stride >= 2**31 or \
+            (not integer and n < FLOAT_TWO_STAGE_MIN_ROWS):
+        # single block, block-slot space would overflow int32 indexing, or
+        # a float launch too small for two-stage to pay its precision
+        # cost: exact single-stage 64-bit scatter
         out = jnp.zeros(num_groups + 1, dtype=stage2_dt).at[flat_g].add(
             v.astype(stage2_dt)
         )

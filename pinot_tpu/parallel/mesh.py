@@ -85,10 +85,14 @@ def _combine_sorted_table(outs: dict) -> dict:
     # one shard's length: merged distinct can legitimately exceed any
     # single shard's table. numGroupsLimit semantics stay host-side, via
     # the executor's n_groups_total check against sorted_k.
+    # scalar observability leaves ride the ordinary psum combine, not the
+    # keyed table merge (they are per-shard counts, not table columns)
+    stat_keys = ("doc_count", "seg_matched", "n_groups_total", "skeys",
+                 "n_alive", "rows_filter", "blocks_total", "blocks_scanned")
     K = outs["skeys"].shape[-1]
     reds, cols = {}, {}
     for k, v in outs.items():
-        if k in ("doc_count", "seg_matched", "n_groups_total", "skeys"):
+        if k in stat_keys:
             continue
         reds[k] = "min" if k.endswith("_min") \
             else "max" if k.endswith("_max") else "sum"
@@ -105,6 +109,9 @@ def _combine_sorted_table(outs: dict) -> dict:
         "skeys": jnp.where(empty, radix_ops.INT64_SENTINEL, fk),
         "n_groups_total": jnp.maximum(merged_distinct, overflow_total),
     }
+    for k in ("n_alive", "rows_filter", "blocks_total", "blocks_scanned"):
+        if k in outs:
+            combined[k] = jax.lax.psum(outs[k], SEG_AXIS)
     combined.update(merged)
     return combined
 
@@ -165,10 +172,14 @@ def shard_pipeline(pipeline_fn, mesh: Mesh, cohort: bool = False, post=None):
         return one(cols, n_docs, params)
 
     # global-id design: every param (literals, (C,) LUTs) is batch-wide and
-    # replicated; only columns and n_docs carry the segment axis. The "ps"
-    # prefix remains reserved for any future per-segment param.
+    # replicated; only columns, n_docs, and "ps"-prefixed per-segment
+    # params (e.g. the Level-1 ``ps_alive`` vector) carry the segment axis.
+    # Cohort stacks add a leading member axis, so the segment axis shifts
+    # to position 1 there.
     def param_spec(key: str, x) -> P:
         if key.startswith("ps"):
+            if cohort:
+                return P(None, SEG_AXIS, *([None] * (x.ndim - 2)))
             return P(SEG_AXIS, *([None] * (x.ndim - 1)))
         return P()
 

@@ -94,6 +94,7 @@ class Chunklet:
         self._fwd: dict[str, np.ndarray] = {}
         self._dicts: dict[str, Dictionary] = {}
         self._nulls: dict[str, np.ndarray] = {}
+        self._zmaps: dict[str, np.ndarray] = {}
         no_dict = getattr(segment.table_config.indexing,
                           "no_dictionary_columns", [])
         cols_meta: dict[str, ColumnMetadata] = {}
@@ -183,6 +184,21 @@ class Chunklet:
 
     def bloom(self, col: str):
         return None
+
+    def zone_map(self, col: str) -> np.ndarray:
+        """(2, n_blocks) per-block [min, max] over this chunklet's forward
+        index (local dict ids / raw values), same contract as
+        ImmutableSegment.zone_map. Computed lazily from the sealed block —
+        chunklets are immutable, so one compute per promotion is the
+        "refresh": every new frozen block arrives with fresh zone maps and
+        the consuming segment's device batch prunes like sealed data."""
+        zm = self._zmaps.get(col)
+        if zm is None:
+            from pinot_tpu.storage.segment import build_zone_map
+
+            zm = build_zone_map(self._fwd[col])
+            self._zmaps[col] = zm
+        return zm
 
     def values(self, col: str) -> np.ndarray:
         return self.flat_values(col)

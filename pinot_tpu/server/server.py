@@ -658,7 +658,10 @@ class ServerInstance:
         """Committed realtime segments become cluster-visible (the
         Server2Controller commit → ZK metadata step)."""
         meta = sealed.metadata
-        from pinot_tpu.controller.controller import _partition_record_fields
+        from pinot_tpu.controller.controller import (
+            _column_stats_fields,
+            _partition_record_fields,
+        )
 
         self.registry.add_segment(
             SegmentRecord(
@@ -666,6 +669,7 @@ class ServerInstance:
                 location=sealed.dir, state=SegmentState.ONLINE,
                 start_time=meta.start_time, end_time=meta.end_time,
                 **_partition_record_fields(meta),
+                **_column_stats_fields(meta),
             ),
             [self.instance_id],
             merge_instances=True,

@@ -33,6 +33,7 @@ from pinot_tpu.storage.segment import (
     Encoding,
     ImmutableSegment,
     SegmentMetadata,
+    build_zone_map,
     write_creation_meta,
 )
 
@@ -238,6 +239,19 @@ class SegmentCreator:
 
         if mv_off is not None:
             np.save(p(f"{name}.mvoff.npy"), mv_off, allow_pickle=False)
+
+        if spec.single_value:
+            # per-block zone map over the forward index (local dict ids for
+            # DICT, raw values for RAW): the device block-skip path's prune
+            # basis (ops/blockskip.py). Local ids remap to the batch's
+            # global id space monotonically (both dictionaries are sorted),
+            # so min/max survive the remap — engine/params.py reads this
+            # file instead of re-scanning the column at batch build.
+            zm_src = fwd_for_inv if use_dict else raw
+            np.save(p(f"{name}.zmap.npy"), build_zone_map(zm_src),
+                    allow_pickle=False)
+        elif os.path.exists(p(f"{name}.zmap.npy")):
+            os.unlink(p(f"{name}.zmap.npy"))  # SV→MV rebuild: stale zone map
 
         has_inverted = False
         if name in idx_cfg.inverted_index_columns and fwd_for_inv is not None:

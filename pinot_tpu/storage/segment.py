@@ -45,6 +45,27 @@ SEGMENT_FORMAT_VERSION = 1
 METADATA_FILE = "metadata.json"
 CREATION_META_FILE = "creation.meta.json"
 
+# Zone-map granularity (rows per zone-map block). A format constant shared by
+# the segment creator (``<col>.zmap.npy``), the chunklet sealer, and the
+# device batch loader (engine/params.py) — the device block-skip kernel
+# (ops/blockskip.py) prunes at exactly this granularity, so the on-disk
+# blocks line up 1:1 with the (S, n_blocks) device zone arrays.
+ZONE_BLOCK_ROWS = 4096
+
+
+def build_zone_map(values: np.ndarray, block_rows: int = ZONE_BLOCK_ROWS) -> np.ndarray:
+    """(2, n_blocks) per-block [min, max] over ``values`` (dict ids for DICT
+    columns, raw values for RAW) — the columnar analog of the reference's
+    per-chunk min/max metadata that ColumnValueSegmentPruner consults, kept
+    at a granularity the device can gather by."""
+    n = len(values)
+    if n == 0:
+        return np.zeros((2, 0), dtype=np.asarray(values).dtype)
+    starts = np.arange(0, n, block_rows, dtype=np.int64)
+    lo = np.minimum.reduceat(values, starts)
+    hi = np.maximum.reduceat(values, starts)
+    return np.stack([lo, hi])
+
 
 class Encoding:
     DICT = "DICT"
@@ -239,6 +260,17 @@ class ImmutableSegment:
         if not self.column_metadata(col).has_bloom:
             return None
         return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
+
+    def zone_map(self, col: str) -> Optional[np.ndarray]:
+        """(2, n_blocks) per-ZONE_BLOCK_ROWS-block [min, max] over the
+        forward index (LOCAL dict ids for DICT columns, raw values
+        otherwise), or None for segments built before the format carried
+        zone maps (the batch loader then recomputes from the column
+        block)."""
+        path = self._path(f"{col}.zmap.npy")
+        if not os.path.isfile(path):
+            return None
+        return np.load(path, mmap_mode="r", allow_pickle=False)
 
     def range_index(self, col: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """(doc_ids_in_value_order, sorted_values) for a RAW range-indexed

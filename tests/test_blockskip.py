@@ -1,0 +1,434 @@
+"""Zone-map pruning + device block-skip: differential parity + stats.
+
+The contract under test (ISSUE 4): Level-1 launch-time segment skip (the
+filter tree vs per-segment stats, alive-masked via the ``ps_alive`` param)
+and Level-2 device block skip (per-block zone verdicts, static-bound
+candidate compaction, gathered filter+aggregation) must answer EXACTLY like
+the force-dense path (``SET useBlockSkip = false``) and the host executor,
+across EQ/IN/RANGE/AND/OR/NOT on dict and raw columns, sealed + consuming
+segments, solo and 8-dev mesh, and coalesced cohorts whose members prune
+different segment subsets — while the scan stats get honest (entries
+scanned counts only gathered rows, numBlocksPruned/numSegmentsPrunedByServer
+surface the pruning).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import (
+    ZONE_BLOCK_ROWS,
+    ImmutableSegment,
+    build_zone_map,
+)
+
+N_SEG = 3
+ROWS = 20_000  # pad_to 20480 = 5 zone blocks per segment
+
+
+def _make_cols(rng, n, seg_idx):
+    """Time-ordered layout: ``ts`` ascends globally across segments and
+    ``k`` is block-clustered (a new value every 5000 rows) — the shapes
+    zone maps discriminate on. ``tag``/``m``/``f`` are unclustered."""
+    base = seg_idx * n
+    return {
+        "ts": (base + np.arange(n)).astype(np.int64),
+        "k": np.array([f"k{(base + i) // 5000:04d}" for i in range(n)]),
+        "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        "m": rng.integers(0, 10_000, n).astype(np.int32),
+        "f": np.round(rng.uniform(0, 100, n), 3),
+    }
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    rng = np.random.default_rng(29)
+    schema = Schema.build(
+        name="t",
+        dimensions=[("ts", DataType.LONG), ("k", DataType.STRING),
+                    ("tag", DataType.STRING)],
+        metrics=[("m", DataType.INT), ("f", DataType.DOUBLE)],
+    )
+    cfg = TableConfig(
+        table_name="t",
+        indexing=IndexingConfig(no_dictionary_columns=["ts"]),
+    )
+    base = tmp_path_factory.mktemp("bskip")
+    segs, all_cols = [], []
+    for i in range(N_SEG):
+        cols = _make_cols(rng, ROWS, i)
+        all_cols.append(cols)
+        build_segment(schema, cols, str(base / f"s{i}"), cfg, f"s{i}")
+        segs.append(ImmutableSegment(str(base / f"s{i}")))
+    return segs, all_cols
+
+
+def _engine(segs, device="auto"):
+    eng = QueryEngine() if device == "auto" \
+        else QueryEngine(device_executor=device)
+    for s in segs:
+        eng.add_segment("t", s)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines(tables):
+    segs, all_cols = tables
+    return _engine(segs), _engine(segs, device=None), all_cols
+
+
+# EQ / IN / RANGE / AND / OR / NOT over dict (k, tag) and raw (ts, m)
+# columns; scalar and group-by shapes; selective, empty, and unselective.
+PARITY_QUERIES = [
+    "SELECT COUNT(*), SUM(m) FROM t WHERE ts BETWEEN 5000 AND 5999",
+    "SELECT COUNT(*), SUM(m), MIN(m), MAX(m) FROM t WHERE ts < 3000",
+    "SELECT COUNT(*) FROM t WHERE k = 'k0002'",
+    "SELECT COUNT(*), SUM(f) FROM t WHERE k IN ('k0001', 'k0009')",
+    "SELECT tag, COUNT(*), SUM(m) FROM t WHERE ts BETWEEN 10000 AND 30000 "
+    "GROUP BY tag ORDER BY tag",
+    "SELECT COUNT(*) FROM t WHERE ts > 15000 AND k = 'k0004'",
+    "SELECT COUNT(*) FROM t WHERE ts < 2000 OR ts > 55000",
+    "SELECT COUNT(*) FROM t WHERE NOT ts < 30000",
+    "SELECT COUNT(*) FROM t WHERE tag = 'b' AND ts BETWEEN 4096 AND 8191",
+    "SELECT k, COUNT(*) FROM t WHERE ts BETWEEN 4000 AND 21000 "
+    "GROUP BY k ORDER BY k",
+    # empty but not segment-prunable (each conjunct alone may match):
+    # exercises the all-false kernel paths on both forms
+    "SELECT COUNT(*), MIN(m), MAX(m) FROM t WHERE ts = 5000 AND ts = 9000",
+    # provably false everywhere (absent dictionary value): the launch is
+    # SKIPPED and neutral partials synthesized
+    "SELECT COUNT(*), MIN(m), MAX(m) FROM t WHERE k = 'zzz'",
+    # unselective: candidate count overflows the static bound, the
+    # in-kernel dense fallback engages
+    "SELECT COUNT(*), SUM(m) FROM t WHERE ts >= 0",
+]
+
+
+def _close(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, str) or isinstance(b, str):
+        return a == b
+    return np.isclose(float(a), float(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("sql", PARITY_QUERIES)
+def test_pruned_equals_dense_equals_host(engines, sql):
+    dev, host, _ = engines
+    r_skip = dev.execute(sql)
+    r_dense = dev.execute("SET useBlockSkip = false; " + sql)
+    r_host = host.execute(sql)
+    assert not r_skip.get("exceptions"), r_skip
+    assert not r_dense.get("exceptions"), r_dense
+    # pruned vs force-dense: EXACT (same kernels, same dtypes, pruning
+    # only removes provably-non-matching work)
+    assert r_skip["resultTable"] == r_dense["resultTable"], sql
+    assert r_skip["numDocsScanned"] == r_dense["numDocsScanned"]
+    assert r_skip["totalDocs"] == r_dense["totalDocs"]
+    # vs host: value-equal (device float columns are f32-narrowed)
+    rows_s, rows_h = r_skip["resultTable"]["rows"], r_host["resultTable"]["rows"]
+    assert len(rows_s) == len(rows_h), sql
+    for rs, rh in zip(rows_s, rows_h):
+        assert all(_close(a, b) for a, b in zip(rs, rh)), (sql, rs, rh)
+
+
+class TestStats:
+    def test_selective_range_prunes_blocks(self, engines):
+        dev, _, _ = engines
+        sql = "SELECT COUNT(*), SUM(m) FROM t WHERE ts BETWEEN 5000 AND 5999"
+        r = dev.execute(sql)
+        rd = dev.execute("SET useBlockSkip = false; " + sql)
+        assert r["numBlocksPruned"] > 0
+        assert rd["numBlocksPruned"] == 0
+        # honest scan accounting: only gathered blocks' rows counted
+        assert 0 < r["numEntriesScannedInFilter"] \
+            < rd["numEntriesScannedInFilter"]
+        # Level 1 also fires: the window lives entirely in segment 0
+        assert r["numSegmentsPrunedByServer"] == N_SEG - 1
+        assert rd["numSegmentsPrunedByServer"] == N_SEG - 1
+        assert r["numSegmentsProcessed"] == 1
+
+    def test_fully_pruned_skips_launch(self, engines):
+        dev, _, _ = engines
+        r = dev.execute("SELECT COUNT(*) FROM t WHERE k = 'zzz'")
+        assert r["resultTable"]["rows"][0][0] == 0
+        assert r["numSegmentsPrunedByServer"] == N_SEG
+        assert r["numDocsScanned"] == 0
+        assert r["numEntriesScannedInFilter"] == 0
+        # pruned segments still count toward totalDocs
+        assert r["totalDocs"] == N_SEG * ROWS
+
+    def test_overflow_falls_back_dense(self, engines):
+        dev, _, all_cols = engines
+        # matches every block: candidates > the static bound -> dense
+        r = dev.execute("SELECT COUNT(*) FROM t WHERE ts >= 0")
+        assert r["numBlocksPruned"] == 0
+        assert r["resultTable"]["rows"][0][0] == N_SEG * ROWS
+
+    def test_candidate_bound_boundary(self, tables):
+        """Sweep window sizes across the static candidate bound: every
+        width must stay parity-exact whether the skip or the overflow
+        (dense) branch runs."""
+        segs, all_cols = tables
+        dev = _engine(segs)
+        ts = np.concatenate([c["ts"] for c in all_cols])
+        m = np.concatenate([c["m"] for c in all_cols])
+        # total blocks = 15, bound = ceil(15/16) = 1: windows spanning
+        # 1, 2, and 8 blocks cross the bound in both directions
+        for width in (ZONE_BLOCK_ROWS // 2, ZONE_BLOCK_ROWS,
+                      2 * ZONE_BLOCK_ROWS, 8 * ZONE_BLOCK_ROWS):
+            lo, hi = 1000, 1000 + width - 1
+            r = dev.execute(
+                f"SELECT COUNT(*), SUM(m) FROM t "
+                f"WHERE ts BETWEEN {lo} AND {hi}")
+            want = (ts >= lo) & (ts <= hi)
+            assert r["resultTable"]["rows"][0][0] == int(want.sum()), width
+            assert int(float(r["resultTable"]["rows"][0][1])) == \
+                int(m[want].sum()), width
+
+
+class TestMesh:
+    @pytest.mark.parametrize("sql", PARITY_QUERIES)
+    def test_mesh_parity(self, tables, sql):
+        from pinot_tpu.engine.device import DeviceExecutor
+        from pinot_tpu.parallel.mesh import make_mesh
+
+        segs, _ = tables
+        mesh_eng = _engine(segs, DeviceExecutor(mesh=make_mesh(8)))
+        host_eng = _engine(segs, None)
+        rm = mesh_eng.execute(sql)
+        rh = host_eng.execute(sql)
+        assert not rm.get("exceptions"), rm
+        rows_m, rows_h = rm["resultTable"]["rows"], rh["resultTable"]["rows"]
+        assert len(rows_m) == len(rows_h), sql
+        for a, b in zip(rows_m, rows_h):
+            assert all(_close(x, y) for x, y in zip(a, b)), (sql, a, b)
+
+
+class TestCohorts:
+    def test_cohort_members_prune_different_segments(self, tables):
+        """Coalesced cohort whose members' literals prune DIFFERENT
+        segment subsets: ps_alive is a per-member param inside the vmapped
+        launch, so every member must still answer exactly like its solo
+        run."""
+        segs, all_cols = tables
+        eng = _engine(segs)
+        # one window per segment + one spanning two: same template,
+        # different alive vectors
+        windows = [(100, 1500), (21000, 22000), (45000, 46000),
+                   (19000, 41000)]
+        sqls = [f"SELECT COUNT(*), SUM(m) FROM t "
+                f"WHERE ts BETWEEN {lo} AND {hi}" for lo, hi in windows]
+        expected = [eng.execute(s) for s in sqls]  # solo (warm + oracle)
+        co = eng.device.coalescer
+        co.force = True
+        co.window_s = 0.05
+        c0 = co.queries_coalesced
+        try:
+            barrier = threading.Barrier(len(sqls))
+            got = [None] * len(sqls)
+            errs = []
+
+            def worker(i):
+                try:
+                    barrier.wait()
+                    got[i] = eng.execute(sqls[i])
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(len(sqls))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            co.force = False
+        assert not errs, errs
+        for i, (g, e) in enumerate(zip(got, expected)):
+            assert g["resultTable"] == e["resultTable"], sqls[i]
+            assert g["numDocsScanned"] == e["numDocsScanned"], sqls[i]
+        assert co.queries_coalesced > c0, "no query joined a cohort"
+
+
+class TestConsumingSegments:
+    def test_chunklet_batch_prunes(self, tmp_path):
+        """Consuming segments prune too: promoted chunklets carry their
+        own zone maps (refreshed per promotion), ride the chunklet device
+        batch, and a selective ts range skips their blocks — answers
+        staying identical to the all-host scan."""
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="rt",
+            dimensions=[("ts", DataType.LONG), ("tag", DataType.STRING)],
+            metrics=[("m", DataType.INT)],
+        )
+        cfg = TableConfig(
+            table_name="rt",
+            indexing=IndexingConfig(no_dictionary_columns=["ts"]),
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=8192,
+                                     device_min_rows=8192),
+        )
+        rng = np.random.default_rng(41)
+        n = 40_000
+        tags = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+        ms = rng.integers(0, 1000, n)
+        rows = [{"ts": int(i), "tag": str(t), "m": int(v)}
+                for i, (t, v) in enumerate(zip(tags, ms))]
+        seg = MutableSegment(schema, "rt__0__0__0", cfg)
+        for i in range(0, n, 8192):
+            seg.index_batch(rows[i:i + 8192])
+            seg.chunklet_index.promote()
+        assert seg.chunklet_index.chunklets, "no chunklets promoted"
+
+        dev = QueryEngine()
+        dev.add_segment("rt", seg)
+        host = QueryEngine(device_executor=None)
+        host.add_segment("rt", seg)
+        for sql in (
+            "SELECT COUNT(*), SUM(m) FROM rt WHERE ts BETWEEN 3000 AND 3999",
+            "SELECT tag, COUNT(*) FROM rt WHERE ts < 2500 "
+            "GROUP BY tag ORDER BY tag",
+            "SELECT COUNT(*) FROM rt WHERE ts BETWEEN 8192 AND 12287 "
+            "AND tag = 'b'",
+        ):
+            rd, rh = dev.execute(sql), host.execute(sql)
+            assert not rd.get("exceptions"), rd
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+        r = dev.execute(
+            "SELECT COUNT(*) FROM rt WHERE ts BETWEEN 3000 AND 3999")
+        assert r["numBlocksPruned"] > 0  # chunklet zone maps engaged
+
+
+class TestKernelNeutralFills:
+    def test_neutral_outs_match_all_masked_kernel(self):
+        """The fully-pruned synthesized outputs must equal what the dense
+        kernel produces with every segment alive-masked — bit-for-bit, so
+        full-prune skip vs force-dense parity holds for every agg fill."""
+        import jax
+        import jax.numpy as jnp
+
+        from pinot_tpu.engine.device import (
+            _neutral_outs,
+            _out_layout,
+            build_pipeline,
+        )
+
+        template = (
+            "agg",
+            ("eq_raw", ("raw", "v"), "pr0"),
+            (), (),
+            (("count", None, None),
+             ("sum", ("raw", "v"), (None, None)),
+             ("min", ("raw", "v"), None),
+             ("max", ("raw", "v"), None)),
+            0, False,
+        )
+        fn = build_pipeline(template, mm_mode="off")
+        cols = {"v": jnp.asarray(
+            np.arange(2 * ZONE_BLOCK_ROWS, dtype=np.int32).reshape(2, -1))}
+        n_docs = jnp.asarray(np.array([4000, 3000], dtype=np.int32))
+        params = {"pr0": jnp.asarray(np.int32(7)),
+                  "ps_alive": jnp.zeros(2, dtype=bool)}
+        outs = {k: np.asarray(v)
+                for k, v in jax.jit(fn)(cols, n_docs, params).items()}
+        layout = _out_layout(jax.eval_shape(fn, cols, n_docs, params))
+        synth = _neutral_outs(layout)
+        assert set(outs) == set(synth)
+        for k in outs:
+            assert np.array_equal(outs[k].astype(synth[k].dtype),
+                                  synth[k]), k
+
+
+class TestZoneMapFormat:
+    def test_creator_persists_zone_maps(self, tables):
+        segs, all_cols = tables
+        zm = segs[0].zone_map("m")
+        assert zm is not None
+        want = build_zone_map(np.asarray(segs[0].forward("m")))
+        np.testing.assert_array_equal(np.asarray(zm), want)
+        # dict column: local-id space
+        zmk = segs[0].zone_map("k")
+        fwd = np.asarray(segs[0].forward("k"))
+        np.testing.assert_array_equal(
+            np.asarray(zmk), build_zone_map(fwd))
+
+    def test_missing_zone_map_recomputes(self, tmp_path):
+        """Pre-zone-map segments (no .zmap.npy) still prune: the batch
+        loader recomputes from the column block."""
+        import os
+
+        schema = Schema.build(
+            name="t2", dimensions=[("ts", DataType.LONG)],
+            metrics=[("m", DataType.INT)])
+        cfg = TableConfig(
+            table_name="t2",
+            indexing=IndexingConfig(no_dictionary_columns=["ts"]))
+        n = 10_000
+        cols = {"ts": np.arange(n, dtype=np.int64),
+                "m": np.arange(n, dtype=np.int32) % 97}
+        build_segment(schema, cols, str(tmp_path / "s0"), cfg, "s0")
+        for f in os.listdir(tmp_path / "s0"):
+            if f.endswith(".zmap.npy"):
+                os.unlink(tmp_path / "s0" / f)
+        seg = ImmutableSegment(str(tmp_path / "s0"))
+        assert seg.zone_map("ts") is None
+        eng = QueryEngine()
+        eng.add_segment("t2", seg)
+        sql = "SELECT COUNT(*) FROM t2 WHERE ts BETWEEN 100 AND 199"
+        r = eng.execute(sql)
+        assert r["resultTable"]["rows"][0][0] == 100
+        assert r["numBlocksPruned"] > 0
+
+
+class TestHostBloomShortCircuit:
+    def test_bloom_short_circuits_before_decode(self, baseball_segment):
+        """EQ/IN on a bloom-indexed column proves a segment empty before
+        the forward index is read — numEntriesScannedInFilter stays 0 even
+        under an OR (which the segment-level pruner cannot touch)."""
+        from pinot_tpu.engine.host import SegmentEvaluator
+        from pinot_tpu.query.context import (
+            Expression,
+            Predicate,
+            PredicateType,
+        )
+
+        ev = SegmentEvaluator(baseball_segment)
+        p = Predicate(PredicateType.EQ,
+                      Expression.identifier("playerName"),
+                      value="nonexistent_player")
+        mask = ev.predicate_mask(p)
+        assert not mask.any()
+        assert ev.entries_scanned_in_filter == 0
+        p_in = Predicate(PredicateType.IN,
+                         Expression.identifier("playerName"),
+                         values=("ghost_1", "ghost_2"))
+        mask = ev.predicate_mask(p_in)
+        assert not mask.any()
+        assert ev.entries_scanned_in_filter == 0
+
+
+class TestExplainPruning:
+    def test_filter_empty_plan(self, engines):
+        dev, _, _ = engines
+        r = dev.execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM t WHERE k = 'zzz'")
+        ops = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("FILTER_EMPTY" in o for o in ops), ops
+        assert not any("FILTER_PREDICATE" in o for o in ops)
+
+    def test_partial_prune_line(self, engines):
+        dev, _, _ = engines
+        r = dev.execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM t "
+            "WHERE ts BETWEEN 5000 AND 5999")
+        ops = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("PRUNE(zone-map" in o for o in ops), ops

@@ -176,3 +176,79 @@ class TestBrokerPruning:
         expected = sum(int((c["store_id"] != 6).sum()) for c in all_cols)
         assert int(r["resultTable"]["rows"][0][0]) == expected
         assert r["numSegmentsPrunedByBroker"] == 0
+
+
+class TestValueStatsPruning:
+    """Per-column min/max pruning on NON-time columns (SegmentRecord
+    column_stats → broker/segment_pruner.py _stats_may_match)."""
+
+    def _value_table(self, tmp_path, controller, servers):
+        schema = Schema.build(
+            name="sales",
+            dimensions=[("region", DataType.STRING)],
+            metrics=[("amount", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="sales", replication=1)
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(9)
+        all_cols = []
+        for i in range(4):
+            # amount ranges are DISJOINT per segment: [i*1000, i*1000+999]
+            cols = {
+                "region": np.array(["east", "west"])[rng.integers(0, 2, 300)],
+                "amount": (i * 1000 + rng.integers(0, 1000, 300)).astype(
+                    np.int32),
+            }
+            cols["amount"][0] = i * 1000        # pin the min
+            cols["amount"][1] = i * 1000 + 999  # pin the max
+            all_cols.append(cols)
+            d = str(tmp_path / f"sseg{i}")
+            build_segment(schema, cols, d, cfg, f"sales_s{i}")
+            controller.upload_segment("sales", d)
+        registry = servers[0].registry
+
+        def loaded():
+            return (
+                sum(len(s.engine.tables["sales_OFFLINE"].segments)
+                    if s.engine.tables.get("sales_OFFLINE") else 0
+                    for s in servers) >= 4
+                and len(registry.external_view("sales_OFFLINE")) >= 4
+            )
+
+        assert wait_until(loaded)
+        return all_cols
+
+    def test_range_prunes_by_value(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        all_cols = self._value_table(tmp_path, controller, servers)
+        r = broker.execute("SELECT COUNT(*) FROM sales WHERE amount >= 2500")
+        expected = sum(int((c["amount"] >= 2500).sum()) for c in all_cols)
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        # segments 0 and 1 (amount < 2000) provably cannot match
+        assert r["numSegmentsPrunedByBroker"] == 2
+        assert r["numSegmentsPrunedByValue"] == 2
+
+    def test_eq_and_in_prune_by_value(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        all_cols = self._value_table(tmp_path, controller, servers)
+        r = broker.execute("SELECT COUNT(*) FROM sales WHERE amount = 1500")
+        expected = sum(int((c["amount"] == 1500).sum()) for c in all_cols)
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == 3
+        assert r["numSegmentsPrunedByValue"] == 3
+
+        r = broker.execute(
+            "SELECT COUNT(*) FROM sales WHERE amount IN (500, 3500)")
+        expected = sum(
+            int(np.isin(c["amount"], [500, 3500]).sum()) for c in all_cols)
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByBroker"] == 2
+
+    def test_incomparable_literal_conservative(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        all_cols = self._value_table(tmp_path, controller, servers)
+        # string literal against int stats: may-match, never mis-pruned
+        r = broker.execute("SELECT COUNT(*) FROM sales WHERE region = 'east'")
+        expected = sum(int((c["region"] == "east").sum()) for c in all_cols)
+        assert int(r["resultTable"]["rows"][0][0]) == expected
+        assert r["numSegmentsPrunedByValue"] == 0
