@@ -82,64 +82,104 @@ def segment_device_eligible(seg) -> bool:
 # ---------------------------------------------------------------------------
 
 
-def _eval_expr(tpl, cols, params):
+def _col_width(widths, key):
+    """Width-plan tuple (dtype, bits, has_offset, wide) for a cols key, or
+    None (legacy wide plane / keys the planner doesn't narrow)."""
+    return widths.get(key) if widths else None
+
+
+def _ids_col(cols, key, widths):
+    """Dict-id plane at LOGICAL width: sub-byte plans unpack in-register
+    (ops/masks.py unpack_subbyte); byte-aligned narrow ids pass through —
+    predicates/group arithmetic consume them at native width."""
+    v = cols[key]
+    w = _col_width(widths, key)
+    if w is not None and w[1]:
+        return mask_ops.unpack_subbyte(v, w[1])
+    return v
+
+
+def _data_col(cols, params, key, widths):
+    """Raw / decoded (dv::) value plane DECODED to its plan's wide dtype:
+    frame-of-reference planes add the per-batch "fo::<key>" offset param.
+    Both the cast and the add are register-level (XLA fuses them into the
+    consumer); the HBM read stays at the stored width. Decoding always
+    widens — two narrow planes multiplied in an expression must not wrap
+    at the storage width."""
+    v = cols[key]
+    w = _col_width(widths, key)
+    if w is None or not w[3]:
+        return v
+    v = v.astype(jnp.dtype(w[3]))
+    if w[2]:
+        fo = params.get("fo::" + key)
+        if fo is not None:
+            v = v + fo
+    return v
+
+
+def _eval_expr(tpl, cols, params, widths=None):
     kind = tpl[0]
     if kind == "lit":
         return params[tpl[1]]
     if kind == "raw":
-        return cols[tpl[1]]
+        return _data_col(cols, params, tpl[1], widths)
     if kind == "dictval":
         # decoded on the host at upload (BatchContext.decoded_column) — a
         # device (C,)-LUT gather here costs ~80ms/query at 12M docs on v5e
-        return cols["dv::" + tpl[1]]
+        return _data_col(cols, params, "dv::" + tpl[1], widths)
     if kind == "cast":
-        return get_function("cast").jnp_fn(_eval_expr(tpl[1], cols, params), tpl[2])
+        return get_function("cast").jnp_fn(
+            _eval_expr(tpl[1], cols, params, widths), tpl[2])
     fn = get_function(kind)
-    args = [_eval_expr(a, cols, params) for a in tpl[1:]]
+    args = [_eval_expr(a, cols, params, widths) for a in tpl[1:]]
     return fn.jnp_fn(*args)
 
 
-def _eval_filter(tpl, cols, params, shape):
+def _eval_filter(tpl, cols, params, shape, widths=None):
     kind = tpl[0]
     if kind == "true":
         return jnp.ones(shape, dtype=bool)
     if kind == "false":
         return jnp.zeros(shape, dtype=bool)
     if kind == "and":
-        m = _eval_filter(tpl[1], cols, params, shape)
+        m = _eval_filter(tpl[1], cols, params, shape, widths)
         for c in tpl[2:]:
-            m &= _eval_filter(c, cols, params, shape)
+            m &= _eval_filter(c, cols, params, shape, widths)
         return m
     if kind == "or":
-        m = _eval_filter(tpl[1], cols, params, shape)
+        m = _eval_filter(tpl[1], cols, params, shape, widths)
         for c in tpl[2:]:
-            m |= _eval_filter(c, cols, params, shape)
+            m |= _eval_filter(c, cols, params, shape, widths)
         return m
     if kind == "not":
-        return ~_eval_filter(tpl[1], cols, params, shape)
+        return ~_eval_filter(tpl[1], cols, params, shape, widths)
     if kind == "mv_any":
         # per-entry mask over the (S, L, K) id block, -1 padding masked out,
         # reduced match-any over K (ForwardIndexReader.getDictIdMV semantics)
         ids = cols[tpl[1]]
-        m = _eval_filter(tpl[2], cols, params, ids.shape)
+        m = _eval_filter(tpl[2], cols, params, ids.shape, widths)
         return jnp.any(m & (ids >= 0), axis=-1)
     if kind == "eq_dict":
-        return mask_ops.eq_dict(cols[tpl[1]], params[tpl[2]])
+        return mask_ops.eq_dict(_ids_col(cols, tpl[1], widths), params[tpl[2]])
     if kind == "in_dict":
-        return mask_ops.in_dict(cols[tpl[1]], params[tpl[2]])
+        return mask_ops.in_dict(_ids_col(cols, tpl[1], widths), params[tpl[2]])
     if kind == "range_dict":
-        return mask_ops.range_dict(cols[tpl[1]], params[tpl[2]], params[tpl[3]])
+        return mask_ops.range_dict(
+            _ids_col(cols, tpl[1], widths), params[tpl[2]], params[tpl[3]])
     if kind == "lut_dict":
-        return mask_ops.lut_dict(cols[tpl[1]], params[tpl[2]])
+        return mask_ops.lut_dict(_ids_col(cols, tpl[1], widths), params[tpl[2]])
     if kind == "eq_raw":
-        return mask_ops.eq_raw(_eval_expr(tpl[1], cols, params), params[tpl[2]])
+        return mask_ops.eq_raw(
+            _eval_expr(tpl[1], cols, params, widths), params[tpl[2]])
     if kind == "in_raw":
-        return mask_ops.in_raw(_eval_expr(tpl[1], cols, params), params[tpl[2]])
+        return mask_ops.in_raw(
+            _eval_expr(tpl[1], cols, params, widths), params[tpl[2]])
     if kind == "range_raw":
         _, expr_tpl, klo, khi, has_lo, has_hi, lo_inc, hi_inc = tpl
         return mask_ops.range_raw(
-            _eval_expr(expr_tpl, cols, params), params[klo], params[khi],
-            lo_inc, hi_inc, has_lo, has_hi,
+            _eval_expr(expr_tpl, cols, params, widths), params[klo],
+            params[khi], lo_inc, hi_inc, has_lo, has_hi,
         )
     raise AssertionError(f"bad filter template node {kind}")
 
@@ -188,7 +228,8 @@ def _hll_regs(slot, rho, num_groups, log2m, mm_mode):
     return regs[: num_groups * m].reshape(num_groups, m).astype(jnp.int8)
 
 
-def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs):
+def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs,
+                    widths=None):
     """Route COUNT/SUM/AVG through ONE factored one-hot matmul launch
     (ops/groupby_mm.py) when eligible. Fills outs["gcount"] +
     outs[f"a{i}_sum"] and returns the set of agg indexes handled; scatter
@@ -210,7 +251,7 @@ def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs):
         if name not in ("sum", "avg") or not isinstance(extra, tuple):
             continue
         nplanes_int = extra[0]
-        v = _eval_expr(argt, cols, params)
+        v = _eval_expr(argt, cols, params, widths)
         if jnp.issubdtype(v.dtype, jnp.integer):
             if nplanes_int is None:  # unknown range → exact scatter instead
                 continue
@@ -477,6 +518,37 @@ def _neutral_outs(layout) -> dict:
             for name, dt, shp, _which, _off, _size in layout}
 
 
+def _width_audit(ctx, cols: dict, widths: dict) -> None:
+    """PINOT_TPU_WIDTH_AUDIT=1 debug mode: after the column gather, assert
+    no plane silently upcast past its planned storage dtype and log the
+    per-column width table (plane dtype, sub-byte bits, FOR offset,
+    register decode target, resident bytes). EXPLAIN renders the same
+    table (engine/explain.py)."""
+    import logging
+
+    rows = []
+    for key, sig in sorted(widths.items()):
+        dt, bits, has_off, wide = sig
+        arr = cols.get(key)
+        if arr is None:
+            continue
+        got = np.dtype(arr.dtype)
+        planned = np.dtype(np.uint8) if bits else np.dtype(dt)
+        if got != planned:
+            raise AssertionError(
+                f"width audit: plane {key!r} upcast to {got} past its "
+                f"planned {planned} (plan {sig})")
+        rows.append(
+            f"{key}: {np.dtype(dt).name}"
+            + (f" packed={bits}b" if bits else "")
+            + (" for-offset" if has_off else "")
+            + (f" wide={np.dtype(wide).name}" if wide else "")
+            + f" bytes={arr.nbytes}")
+    logging.getLogger("pinot_tpu.device").info(
+        "width audit (%d segments, pad_to=%d):\n  %s",
+        ctx.S, ctx.pad_to, "\n  ".join(rows) if rows else "(no data planes)")
+
+
 def _unpack_outs(bufs: dict, layout) -> dict:
     outs = {}
     for name, dt, shp, which, off, size in layout:
@@ -489,7 +561,8 @@ def _unpack_outs(bufs: dict, layout) -> dict:
 
 
 def build_pipeline(template, mm_mode: str = "auto",
-                   sorted_hll_ok: bool = False, blockskip: bool = False):
+                   sorted_hll_ok: bool = False, blockskip: bool = False,
+                   widths=None):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
     ``mm_mode``: "auto" → the factored one-hot matmul kernel
@@ -514,6 +587,13 @@ def build_pipeline(template, mm_mode: str = "auto",
     It is a PARAM, not part of the batch: the (S, L) batch, its compiled
     templates, and the cohort coalescer key stay stable across queries
     that prune different segment subsets.
+
+    ``widths``: the batch's column width plan — {cols key: (dtype, bits,
+    has_offset, wide)} from BatchContext.width_plan (None = every plane at
+    its legacy wide dtype, the pre-narrowing form __graft_entry__ and the
+    kernel-parity tests build directly). The executor folds the same
+    mapping into its pipeline cache key, so one compiled template serves
+    exactly the batches that share its width plan.
     """
     shape, filter_tpl, group_cols, group_cards, aggs, sorted_k, _final = template
     mm_mode = _resolve_mm_mode(mm_mode)
@@ -521,14 +601,22 @@ def build_pipeline(template, mm_mode: str = "auto",
     for c in group_cards:
         num_groups *= c
 
+    def _kfactor(key: str) -> int:
+        """ids per stored byte-axis element (sub-byte plans pack 8//bits
+        ids per uint8; everything else is 1:1)."""
+        w = _col_width(widths, key)
+        return 8 // w[1] if (w is not None and w[1]) else 1
+
     def pipeline(cols, n_docs, params):
         # zone cols are (S, NB) and sk:: sorted projections are 1-D — the
-        # (S, L) shape inference must skip both
+        # (S, L) shape inference must skip both; sub-byte planes store
+        # L // factor bytes, so the LOGICAL row count multiplies back
         data_cols = {k: v for k, v in cols.items()
                      if not k.startswith((bs_ops.ZLO, bs_ops.ZHI))}
-        any_col = next(v for k, v in data_cols.items()
-                       if not k.startswith("sk::"))
-        S, L = any_col.shape[:2]  # MV blocks are (S, L, K); masks are (S, L)
+        any_key = next(k for k in data_cols if not k.startswith("sk::"))
+        any_col = data_cols[any_key]
+        S = any_col.shape[0]  # MV blocks are (S, L, K); masks are (S, L)
+        L = any_col.shape[1] * _kfactor(any_key)
         alive = params.get("ps_alive")
         alive_b = jnp.ones((S,), dtype=bool) if alive is None \
             else alive.astype(bool)
@@ -550,7 +638,8 @@ def build_pipeline(template, mm_mode: str = "auto",
         def dense(blocks_total):
             valid = mask_ops.valid_mask(n_docs, L, batched=True) \
                 & alive_b[:, None]
-            mask = _eval_filter(filter_tpl, data_cols, params, (S, L)) & valid
+            mask = _eval_filter(filter_tpl, data_cols, params, (S, L),
+                                widths) & valid
             seg_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)
             outs = _stat_outs(
                 seg_matched, jnp.sum(jnp.where(alive_b, nd64, 0)),
@@ -563,7 +652,8 @@ def build_pipeline(template, mm_mode: str = "auto",
         # ---- zone-map block skip (ops/blockskip.py) ----------------------
         NB = L // R
         blocks_total = jnp.sum(jnp.where(alive_b, (nd64 + R - 1) // R, 0))
-        verdict = bs_ops.zone_verdict(filter_tpl, cols, params, (S, NB))
+        verdict = bs_ops.zone_verdict(filter_tpl, cols, params, (S, NB),
+                                      widths)
         block_start = jnp.arange(NB, dtype=jnp.int32) * R
         verdict = verdict & (block_start[None, :] < n_docs[:, None]) \
             & alive_b[:, None]
@@ -578,9 +668,13 @@ def build_pipeline(template, mm_mode: str = "auto",
             row_idx = ((cand % NB) * R)[:, None] \
                 + jnp.arange(R, dtype=jnp.int32)[None, :]
             rvalid = cand_valid[:, None] & (row_idx < n_docs[seg_of][:, None])
-            g_cols = {k: bs_ops.gather_blocks(v, cand, NB, R)
+            # sub-byte planes gather at their PACKED block width (R // f
+            # bytes per block; R = 4096 divides by every pack factor) and
+            # unpack post-gather at the access site (_ids_col)
+            g_cols = {k: bs_ops.gather_blocks(v, cand, NB, R // _kfactor(k))
                       for k, v in data_cols.items()}
-            mask = _eval_filter(filter_tpl, g_cols, params, (B, R)) & rvalid
+            mask = _eval_filter(filter_tpl, g_cols, params, (B, R),
+                                widths) & rvalid
             block_matched = jnp.sum(mask, axis=1, dtype=jnp.int64)
             seg_matched = jnp.zeros(S + 1, dtype=jnp.int64).at[
                 jnp.where(cand_valid, seg_of, S)].add(block_matched)[:S]
@@ -638,7 +732,7 @@ def build_pipeline(template, mm_mode: str = "auto",
             # merge per-shard tables (merge_tables) — the old basis was
             # not mesh-combinable at all.
             K = sorted_k
-            per_col = [cols[c] for c in group_cols]
+            per_col = [_ids_col(cols, c, widths) for c in group_cols]
             key = radix_ops.pack_keys(per_col, group_cards, mask)
             # dedup payloads by argument template: MIN(x)+MAX(x)+AVG(x)
             # must carry ONE copy of x through the level-1 sort, not three
@@ -648,7 +742,7 @@ def build_pipeline(template, mm_mode: str = "auto",
                 if name == "count":
                     continue
                 if argt not in pname_of:
-                    v = _eval_expr(argt, cols, params)
+                    v = _eval_expr(argt, cols, params, widths)
                     # integer args accumulate exactly in int64 (the host /
                     # dense paths are exact; per-doc f64 adds would round)
                     as_int = jnp.issubdtype(v.dtype, jnp.integer)
@@ -693,10 +787,10 @@ def build_pipeline(template, mm_mode: str = "auto",
 
         if shape == "groupby":
             # columns are already global ids: the group key IS the column
-            per_col = [cols[c] for c in group_cols]
+            per_col = [_ids_col(cols, c, widths) for c in group_cols]
             gid = agg_ops.group_ids_combine(per_col, group_cards, mask, num_groups)
             mm_done = _try_mm_groupby(
-                aggs, gid, cols, params, num_groups, mm_mode, outs
+                aggs, gid, cols, params, num_groups, mm_mode, outs, widths
             )
             if "gcount" not in outs:
                 outs["gcount"] = agg_ops.group_count(gid, num_groups)
@@ -705,22 +799,25 @@ def build_pipeline(template, mm_mode: str = "auto",
                 if i in mm_done or name == "count":
                     pass  # produced by the matmul kernel / gcount reused
                 elif name in ("sum", "avg"):
-                    v = _eval_expr(argt, cols, params)
+                    v = _eval_expr(argt, cols, params, widths)
                     rpb = _rows_per_block(v, _legacy_rpb(extra))
                     outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups, rpb)
                 elif name == "min":
-                    v = _eval_expr(argt, cols, params)
+                    v = _eval_expr(argt, cols, params, widths)
                     outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
                 elif name == "max":
-                    v = _eval_expr(argt, cols, params)
+                    v = _eval_expr(argt, cols, params, widths)
                     outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
                 elif name == "minmaxrange":
-                    v = _eval_expr(argt, cols, params)
+                    v = _eval_expr(argt, cols, params, widths)
                     outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
                     outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
                 elif name == "distinctcount":
                     card = extra
-                    sub = jnp.clip(cols[argt], 0, card - 1)
+                    # ids widen in-register: uint8 * weak-int arithmetic
+                    # would wrap at the storage width
+                    sub = jnp.clip(_ids_col(cols, argt, widths), 0,
+                                   card - 1).astype(jnp.int32)
                     gid2 = jnp.where(mask, gid * card + sub, num_groups * card)
                     pres = jnp.zeros(num_groups * card + 1, dtype=jnp.int8)
                     pres = pres.at[gid2.reshape(-1)].max(1)
@@ -764,8 +861,8 @@ def build_pipeline(template, mm_mode: str = "auto",
                     regs = regs.at[gid2].max(planes.reshape(-1, m))
                     outs[f"{k}_regs"] = regs[:num_groups]
                 elif name in ("firstwithtime", "lastwithtime"):
-                    v = _eval_expr(argt[0], cols, params)
-                    t = _eval_expr(argt[1], cols, params)
+                    v = _eval_expr(argt[0], cols, params, widths)
+                    t = _eval_expr(argt[1], cols, params, widths)
                     first = name == "firstwithtime"
                     tb, vb = agg_ops.group_arg_time(gid, v, t, num_groups, first)
                     suff = "tmin" if first else "tmax"
@@ -779,19 +876,22 @@ def build_pipeline(template, mm_mode: str = "auto",
             if name == "count":
                 pass  # doc_count reused
             elif name in ("sum", "avg"):
-                v = _eval_expr(argt, cols, params)
+                v = _eval_expr(argt, cols, params, widths)
                 outs[f"{k}_sum"] = agg_ops.agg_sum(v, mask)
             elif name == "min":
-                outs[f"{k}_min"] = agg_ops.agg_min(_eval_expr(argt, cols, params), mask)
+                outs[f"{k}_min"] = agg_ops.agg_min(
+                    _eval_expr(argt, cols, params, widths), mask)
             elif name == "max":
-                outs[f"{k}_max"] = agg_ops.agg_max(_eval_expr(argt, cols, params), mask)
+                outs[f"{k}_max"] = agg_ops.agg_max(
+                    _eval_expr(argt, cols, params, widths), mask)
             elif name == "minmaxrange":
-                v = _eval_expr(argt, cols, params)
+                v = _eval_expr(argt, cols, params, widths)
                 outs[f"{k}_min"] = agg_ops.agg_min(v, mask)
                 outs[f"{k}_max"] = agg_ops.agg_max(v, mask)
             elif name == "distinctcount":
                 card = extra
-                sub = jnp.clip(cols[argt], 0, card - 1)
+                sub = jnp.clip(_ids_col(cols, argt, widths), 0,
+                               card - 1).astype(jnp.int32)
                 slot = jnp.where(mask, sub, card)
                 outs[f"{k}_pres"] = agg_ops.distinct_presence(slot, card)
             elif name == "distinctcounthll":
@@ -807,8 +907,8 @@ def build_pipeline(template, mm_mode: str = "auto",
                 outs[f"{k}_regs"] = jnp.max(
                     jnp.where(mask[..., None], planes, 0), axis=(0, 1))
             elif name in ("firstwithtime", "lastwithtime"):
-                v = _eval_expr(argt[0], cols, params)
-                t = _eval_expr(argt[1], cols, params)
+                v = _eval_expr(argt[0], cols, params, widths)
+                t = _eval_expr(argt[1], cols, params, widths)
                 first = name == "firstwithtime"
                 tb, vb = agg_ops.agg_arg_time(v, t, mask, first)
                 suff = "tmin" if first else "tmax"
@@ -841,7 +941,8 @@ class DeviceExecutor:
         self.mm_mode = mm_mode
         self.num_groups_limit = max(1, num_groups_limit)
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
-        self._pipelines: dict = {}   # (template, mm_mode) -> entry dict
+        # (template, mm_mode, blockskip, width_sig) -> entry dict
+        self._pipelines: dict = {}
         # thread safety: server query threads launch/fetch concurrently —
         # one lock guards the caches, refcounts, and observability fields
         # (BatchContext guards its own lazy column materialization)
@@ -852,6 +953,13 @@ class DeviceExecutor:
         # cumulative host-link observability (bench reads deltas per query)
         self.fetch_bytes_total = 0
         self.fetch_leaves_total = 0
+        # batch-LRU / HBM observability: cache hit/miss/eviction counters
+        # plus per-batch resident bytes and bytes the width planning saved
+        # (hbm_stats — surfaced through server /metrics gauges and bench
+        # detail.narrow)
+        self.batch_hits = 0
+        self.batch_misses = 0
+        self.batch_evictions = 0
         # last-launch capture for kernel profiling (bench breakdown):
         # (pipeline, cols, n_docs, params, bytes_in). OPT-IN: retaining
         # the launch pins a whole batch's HBM past the batch cache's
@@ -920,6 +1028,9 @@ class DeviceExecutor:
             ctx = self._batches.pop(key, None)
             if ctx is None:
                 ctx = BatchContext(segments)
+                self.batch_misses += 1
+            else:
+                self.batch_hits += 1
             self._batches[key] = ctx
             if retain:
                 self._retain_launch(key)  # RLock: reentrant
@@ -953,6 +1064,52 @@ class DeviceExecutor:
                 if lru is None:
                     return  # everything else is pinned by in-flight launches
                 self._batches.pop(lru)
+                self.batch_evictions += 1
+
+    def _batch_list(self) -> list:
+        with self._lock:
+            return list(self._batches.values())
+
+    def resident_bytes(self) -> int:
+        """Total HBM bytes of cached batches (lock-free per-batch counter
+        reads; one short lock hold to snapshot the batch list)."""
+        return sum(b.device_bytes() for b in self._batch_list())
+
+    def narrow_saved_bytes(self) -> int:
+        """Total bytes the width planning saved vs the wide layout across
+        cached batches."""
+        return sum(b.narrow_saved_bytes() for b in self._batch_list())
+
+    def hbm_stats(self) -> dict:
+        """HBM / batch-LRU observability snapshot: per-batch resident
+        bytes and narrowing savings, cumulative hit/miss/eviction
+        counters, and the byte budget. Byte reads are the batches'
+        lock-free insert-time counters (see BatchContext.device_bytes), so
+        this never stalls a cold column build."""
+        with self._lock:
+            batches = list(self._batches.items())
+            snap = {
+                "batch_hits": self.batch_hits,
+                "batch_misses": self.batch_misses,
+                "batch_evictions": self.batch_evictions,
+            }
+        per_batch = [
+            {
+                "segments": len(key),
+                "resident_bytes": ctx.device_bytes(),
+                "narrow_saved_bytes": ctx.narrow_saved_bytes(),
+            }
+            for key, ctx in batches
+        ]
+        snap.update(
+            cached_batches=len(per_batch),
+            resident_bytes=sum(b["resident_bytes"] for b in per_batch),
+            narrow_saved_bytes=sum(
+                b["narrow_saved_bytes"] for b in per_batch),
+            max_cached_bytes=self.MAX_CACHED_BYTES,
+            batches=per_batch,
+        )
+        return snap
 
     def _retain_launch(self, key) -> None:
         with self._lock:
@@ -1173,8 +1330,6 @@ class DeviceExecutor:
             prunable, zone_cols = bs_ops.prunable_columns(filter_tpl)
             use_bs = prunable and bool(zone_cols)
 
-        entry = self._pipeline_entry(template, agg_tpls, final, use_bs)
-
         # Level-1 launch-time segment skip: evaluate the filter tree against
         # per-segment column stats (min/max, dictionary membership, bloom
         # for EQ/IN) with the broker pruner's conservative tri-state
@@ -1221,6 +1376,29 @@ class DeviceExecutor:
                 needed |= self._needed_columns(argt[1])
             elif argt is not None:
                 needed |= self._needed_columns(argt)
+        if not needed:  # COUNT(*) no filter: one column carries the shape
+            needed.add(segments[0].column_names()[0])
+
+        # per-column width plan (engine/params.py ColPlan): part of the
+        # pipeline cache key — narrow dict-id planes, frame-of-reference
+        # raw/decoded planes, and the opt-in sub-byte tier each compile
+        # their own template form, and cohort coalescing keys on the entry
+        # so same-plan queries still stack. FOR offsets ride as per-batch
+        # "fo::<key>" params (replicated on the mesh, stacked per cohort
+        # member) — the offset VALUE stays out of the compiled template.
+        widths = {}
+        for c in sorted(needed):
+            if c.startswith(("dv::",)) or not c.startswith(
+                    (bs_ops.ZLO, bs_ops.ZHI, "sk::", "hh::", "bp::", "mv::")):
+                plan = ctx.width_plan(c)
+                widths[c] = plan.sig()
+                if plan.offset is not None:
+                    params["fo::" + c] = jnp.asarray(
+                        np.asarray(plan.offset, dtype=np.dtype(plan.wide)))
+        wsig = tuple(sorted(widths.items()))
+
+        entry = self._pipeline_entry(template, agg_tpls, final, use_bs,
+                                     widths, wsig)
         cols = {}
         for c in sorted(needed):
             if c.startswith(bs_ops.ZLO):
@@ -1241,9 +1419,8 @@ class DeviceExecutor:
                 cols[c] = ctx.mv_column(c[4:])
             else:
                 cols[c] = ctx.column(c)
-        if not cols:  # COUNT(*) with no filter: still need one column for shape
-            first = segments[0].column_names()[0]
-            cols = {first: ctx.column(first)}
+        if os.environ.get("PINOT_TPU_WIDTH_AUDIT", "") not in ("", "0"):
+            _width_audit(ctx, cols, widths)
 
         n_docs = ctx.n_docs_dev
         if self.mesh is not None:
@@ -1281,20 +1458,25 @@ class DeviceExecutor:
 
     # ---- dispatch: solo vs coalesced -------------------------------------
     def _pipeline_entry(self, template, agg_tpls, final,
-                        blockskip: bool = False) -> dict:
-        """Compiled-pipeline cache entry for (template, mm_mode, blockskip):
-        the solo jitted pipeline, the pre-pack inner fn (eval_shape
-        layouts), the raw pipeline (cohort rebuilds compose vmap/mesh from
-        it), and the layout caches. Built under the executor lock so
-        concurrent same-template launches share ONE entry (the coalescer
-        keys on it)."""
+                        blockskip: bool = False, widths=None,
+                        wsig: tuple = ()) -> dict:
+        """Compiled-pipeline cache entry for (template, mm_mode, blockskip,
+        width-plan sig): the solo jitted pipeline, the pre-pack inner fn
+        (eval_shape layouts), the raw pipeline (cohort rebuilds compose
+        vmap/mesh from it), and the layout caches. The width sig keys the
+        entry because plane dtypes shape BOTH the compiled kernels and the
+        packed output layouts (a uint8 MIN emits a uint8 leaf); cohort
+        coalescing keys on id(entry), so only same-width queries stack.
+        Built under the executor lock so concurrent same-template launches
+        share ONE entry."""
         with self._lock:
-            entry = self._pipelines.get((template, self.mm_mode, blockskip))
+            entry = self._pipelines.get(
+                (template, self.mm_mode, blockskip, wsig))
             if entry is not None:
                 return entry
             raw = build_pipeline(template, self.mm_mode,
                                  sorted_hll_ok=(self.mesh is None),
-                                 blockskip=blockskip)
+                                 blockskip=blockskip, widths=widths)
             # cohorts vmap the pipeline over stacked member params, and a
             # vmapped lax.cond lowers to select — BOTH branches would run
             # for every member. Cohorts therefore ride the DENSE form;
@@ -1303,6 +1485,7 @@ class DeviceExecutor:
             # subsets stay correct.
             raw_cohort = build_pipeline(
                 template, self.mm_mode, sorted_hll_ok=(self.mesh is None),
+                widths=widths,
             ) if blockskip else raw
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
@@ -1326,7 +1509,7 @@ class DeviceExecutor:
                 "agg_tpls": agg_tpls, "final": final,
                 "layouts": {}, "cohort": None, "cohort_layouts": {},
             }
-            self._pipelines[(template, self.mm_mode, blockskip)] = entry
+            self._pipelines[(template, self.mm_mode, blockskip, wsig)] = entry
             return entry
 
     def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout):

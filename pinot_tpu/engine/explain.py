@@ -7,7 +7,41 @@ engine's shape dispatch + filter tree + backend choice.
 
 from __future__ import annotations
 
+import os
+
 from pinot_tpu.query.context import FilterNode, FilterNodeType, QueryContext
+
+
+def _width_lines(engine, q: QueryContext, segs, out: list) -> None:
+    """PINOT_TPU_WIDTH_AUDIT=1: render the device width plan per referenced
+    column (engine/params.py ColPlan) — the EXPLAIN face of the debug
+    width-audit mode. Best-effort: anything the device path would reject
+    simply renders no WIDTH lines (the host path has no width plan)."""
+    import numpy as np
+
+    from pinot_tpu.engine.params import BatchContext
+    from pinot_tpu.storage.segment import Encoding
+
+    try:
+        # a THROWAWAY context: planning reads only metadata/dictionaries,
+        # and going through the executor's batch_for here would insert a
+        # display-only batch into the production LRU (evicting a hot one)
+        # and skew the hit/miss gauges
+        ctx = BatchContext(segs)
+        for name in sorted(q.columns()):
+            plan = ctx.width_plan(name)
+            desc = np.dtype(plan.dtype).name
+            if plan.bits:
+                desc += f" packed={plan.bits}b"
+            if plan.offset is not None:
+                desc += f" for-offset={plan.offset}"
+            if plan.wide:
+                desc += f" wide={np.dtype(plan.wide).name}"
+            if ctx.encoding(name) == Encoding.DICT:
+                desc += f" card={ctx.cardinality(name)}"
+            out.append(f"    WIDTH({name}: {desc})")
+    except Exception:  # noqa: BLE001 — display only
+        pass
 
 
 def _filter_lines(f: FilterNode, depth: int, out: list, seg=None) -> None:
@@ -77,6 +111,12 @@ def explain_plan(engine, q: QueryContext) -> dict:
     else:
         lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
     lines.append("    PROJECT(" + ", ".join(sorted(q.columns())) + ")")
+    if (backend.startswith("DEVICE")
+            and os.environ.get("PINOT_TPU_WIDTH_AUDIT", "") not in ("", "0")):
+        tdm = engine.tables.get(q.table_name)
+        segs = list(tdm.segments.values()) if tdm is not None else []
+        if segs:
+            _width_lines(engine, q, segs, lines)
 
     rows = [[ln, i, i - 1] for i, ln in enumerate(lines)]
     return {

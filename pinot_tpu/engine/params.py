@@ -24,6 +24,8 @@ the engine falls back to the host executor.
 
 from __future__ import annotations
 
+import dataclasses
+import os
 import re
 import threading
 
@@ -55,6 +57,89 @@ class DeviceUnsupported(Exception):
 
 
 _NUMERIC_KINDS = ("i", "u", "f")
+
+# ---------------------------------------------------------------------------
+# cardinality-aware column width planning
+# ---------------------------------------------------------------------------
+# The reference never stores a forward index at full width
+# (FixedBitSVForwardIndexReader reads ceil(log2(cardinality)) bits per dict
+# id); the device path used to widen everything to int32/int64 before upload,
+# making scans HBM-bandwidth-bound and the batch LRU evict batches that
+# would fit 4-8x over at their true width. A ColPlan is the per-column
+# device storage decision:
+#
+# - DICT id planes: uint8 (C <= 255), uint16 (C <= 65535), else int32 —
+#   the pad sentinel is C itself on unsigned planes (ids are < C, so the
+#   pad matches no literal) and -1 on signed ones (legacy). An OPT-IN
+#   sub-byte tier (PINOT_TPU_SUBBYTE=1) packs 2-bit (C <= 3) / 4-bit
+#   (C <= 15) ids into uint8 bytes, unpacked in-kernel with shifts/masks
+#   (ops/masks.py unpack_subbyte).
+# - RAW / decoded (dv::) int planes: frame-of-reference (min-offset)
+#   downcast — values store as (v - min) in the narrowest unsigned dtype
+#   whose span covers (max - min), decoding to the legacy wide dtype at
+#   REGISTER level only (``wide`` + the per-batch "fo::<key>" offset
+#   param). When values already fit the narrow dtype unsigned, the offset
+#   is skipped entirely; int64 planes whose values fit int32 drop to a
+#   plain int32.
+# - Floats stay f32 (the pre-existing device value space).
+#
+# Zone-map (zlo::/zhi::) planes narrow WITH their column (stored in the
+# same space the plane stores — id space or FOR space); ops/blockskip.py
+# decodes them the same way the kernels decode the column.
+#
+# PINOT_TPU_FORCE_WIDE=1 restores the legacy widths end to end (the
+# differential-parity reference form). Env knobs are read ONCE per
+# BatchContext so a cached batch's plans never shift mid-life.
+
+
+@dataclasses.dataclass(frozen=True)
+class ColPlan:
+    """Device storage plan for one column plane."""
+
+    dtype: str          # numpy dtype .str of the STORED plane
+    bits: int = 0       # sub-byte pack width (2 | 4); 0 = byte-aligned
+    offset: int | None = None  # frame-of-reference offset (raw value space)
+    wide: str = ""      # register decode target dtype ("" = none needed)
+
+    @property
+    def packed(self) -> bool:
+        return self.bits > 0
+
+    def sig(self) -> tuple:
+        """Hashable template-key form (offset VALUE excluded — it is a
+        runtime param, one compiled pipeline serves any offset)."""
+        return (self.dtype, self.bits, self.offset is not None, self.wide)
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0")
+
+
+def _int_for_plan(lo: int, hi: int, base: np.dtype) -> ColPlan:
+    """Frame-of-reference plan for an integer plane with exact (python
+    int) bounds: narrowest unsigned dtype covering the RANGE, offset only
+    when the values don't already fit unsigned, int32 fallback for int64
+    planes whose values fit natively. Bounds arithmetic runs in python
+    ints, so dtype-extreme columns (min near -2^63) can't overflow here."""
+    rng = hi - lo
+    for dt, span in ((np.uint8, 1 << 8), (np.uint16, 1 << 16)):
+        ndt = np.dtype(dt)
+        if ndt.itemsize >= base.itemsize:
+            break  # no byte-width win at/past the base dtype
+        if 0 <= lo and hi < span:
+            return ColPlan(ndt.str, wide=base.str)
+        if rng < span:
+            return ColPlan(ndt.str, offset=int(lo), wide=base.str)
+    if base.itemsize > 4:
+        # same 4 bytes either way: prefer the offset-free native int32
+        if -(1 << 31) <= lo and hi < (1 << 31):
+            return ColPlan(np.dtype(np.int32).str, wide=base.str)
+        if 0 <= lo and hi < (1 << 32):
+            return ColPlan(np.dtype(np.uint32).str, wide=base.str)
+        if rng < (1 << 32):
+            return ColPlan(np.dtype(np.uint32).str, offset=int(lo),
+                           wide=base.str)
+    return ColPlan(base.str)
 
 
 class BatchContext:
@@ -93,6 +178,14 @@ class BatchContext:
         # unrelated launches behind a cold multi-GB column build.
         self._lock = threading.RLock()
         self._resident_bytes = 0
+        # width planning (ColPlan) — env knobs sampled ONCE so a cached
+        # batch's plans (and the executor's width-keyed templates) never
+        # shift mid-life; bytes the narrowing saved vs the legacy wide
+        # layout accumulate lock-free like _resident_bytes
+        self._force_wide = _env_flag("PINOT_TPU_FORCE_WIDE")
+        self._subbyte = _env_flag("PINOT_TPU_SUBBYTE")
+        self._plans: dict[str, ColPlan] = {}
+        self._narrow_saved_bytes = 0
 
     # ---- column access ---------------------------------------------------
     def column_meta(self, name: str):
@@ -165,19 +258,133 @@ class BatchContext:
             self._note_resident(self._mv_columns[name])
         return self._mv_columns[name]
 
+    # ---- width planning (ColPlan) ---------------------------------------
+    def width_plan(self, key: str) -> ColPlan:
+        """Device storage plan for a cols-dict key (bare column name or
+        "dv::name"); the executor folds these into its template cache key
+        so cohort coalescing keeps stacking same-shape queries."""
+        with self._lock:
+            return self._width_plan_locked(key)
+
+    def _width_plan_locked(self, key: str) -> ColPlan:
+        plan = self._plans.get(key)
+        if plan is None:
+            if key.startswith("dv::"):
+                plan = self._plan_decoded(key[4:])
+            elif self._encoding_locked(key) == Encoding.DICT:
+                plan = self._plan_dict(key)
+            else:
+                plan = self._plan_raw(key)
+            self._plans[key] = plan
+        return plan
+
+    def _plan_dict(self, name: str) -> ColPlan:
+        if self._force_wide:
+            return ColPlan(np.dtype(np.int32).str)
+        C = len(self._global_dict_locked(name))
+        # sub-byte tiers reserve the pad sentinel C inside the bit width
+        if self._subbyte and C <= 3:
+            return ColPlan(np.dtype(np.uint8).str, bits=2)
+        if self._subbyte and C <= 15:
+            return ColPlan(np.dtype(np.uint8).str, bits=4)
+        if C <= 255:  # ids 0..C-1, pad C: C == 255 still fits uint8
+            return ColPlan(np.dtype(np.uint8).str)
+        if C <= 65535:
+            return ColPlan(np.dtype(np.uint16).str)
+        return ColPlan(np.dtype(np.int32).str)
+
+    def _plan_raw(self, name: str) -> ColPlan:
+        from pinot_tpu.storage.device import _RAW_DEVICE_DTYPES
+
+        base = np.dtype(_RAW_DEVICE_DTYPES[self.column_meta(name).data_type])
+        if self._force_wide or base.kind == "f":
+            return ColPlan(base.str)
+        b = self._exact_int_bounds(name)
+        if b is None:
+            return ColPlan(base.str)
+        return _int_for_plan(b[0], b[1], base)
+
+    def _plan_decoded(self, name: str) -> ColPlan:
+        if self._encoding_locked(name) != Encoding.DICT:
+            return self._width_plan_locked(name)  # dv:: of RAW aliases raw
+        per_seg = [np.asarray(s.dictionary(name).values)
+                   for s in self.segments]
+        if any(v.dtype.kind == "f" for v in per_seg):
+            return ColPlan(np.dtype(np.float32).str)
+        base = np.dtype(np.int64) if any(v.dtype.itemsize == 8
+                                         for v in per_seg) \
+            else np.dtype(np.int32)
+        if self._force_wide or not any(len(v) for v in per_seg):
+            return ColPlan(base.str)
+        # dictionaries are sorted: batch bounds are the edge values
+        lo = min(int(v[0]) for v in per_seg if len(v))
+        hi = max(int(v[-1]) for v in per_seg if len(v))
+        return _int_for_plan(lo, hi, base)
+
+    def _exact_int_bounds(self, name: str):
+        """(min, max) as exact python ints from segment metadata, or None
+        (missing stats / non-integer values) — int_bounds() stays float
+        for the two-stage-sum interval arithmetic; FOR offsets need
+        exactness at dtype extremes."""
+        mns, mxs = [], []
+        for s in self.segments:
+            m = s.column_metadata(name)
+            if not isinstance(m.min_value, (int, np.integer)) \
+                    or not isinstance(m.max_value, (int, np.integer)):
+                return None
+            mns.append(int(m.min_value))
+            mxs.append(int(m.max_value))
+        return (min(mns), max(mxs)) if mns else None
+
+    def _dict_pad(self, name: str, plan: ColPlan) -> int:
+        """Pad sentinel for an id plane: C on unsigned planes (< any real
+        id's successor, matches no literal, fits by the tier rule), -1 on
+        signed (legacy)."""
+        if np.dtype(plan.dtype).kind == "u":
+            return len(self._global_dict_locked(name))
+        return -1
+
+    @staticmethod
+    def _pack_subbyte_np(blocks: np.ndarray, bits: int) -> np.ndarray:
+        """(S, L) small ids → (S, L * bits // 8) uint8, little-endian
+        within each byte (the host-side inverse of ops/masks.py
+        unpack_subbyte)."""
+        f = 8 // bits
+        v = blocks.reshape(blocks.shape[0], -1, f).astype(np.uint16)
+        shifts = np.arange(f, dtype=np.uint16) * bits
+        return (v << shifts).sum(axis=-1, dtype=np.uint16).astype(np.uint8)
+
+    def _note_saved(self, wide_nbytes: int, *arrays) -> None:
+        """Caller holds self._lock: record bytes the width plan saved vs
+        the legacy wide layout of the same logical plane(s)."""
+        actual = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
+        if wide_nbytes > actual:
+            self._narrow_saved_bytes += wide_nbytes - actual
+
+    def narrow_saved_bytes(self) -> int:
+        """HBM bytes saved by width planning vs the r05 wide layout
+        (lock-free read, like device_bytes)."""
+        return self._narrow_saved_bytes
+
     def column(self, name: str):
-        """(S, L) device array: **global** dict ids (DICT, pad -1) or raw
-        values (RAW, pad 0)."""
+        """(S, L) device array at the column's PLANNED width: **global**
+        dict ids (DICT — pad -1 signed / C unsigned; sub-byte plans pack
+        8//bits ids per byte into an (S, L * bits // 8) plane) or raw
+        values (RAW — frame-of-reference storage when the plan carries an
+        offset, pad 0)."""
         with self._lock:
             return self._column_locked(name)
 
     def _column_locked(self, name: str):
         if name not in self._columns:
             enc = self.encoding(name)
+            plan = self._width_plan_locked(name)
+            sdt = np.dtype(plan.dtype)
             if enc == Encoding.DICT:
                 gdict = self.global_dict(name)
-                blocks = np.full((self.S, self.pad_to), -1, dtype=np.int32)
-                zlo, zhi = self._zone_fills(np.int32)
+                pad = self._dict_pad(name, plan)
+                blocks = np.full((self.S, self.pad_to), pad, dtype=sdt)
+                zlo, zhi = self._zone_fills(sdt)
                 for i, s in enumerate(self.segments):
                     d = s.dictionary(name)
                     remap = np.searchsorted(
@@ -185,7 +392,7 @@ class BatchContext:
                     ).astype(np.int32)
                     fwd = np.asarray(s.forward(name))
                     gids = remap[fwd]
-                    blocks[i, : len(fwd)] = gids
+                    blocks[i, : len(fwd)] = gids  # ids < C: fits the plan
                     zm = self._reader_zone_map(s, name, len(fwd))
                     # local->global id remap is monotone (both dictionaries
                     # are sorted), so per-block min/max ids survive it
@@ -193,26 +400,52 @@ class BatchContext:
                         else build_zone_map(gids)
                     zlo[i, : z.shape[1]] = z[0]
                     zhi[i, : z.shape[1]] = z[1]
+                if plan.packed:
+                    blocks = self._pack_subbyte_np(blocks, plan.bits)
             else:
-                from pinot_tpu.storage.device import host_column_block
-
-                blocks = np.stack(
-                    [host_column_block(s, name, self.pad_to) for s in self.segments]
-                )
-                zlo, zhi = self._zone_fills(blocks.dtype)
+                off = plan.offset or 0
+                blocks = np.zeros((self.S, self.pad_to), dtype=sdt)
+                zlo, zhi = self._zone_fills(sdt)
                 for i, s in enumerate(self.segments):
+                    fwd = np.asarray(s.forward(name))
+                    if off:
+                        # FOR storage: python-int-exact metadata bounds
+                        # guarantee (v - off) fits the plan dtype; the
+                        # int64 intermediate never overflows (|off| and v
+                        # both fit int64 and their difference fits uint32)
+                        vals = (fwd.astype(np.int64) - off).astype(sdt)
+                    else:
+                        # astype matches the device narrowing (float
+                        # round-to-nearest is monotone, so narrowed
+                        # bounds still bound the narrowed values)
+                        vals = fwd.astype(sdt)
+                    blocks[i, : len(fwd)] = vals
                     zm = self._reader_zone_map(s, name, s.n_docs)
-                    # astype matches the device narrowing (round-to-nearest
-                    # is monotone, so narrowed bounds still bound the
-                    # narrowed column values)
-                    z = np.asarray(zm).astype(blocks.dtype) if zm is not None \
-                        else build_zone_map(blocks[i, : s.n_docs])
+                    if zm is not None:
+                        zm = np.asarray(zm)
+                        z = ((zm.astype(np.int64) - off).astype(sdt)
+                             if off else zm.astype(sdt))
+                    else:
+                        z = build_zone_map(blocks[i, : s.n_docs])
                     zlo[i, : z.shape[1]] = z[0]
                     zhi[i, : z.shape[1]] = z[1]
             self._columns[name] = jnp.asarray(blocks)
             self._note_resident(self._columns[name])
             self._store_zone_map(name, zlo, zhi)
+            # legacy wide layout: int32 id plane / base-dtype raw plane,
+            # plus two int32/base zone planes
+            wide_item = 4 if enc == Encoding.DICT else \
+                np.dtype(self._legacy_raw_dtype(name)).itemsize
+            nb = self.pad_to // ZONE_BLOCK_ROWS
+            self._note_saved(
+                wide_item * self.S * (self.pad_to + 2 * nb),
+                self._columns[name], *self._zone_maps[name])
         return self._columns[name]
+
+    def _legacy_raw_dtype(self, name: str):
+        from pinot_tpu.storage.device import _RAW_DEVICE_DTYPES
+
+        return _RAW_DEVICE_DTYPES[self.column_meta(name).data_type]
 
     # ---- zone maps (device block-skip basis, ops/blockskip.py) ----------
     def _zone_fills(self, dtype):
@@ -304,29 +537,38 @@ class BatchContext:
                 if vals.dtype.kind not in _NUMERIC_KINDS:
                     raise DeviceUnsupported(f"non-numeric dict column {name} in expression")
                 per_seg.append(vals)
-            if any(v.dtype.kind == "f" for v in per_seg):
-                dt = np.float32
-            elif any(v.dtype.itemsize == 8 for v in per_seg):
-                dt = np.int64
-            else:
-                dt = np.int32
-            blocks = np.zeros((self.S, self.pad_to), dtype=dt)
-            zlo, zhi = self._zone_fills(dt)
+            plan = self._width_plan_locked("dv::" + name)
+            sdt = np.dtype(plan.dtype)
+            off = plan.offset or 0
+            # legacy wide layout = the plan's decode target (un-narrowed
+            # plans store the legacy dtype already)
+            wide_item = np.dtype(plan.wide).itemsize if plan.wide \
+                else sdt.itemsize
+            blocks = np.zeros((self.S, self.pad_to), dtype=sdt)
+            zlo, zhi = self._zone_fills(sdt)
             for i, (s, vals) in enumerate(zip(self.segments, per_seg)):
                 fwd = np.asarray(s.forward(name))
-                decoded = vals.astype(dt)[fwd]
-                blocks[i, : len(fwd)] = decoded
+                # FOR narrowing happens on the (C,)-sized LUT, not the
+                # rows: one subtract per distinct value, then the same
+                # one-off host gather as before
+                lut = (vals.astype(np.int64) - off).astype(sdt) if off \
+                    else vals.astype(sdt)
+                blocks[i, : len(fwd)] = lut[fwd]
                 zm = self._reader_zone_map(s, name, len(fwd))
                 # id zone -> value zone through the sorted dictionary (id
                 # order == value order, so min/max ids decode to min/max
                 # values)
-                z = vals[np.asarray(zm)].astype(dt) if zm is not None \
-                    else build_zone_map(decoded)
+                z = lut[np.asarray(zm)] if zm is not None \
+                    else build_zone_map(blocks[i, : len(fwd)])
                 zlo[i, : z.shape[1]] = z[0]
                 zhi[i, : z.shape[1]] = z[1]
             self._decoded[name] = jnp.asarray(blocks)
             self._note_resident(self._decoded[name])
             self._store_zone_map("dv::" + name, zlo, zhi)
+            nb = self.pad_to // ZONE_BLOCK_ROWS
+            self._note_saved(
+                wide_item * self.S * (self.pad_to + 2 * nb),
+                self._decoded[name], *self._zone_maps["dv::" + name])
         return self._decoded[name]
 
     def prehashed_column(self, name: str):
@@ -425,7 +667,16 @@ class BatchContext:
             for c in group_cards:
                 num_groups *= int(c)
             m = 1 << log2m
-            per_col = [self.column(c) for c in group_cols]
+            # sub-byte id planes unpack before the sort build (the sorted
+            # projection is row-scale anyway; group_ids_combine widens ids
+            # to int32 in-register regardless of plane width)
+            per_col = []
+            for c in group_cols:
+                col = self._column_locked(c)
+                plan = self._width_plan_locked(c)
+                if plan.packed:
+                    col = mask_ops.unpack_subbyte(col, plan.bits)
+                per_col.append(col)
             hh = self.prehashed_column(hash_col)
 
             def build(cols_list, h, n_docs):

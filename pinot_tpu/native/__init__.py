@@ -96,9 +96,22 @@ def _compile() -> bool:
     return False
 
 
+_FORCE_NUMPY_ENV = "PINOT_TPU_NO_NATIVE"
+
+
 def _load():
-    """ctypes handle on the packer library, or None (numpy fallback)."""
+    """ctypes handle on the packer library, or None (numpy fallback).
+
+    Every failure mode — no toolchain, a failed compile, a corrupt or
+    unloadable ``_libpinot_packer.so`` — degrades to the pure-numpy codec
+    (`_pack_np`/`_unpack_np`, same byte format), so ``<col>.fwdpacked.bin``
+    segments stay readable on any host. ``PINOT_TPU_NO_NATIVE=1`` forces
+    the numpy path outright (checked per call, ahead of the cached
+    handle, so tests and constrained deployments can flip it without
+    reloading the module)."""
     global _lib, _lib_tried
+    if os.environ.get(_FORCE_NUMPY_ENV, "") not in ("", "0"):
+        return None
     with _lock:
         if _lib_tried:
             return _lib

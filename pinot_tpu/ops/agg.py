@@ -188,13 +188,17 @@ def group_ids_combine(per_col_gids, cardinalities, mask, num_groups: int):
     regime of DictionaryBasedGroupKeyGenerator.java:43-45: raw key == group
     id via cartesian arithmetic).
 
-    per_col_gids: list of int32 (S, L) arrays in [0, C_j) — padding may be
-    negative, so ids are clipped before the arithmetic; masked docs land in
-    the `num_groups` overflow slot.
+    per_col_gids: list of (S, L) id arrays in [0, C_j) at their planned
+    width (uint8/uint16/int32 — engine/params.py ColPlan); padding may be
+    negative (signed planes) or C (unsigned), so ids are clipped before
+    the arithmetic. The cartesian multiply widens to int32 IN-REGISTER —
+    narrow planes keep HBM traffic down, but uint8 * weak-int stays uint8
+    under jax promotion and would silently wrap past 255. Masked docs land
+    in the `num_groups` overflow slot.
     """
     gid = None
     for g, c in zip(per_col_gids, cardinalities):
-        g = jnp.clip(g, 0, c - 1)
+        g = jnp.clip(g, 0, c - 1).astype(jnp.int32)
         gid = g if gid is None else gid * c + g
     return jnp.where(mask, gid, num_groups)
 

@@ -100,19 +100,37 @@ def prunable_columns(tpl) -> tuple[bool, set]:
     return False, set()
 
 
-def _zones(cols, colkey):
+def _zones(cols, params, colkey, widths=None):
+    """(lo, hi) zone arrays for a column key, DECODED to the column's
+    register value space. Zone planes narrow WITH their column
+    (engine/params.py ColPlan): id-space zones compare at native width
+    (the int32 literal promotes in-register), but frame-of-reference
+    (min-offset) planes store zones in FOR space — widen and add the
+    per-batch "fo::<key>" offset param so predicate literals (raw value
+    space) compare correctly. The (S, NB) zone arrays are a few thousand
+    elements; the widening is register noise."""
     lo = cols.get(ZLO + colkey)
     hi = cols.get(ZHI + colkey)
     if lo is None or hi is None:
         return None, None
+    w = widths.get(colkey) if widths else None
+    if w is not None and w[3]:  # (dtype, bits, has_offset, wide)
+        wd = jnp.dtype(w[3])
+        lo = lo.astype(wd)
+        hi = hi.astype(wd)
+        fo = params.get("fo::" + colkey)
+        if w[2] and fo is not None:
+            lo = lo + fo
+            hi = hi + fo
     return lo, hi
 
 
-def zone_verdict(tpl, cols, params, shape):
+def zone_verdict(tpl, cols, params, shape, widths=None):
     """(S, n_blocks) bool: True where the block MAY contain a matching doc.
     Mirrors device.py's ``_eval_filter`` node set in interval semantics;
     any node without interval structure returns all-True (never prunes a
-    block the dense mask would match)."""
+    block the dense mask would match). ``widths``: the pipeline's column
+    width plan (build_pipeline) — zone planes decode like their column."""
     kind = tpl[0]
     ones = jnp.ones(shape, dtype=bool)
     if kind == "true":
@@ -120,42 +138,42 @@ def zone_verdict(tpl, cols, params, shape):
     if kind == "false":
         return jnp.zeros(shape, dtype=bool)
     if kind == "and":
-        v = zone_verdict(tpl[1], cols, params, shape)
+        v = zone_verdict(tpl[1], cols, params, shape, widths)
         for c in tpl[2:]:
-            v &= zone_verdict(c, cols, params, shape)
+            v &= zone_verdict(c, cols, params, shape, widths)
         return v
     if kind == "or":
-        v = zone_verdict(tpl[1], cols, params, shape)
+        v = zone_verdict(tpl[1], cols, params, shape, widths)
         for c in tpl[2:]:
-            v |= zone_verdict(c, cols, params, shape)
+            v |= zone_verdict(c, cols, params, shape, widths)
         return v
     if kind == "eq_dict":
-        lo, hi = _zones(cols, tpl[1])
+        lo, hi = _zones(cols, params, tpl[1], widths)
         if lo is None:
             return ones
         t = params[tpl[2]]  # -2 when the value is absent: matches no block
         return (t >= lo) & (t <= hi)
     if kind == "in_dict":
-        lo, hi = _zones(cols, tpl[1])
+        lo, hi = _zones(cols, params, tpl[1], widths)
         if lo is None:
             return ones
         ids = params[tpl[2]]  # (K,) with -2 padding (< any real zone lo)
         return jnp.any((ids >= lo[..., None]) & (ids <= hi[..., None]),
                        axis=-1)
     if kind == "range_dict":
-        lo, hi = _zones(cols, tpl[1])
+        lo, hi = _zones(cols, params, tpl[1], widths)
         if lo is None:
             return ones
         rlo, rhi = params[tpl[2]], params[tpl[3]]  # id interval [rlo, rhi)
         return (lo < rhi) & (hi >= rlo)
     if kind == "eq_raw":
-        lo, hi = _zones(cols, _expr_colkey(tpl[1]) or "")
+        lo, hi = _zones(cols, params, _expr_colkey(tpl[1]) or "", widths)
         if lo is None:
             return ones
         t = params[tpl[2]]
         return (t >= lo) & (t <= hi)
     if kind == "in_raw":
-        lo, hi = _zones(cols, _expr_colkey(tpl[1]) or "")
+        lo, hi = _zones(cols, params, _expr_colkey(tpl[1]) or "", widths)
         if lo is None:
             return ones
         lits = params[tpl[2]]
@@ -163,7 +181,7 @@ def zone_verdict(tpl, cols, params, shape):
                        axis=-1)
     if kind == "range_raw":
         _, expr_tpl, klo, khi, has_lo, has_hi, lo_inc, hi_inc = tpl
-        lo, hi = _zones(cols, _expr_colkey(expr_tpl) or "")
+        lo, hi = _zones(cols, params, _expr_colkey(expr_tpl) or "", widths)
         if lo is None:
             return ones
         v = ones

@@ -11,8 +11,13 @@ loader remapped them on upload, engine/params.py), so predicate literals
 resolve to batch-wide scalars/vectors on the host — one binary search over
 the global dictionary replaces the reference's per-segment
 PredicateEvaluator, and the kernel is a bare vector comparison with no
-per-segment indirection. Padding docs carry id -1 and literal params use -2
-for "absent", so padding never matches; callers still AND with valid_mask.
+per-segment indirection. Id planes arrive at their cardinality-chosen width
+(uint8/uint16/int32, optionally sub-byte-packed — engine/params.py
+ColPlan); predicates compare at native width (the int32 literal promotes
+in-register, HBM traffic stays narrow). Padding docs carry id -1 (signed
+planes) or the cardinality C (unsigned planes — ids are < C, so C matches
+no literal) and literal params use -2 for "absent", so padding never
+matches; callers still AND with valid_mask.
 
 All functions here are shape-polymorphic jnp ops, traced inside the engine's
 jitted pipeline; nothing allocates per-doc.
@@ -21,6 +26,20 @@ jitted pipeline; nothing allocates per-doc.
 from __future__ import annotations
 
 import jax.numpy as jnp
+
+
+def unpack_subbyte(packed, bits: int):
+    """(…, Lp) uint8 sub-byte plane → (…, Lp * 8//bits) uint8 dict ids,
+    unpacked with shifts/masks at REGISTER level (the in-kernel analog of
+    FixedBitSVForwardIndexReader's bit extraction): the HBM read stays at
+    the packed width, XLA fuses the shift/mask into whatever consumes the
+    ids. Values are little-endian within each byte — id j lives in byte
+    j // f at bit offset (j % f) * bits (f = 8 // bits), matching
+    engine/params.py's host-side packer."""
+    f = 8 // bits
+    shifts = jnp.arange(f, dtype=jnp.uint8) * jnp.uint8(bits)
+    sub = (packed[..., None] >> shifts) & jnp.uint8((1 << bits) - 1)
+    return sub.reshape(packed.shape[:-1] + (packed.shape[-1] * f,))
 
 
 def valid_mask(n_docs, padded_len: int, batched: bool):

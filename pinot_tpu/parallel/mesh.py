@@ -171,11 +171,14 @@ def shard_pipeline(pipeline_fn, mesh: Mesh, cohort: bool = False, post=None):
             return jax.vmap(lambda p: one(cols, n_docs, p))(params)
         return one(cols, n_docs, params)
 
-    # global-id design: every param (literals, (C,) LUTs) is batch-wide and
-    # replicated; only columns, n_docs, and "ps"-prefixed per-segment
-    # params (e.g. the Level-1 ``ps_alive`` vector) carry the segment axis.
-    # Cohort stacks add a leading member axis, so the segment axis shifts
-    # to position 1 there.
+    # global-id design: every param (literals, (C,) LUTs, the per-batch
+    # "fo::" frame-of-reference offsets from width planning) is batch-wide
+    # and replicated; only columns, n_docs, and "ps"-prefixed per-segment
+    # params (e.g. the Level-1 ``ps_alive`` vector) carry the segment
+    # axis. Narrow/sub-byte column planes shard like any column — the
+    # (S, L//f) packed byte axis is position 1 either way. Cohort stacks
+    # add a leading member axis, so the segment axis shifts to position 1
+    # there.
     def param_spec(key: str, x) -> P:
         if key.startswith("ps"):
             if cohort:

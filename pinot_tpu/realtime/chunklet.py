@@ -74,7 +74,11 @@ class Chunklet:
     ``forward`` / ``values`` / ``null_vector`` / ``n_docs`` / ``dir``.
     ``dir`` is the executor's batch cache key — stable per block, so
     repeated queries over the same frozen prefix hit the HBM-resident
-    BatchContext."""
+    BatchContext. Because per-block ColumnMetadata carries exact
+    cardinality and min/max (``_seal_column``), the batch layer's width
+    planner (engine/params.py ColPlan) narrows chunklet planes exactly
+    like sealed segments' — uint8/uint16 dict ids, frame-of-reference
+    raw values — pinned by tests/test_narrow.py."""
 
     is_mutable = False
 

@@ -135,6 +135,27 @@ class ServerInstance:
         self.metrics.gauge("schedulerRejected",
                            lambda: self.scheduler.num_rejected,
                            tag=instance_id)
+        # HBM / batch-LRU accounting (DeviceExecutor.hbm_stats): resident
+        # bytes, cache traffic, and bytes the width planning saved — the
+        # operational view of ISSUE 5's narrowing (a shrinking
+        # deviceNarrowSavedBytes alongside rising evictions means batches
+        # stopped fitting)
+        if dev is not None:
+            # counters are plain executor ints (GIL-atomic reads); only
+            # the byte gauges walk the batch list — one lightweight sum
+            # each, not a full hbm_stats() snapshot 5x per scrape
+            for gname, attr in (("deviceBatchHits", "batch_hits"),
+                                ("deviceBatchMisses", "batch_misses"),
+                                ("deviceBatchEvictions", "batch_evictions")):
+                self.metrics.gauge(
+                    gname, (lambda _a=attr, _d=dev: getattr(_d, _a)),
+                    tag=instance_id)
+            self.metrics.gauge(
+                "deviceResidentBytes",
+                (lambda _d=dev: _d.resident_bytes()), tag=instance_id)
+            self.metrics.gauge(
+                "deviceNarrowSavedBytes",
+                (lambda _d=dev: _d.narrow_saved_bytes()), tag=instance_id)
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
@@ -167,6 +188,10 @@ class ServerInstance:
         # instance (and its loaded segments) in the process-global registry
         self.metrics.remove_gauge("segmentsLoaded", tag=self.instance_id)
         self.metrics.remove_gauge("schedulerRejected", tag=self.instance_id)
+        for gname in ("deviceResidentBytes", "deviceNarrowSavedBytes",
+                      "deviceBatchHits", "deviceBatchMisses",
+                      "deviceBatchEvictions"):
+            self.metrics.remove_gauge(gname, tag=self.instance_id)
         if self._sync_thread is not None:
             self._sync_thread.join(5)
         for mgr in self._realtime_managers.values():

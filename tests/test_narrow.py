@@ -1,0 +1,424 @@
+"""Narrow-width device residency: width planning + differential parity.
+
+The contract under test (ISSUE 5): device column planes store at their
+cardinality-chosen width — uint8/uint16/int32 dict-id planes, frame-of-
+reference (min-offset) downcast for raw/decoded int planes, an opt-in
+sub-byte tier (PINOT_TPU_SUBBYTE=1) unpacked in-kernel — with zone maps
+narrowing alongside, and every query over narrow planes answers EXACTLY
+like the forced-wide legacy layout (PINOT_TPU_FORCE_WIDE=1) and
+value-equal to the host executor, across EQ/IN/RANGE/NOT predicates,
+scalar + group-by aggregations, sealed + consuming segments, solo +
+8-dev mesh, cardinality boundaries (255/256, 65535/65536), and
+eviction churn under a shrunken byte budget.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.engine.params import BatchContext, ColPlan, _int_for_plan
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+N_SEG = 2
+ROWS = 8192
+
+
+def _build_table(base, seed=11):
+    rng = np.random.default_rng(seed)
+    schema = Schema.build(
+        name="nw",
+        dimensions=[("tag", DataType.STRING), ("mid", DataType.INT),
+                    ("ts", DataType.LONG)],
+        metrics=[("m", DataType.INT), ("f", DataType.DOUBLE)],
+    )
+    cfg = TableConfig(
+        table_name="nw",
+        indexing=IndexingConfig(no_dictionary_columns=["ts", "m"]),
+    )
+    segs, all_cols = [], []
+    for i in range(N_SEG):
+        cols = {
+            # dict str, card 3 -> uint8 (2-bit under the sub-byte tier)
+            "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, ROWS)],
+            # dict int, card ~300 -> uint16
+            "mid": rng.integers(0, 300, ROWS).astype(np.int32),
+            # raw int64, huge base but tiny range -> FOR uint16 + offset
+            "ts": (10_000_000_000 + i * ROWS
+                   + np.arange(ROWS)).astype(np.int64),
+            # raw int32, values 0..9999 -> plain uint16 (no offset)
+            "m": rng.integers(0, 10_000, ROWS).astype(np.int32),
+            # raw double -> f32 (legacy device float space)
+            "f": np.round(rng.uniform(0, 100, ROWS), 3),
+        }
+        all_cols.append(cols)
+        build_segment(schema, cols, str(base / f"s{i}"), cfg, f"s{i}")
+        segs.append(ImmutableSegment(str(base / f"s{i}")))
+    return segs, all_cols
+
+
+@pytest.fixture(scope="module")
+def tables(tmp_path_factory):
+    return _build_table(tmp_path_factory.mktemp("narrow"))
+
+
+def _engine(segs, device="auto", table="nw"):
+    eng = QueryEngine() if device == "auto" \
+        else QueryEngine(device_executor=device)
+    for s in segs:
+        eng.add_segment(table, s)
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines(tables):
+    segs, all_cols = tables
+    narrow = _engine(segs)
+    os.environ["PINOT_TPU_FORCE_WIDE"] = "1"
+    try:
+        wide = _engine(segs)
+        # materialize the wide engine's BatchContext while the env flag is
+        # up (plans are sampled at BatchContext creation)
+        wide.execute("SELECT COUNT(*) FROM nw")
+    finally:
+        del os.environ["PINOT_TPU_FORCE_WIDE"]
+    host = _engine(segs, device=None)
+    return narrow, wide, host, all_cols
+
+
+# EQ / IN / RANGE / NOT over every width tier; scalar + group-by shapes;
+# FOR columns filtered in raw value space; empty + unselective.
+PARITY_QUERIES = [
+    "SELECT COUNT(*), SUM(m), MIN(m), MAX(m) FROM nw WHERE tag = 'b'",
+    "SELECT COUNT(*), AVG(m) FROM nw WHERE mid IN (5, 250, 299)",
+    "SELECT COUNT(*), SUM(m) FROM nw "
+    "WHERE ts BETWEEN 10000000100 AND 10000004000",
+    "SELECT COUNT(*), MIN(ts), MAX(ts) FROM nw WHERE m < 100",
+    "SELECT COUNT(*) FROM nw WHERE NOT tag = 'a' AND m >= 5000",
+    "SELECT tag, COUNT(*), SUM(m), MIN(ts), MAX(ts) FROM nw "
+    "GROUP BY tag ORDER BY tag",
+    "SELECT mid, COUNT(*), SUM(f) FROM nw WHERE tag = 'c' "
+    "GROUP BY mid ORDER BY mid LIMIT 10",
+    "SELECT COUNT(*), DISTINCTCOUNT(tag), DISTINCTCOUNT(mid) FROM nw "
+    "WHERE m > 2000",
+    "SELECT COUNT(*), MINMAXRANGE(m) FROM nw WHERE mid = 7 OR mid = 123",
+    # empty (absent dict value) and empty-but-unprunable
+    "SELECT COUNT(*), MIN(m), MAX(m) FROM nw WHERE tag = 'zzz'",
+    "SELECT COUNT(*), MIN(ts), MAX(ts) FROM nw WHERE m = 1 AND m = 2",
+    # unselective full scan
+    "SELECT COUNT(*), SUM(m) FROM nw WHERE ts >= 0",
+]
+
+
+def _close(a, b):
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, str) or isinstance(b, str):
+        return a == b
+    return np.isclose(float(a), float(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("sql", PARITY_QUERIES)
+def test_narrow_equals_wide_equals_host(engines, sql):
+    narrow, wide, host, _ = engines
+    rn, rw, rh = narrow.execute(sql), wide.execute(sql), host.execute(sql)
+    assert not rn.get("exceptions"), rn
+    assert not rw.get("exceptions"), rw
+    # narrow vs forced-wide: EXACT — the decode (in-register widen +
+    # offset add) reconstructs the same values the wide plane stored
+    assert rn["resultTable"] == rw["resultTable"], sql
+    assert rn["numDocsScanned"] == rw["numDocsScanned"], sql
+    # vs host: value-equal (device floats are f32-narrowed, as before)
+    rows_n, rows_h = rn["resultTable"]["rows"], rh["resultTable"]["rows"]
+    assert len(rows_n) == len(rows_h), sql
+    for a, b in zip(rows_n, rows_h):
+        assert all(_close(x, y) for x, y in zip(a, b)), (sql, a, b)
+
+
+class TestWidthPlans:
+    def test_tier_assignment(self, tables):
+        segs, _ = tables
+        ctx = BatchContext(segs)
+        assert ctx.width_plan("tag") == ColPlan("|u1")
+        assert ctx.width_plan("mid").dtype == np.dtype(np.uint16).str
+        ts = ctx.width_plan("ts")
+        assert ts.dtype == np.dtype(np.uint16).str
+        assert ts.offset == 10_000_000_000
+        assert np.dtype(ts.wide) == np.int64
+        m = ctx.width_plan("m")
+        assert m.dtype == np.dtype(np.uint16).str and m.offset is None
+        assert ctx.width_plan("f").dtype == np.dtype(np.float32).str
+        # decoded plane of the int dict column narrows too
+        assert np.dtype(ctx.width_plan("dv::mid").dtype).itemsize <= 2
+
+    def test_force_wide_restores_legacy(self, tables, monkeypatch):
+        segs, _ = tables
+        monkeypatch.setenv("PINOT_TPU_FORCE_WIDE", "1")
+        ctx = BatchContext(segs)
+        assert np.dtype(ctx.width_plan("tag").dtype) == np.int32
+        assert np.dtype(ctx.width_plan("ts").dtype) == np.int64
+        assert np.dtype(ctx.width_plan("m").dtype) == np.int32
+
+    def test_int_plan_dtype_extremes(self):
+        """FOR planning near int64 extremes must not overflow (python-int
+        bounds arithmetic) and must bail to the base dtype when the range
+        itself exceeds uint32."""
+        i64 = np.dtype(np.int64)
+        lo = -(1 << 62)
+        p = _int_for_plan(lo, lo + 65_000, i64)
+        assert np.dtype(p.dtype) == np.uint16 and p.offset == lo
+        p = _int_for_plan(lo, lo + (1 << 33), i64)
+        assert np.dtype(p.dtype) == np.int64 and p.offset is None
+        p = _int_for_plan(-(1 << 63), (1 << 63) - 1, i64)
+        assert np.dtype(p.dtype) == np.int64 and p.offset is None
+        # int64 values that fit int32 natively: plain downcast, no offset
+        p = _int_for_plan(-(1 << 30), 1 << 30, i64)
+        assert np.dtype(p.dtype) == np.int32 and p.offset is None
+
+    def test_zone_maps_narrow_with_column(self, tables):
+        segs, _ = tables
+        ctx = BatchContext(segs)
+        ctx.column("ts")
+        zlo, zhi = ctx.zone_map("ts")
+        assert zlo.dtype == np.uint16 and zhi.dtype == np.uint16
+
+
+class TestCardinalityBoundaries:
+    @pytest.mark.parametrize("card,want", [
+        (255, np.uint8), (256, np.uint16),
+        (65535, np.uint16), (65536, np.int32),
+    ])
+    def test_dict_tier_boundary(self, tmp_path, card, want):
+        schema = Schema.build(
+            name="cb", dimensions=[("g", DataType.INT)],
+            metrics=[("m", DataType.INT)])
+        cfg = TableConfig(table_name="cb")
+        n = max(card, 4096)
+        cols = {"g": (np.arange(n, dtype=np.int64) % card).astype(np.int32),
+                "m": np.ones(n, dtype=np.int32)}
+        d = str(tmp_path / f"c{card}")
+        build_segment(schema, cols, d, cfg, f"c{card}")
+        seg = ImmutableSegment(d)
+        ctx = BatchContext([seg])
+        plan = ctx.width_plan("g")
+        assert np.dtype(plan.dtype) == want, plan
+        eng = _engine([seg], table="cb")
+        host = _engine([seg], device=None, table="cb")
+        for sql in (f"SELECT COUNT(*) FROM cb WHERE g = {card - 1}",
+                    f"SELECT COUNT(*) FROM cb WHERE g IN (0, {card - 1})",
+                    "SELECT COUNT(*), DISTINCTCOUNT(g) FROM cb"):
+            rd, rh = eng.execute(sql), host.execute(sql)
+            assert not rd.get("exceptions"), (sql, rd)
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+
+
+class TestSubByteTier:
+    def test_unpack_matches_numpy(self):
+        import jax.numpy as jnp
+
+        from pinot_tpu.ops.masks import unpack_subbyte
+
+        rng = np.random.default_rng(5)
+        for bits in (2, 4):
+            ids = rng.integers(0, 1 << bits, (3, 128)).astype(np.uint8)
+            packed = BatchContext._pack_subbyte_np(ids, bits)
+            assert packed.shape == (3, 128 * bits // 8)
+            got = np.asarray(unpack_subbyte(jnp.asarray(packed), bits))
+            np.testing.assert_array_equal(got, ids)
+
+    def test_subbyte_opt_in_parity(self, tables, monkeypatch):
+        segs, _ = tables
+        monkeypatch.setenv("PINOT_TPU_SUBBYTE", "1")
+        ctx = BatchContext(segs)
+        plan = ctx.width_plan("tag")  # card 3 -> 2-bit
+        assert plan.bits == 2
+        col = ctx.column("tag")
+        assert col.shape == (N_SEG, ctx.pad_to // 4)
+        sub = _engine(segs)
+        host = _engine(segs, device=None)
+        for sql in (
+            "SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'b'",
+            "SELECT tag, COUNT(*), MIN(m) FROM nw GROUP BY tag ORDER BY tag",
+            "SELECT COUNT(*) FROM nw WHERE tag IN ('a', 'c') "
+            "AND ts BETWEEN 10000000100 AND 10000002000",
+            "SELECT COUNT(*), DISTINCTCOUNT(tag) FROM nw WHERE m > 100",
+        ):
+            rd, rh = sub.execute(sql), host.execute(sql)
+            assert not rd.get("exceptions"), (sql, rd)
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+        # default (opt-out) stays byte-aligned
+        assert BatchContext._pack_subbyte_np is not None
+        monkeypatch.delenv("PINOT_TPU_SUBBYTE")
+        assert BatchContext(segs).width_plan("tag").bits == 0
+
+    def test_subbyte_mesh_parity(self, tables, monkeypatch):
+        """Sub-byte planes shard like any column ((S, L//f) packed byte
+        axis) and unpack inside each shard's kernel."""
+        from pinot_tpu.engine.device import DeviceExecutor
+        from pinot_tpu.parallel.mesh import make_mesh
+
+        segs, _ = tables
+        monkeypatch.setenv("PINOT_TPU_SUBBYTE", "1")
+        mesh_eng = _engine(segs, DeviceExecutor(mesh=make_mesh(8)))
+        host = _engine(segs, None)
+        for sql in (
+            "SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'b' "
+            "AND ts BETWEEN 10000000100 AND 10000009000",
+            "SELECT tag, COUNT(*), MIN(m), MAX(ts) FROM nw "
+            "GROUP BY tag ORDER BY tag",
+        ):
+            rm, rh = mesh_eng.execute(sql), host.execute(sql)
+            assert not rm.get("exceptions"), (sql, rm)
+            assert rm["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+
+
+class TestMesh:
+    @pytest.mark.parametrize("sql", PARITY_QUERIES[:6])
+    def test_mesh_parity(self, tables, sql):
+        from pinot_tpu.engine.device import DeviceExecutor
+        from pinot_tpu.parallel.mesh import make_mesh
+
+        segs, _ = tables
+        mesh_eng = _engine(segs, DeviceExecutor(mesh=make_mesh(8)))
+        host_eng = _engine(segs, None)
+        rm, rh = mesh_eng.execute(sql), host_eng.execute(sql)
+        assert not rm.get("exceptions"), rm
+        rows_m, rows_h = rm["resultTable"]["rows"], rh["resultTable"]["rows"]
+        assert len(rows_m) == len(rows_h), sql
+        for a, b in zip(rows_m, rows_h):
+            assert all(_close(x, y) for x, y in zip(a, b)), (sql, a, b)
+
+
+class TestConsumingSegments:
+    def test_chunklet_planes_narrow_like_sealed(self, tmp_path):
+        """Consuming segments' promoted chunklets ride the SAME BatchContext
+        width planning as sealed segments — parity vs an all-host engine
+        while the tail stays unfrozen."""
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.realtime.chunklet import split_for_query
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="rt", dimensions=[("tag", DataType.STRING)],
+            metrics=[("m", DataType.INT)])
+        cfg = TableConfig(
+            table_name="rt",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=4096,
+                                     device_min_rows=0))
+        seg = MutableSegment(schema, "rt__0", cfg)
+        rng = np.random.default_rng(17)
+        n = 11_000  # 2 promotable chunklets + a host tail
+        tags = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+        ms = rng.integers(0, 50, n)
+        seg.index_batch([{"tag": str(t), "m": int(v)}
+                         for t, v in zip(tags, ms)])
+        seg.chunklet_index.promote()
+        dev = _engine([seg], table="rt")
+        host = _engine([seg], None, table="rt")
+        for sql in ("SELECT COUNT(*), SUM(m) FROM rt WHERE tag = 'b'",
+                    "SELECT tag, COUNT(*), MAX(m) FROM rt "
+                    "GROUP BY tag ORDER BY tag"):
+            rd, rh = dev.execute(sql), host.execute(sql)
+            assert not rd.get("exceptions"), rd
+            assert rd["resultTable"]["rows"] == rh["resultTable"]["rows"], sql
+        # the chunklet batch planned narrow id planes (card 3 -> uint8)
+        split = split_for_query(seg)
+        assert split is not None and split[0], "no chunklets promoted"
+        ctx = BatchContext(split[0])
+        assert np.dtype(ctx.width_plan("tag").dtype) == np.uint8
+
+
+class TestHbmAccounting:
+    def test_resident_bytes_shrink(self, tables):
+        """The headline claim: a dict-heavy batch's resident bytes shrink
+        >= 2.5x vs the r05 wide layout for the same columns."""
+        segs, _ = tables
+        narrow = BatchContext(segs)
+        os.environ["PINOT_TPU_FORCE_WIDE"] = "1"
+        try:
+            wide = BatchContext(segs)
+        finally:
+            del os.environ["PINOT_TPU_FORCE_WIDE"]
+        for c in ("tag", "mid", "ts", "m"):
+            narrow.column(c)
+            wide.column(c)
+        assert wide.device_bytes() >= 2.5 * narrow.device_bytes(), (
+            wide.device_bytes(), narrow.device_bytes())
+        # saved-bytes accounting matches the actual delta
+        assert narrow.narrow_saved_bytes() == \
+            wide.device_bytes() - narrow.device_bytes()
+        assert wide.narrow_saved_bytes() == 0
+
+    def test_executor_counters(self, tables):
+        segs, _ = tables
+        eng = _engine(segs)
+        eng.execute("SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'a'")
+        eng.execute("SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'b'")
+        snap = eng.device.hbm_stats()
+        assert snap["batch_misses"] == 1
+        assert snap["batch_hits"] >= 1
+        assert snap["cached_batches"] == 1
+        assert snap["resident_bytes"] > 0
+        assert snap["narrow_saved_bytes"] > 0
+        assert snap["batches"][0]["segments"] == N_SEG
+
+    def test_eviction_churn_parity(self, tmp_path):
+        """Two tables alternating under a byte budget that holds only one
+        batch: every re-admission rebuilds narrow planes and answers must
+        stay stable; the eviction counter proves churn happened."""
+        segs_a, _ = _build_table(tmp_path / "a", seed=23)
+        segs_b, _ = _build_table(tmp_path / "b", seed=29)
+        eng = QueryEngine()
+        for s in segs_a:
+            eng.add_segment("nw", s)
+        for s in segs_b:
+            eng.add_segment("nw2", s)
+        eng.device.MAX_CACHED_BATCHES = 1
+        sqls = ("SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'b'",
+                "SELECT COUNT(*), SUM(m) FROM nw2 WHERE tag = 'b'")
+        first = [eng.execute(s)["resultTable"] for s in sqls]
+        for _ in range(2):
+            for sql, want in zip(sqls, first):
+                assert eng.execute(sql)["resultTable"] == want
+        assert eng.device.hbm_stats()["batch_evictions"] >= 2
+
+
+class TestWidthAudit:
+    def test_audit_passes_and_logs(self, tables, monkeypatch, caplog):
+        import logging
+
+        segs, _ = tables
+        monkeypatch.setenv("PINOT_TPU_WIDTH_AUDIT", "1")
+        eng = _engine(segs)
+        with caplog.at_level(logging.INFO, logger="pinot_tpu.device"):
+            r = eng.execute(
+                "SELECT COUNT(*), SUM(m) FROM nw WHERE tag = 'b'")
+        assert not r.get("exceptions"), r
+        assert any("width audit" in m for m in caplog.messages)
+        assert any("tag: uint8" in m for m in caplog.messages)
+
+    def test_explain_width_table(self, tables, monkeypatch):
+        segs, _ = tables
+        monkeypatch.setenv("PINOT_TPU_WIDTH_AUDIT", "1")
+        eng = _engine(segs)
+        r = eng.execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM nw "
+            "WHERE tag = 'b' AND ts > 10000000100")
+        ops = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("WIDTH(tag: uint8" in o for o in ops), ops
+        assert any("WIDTH(ts: uint16 for-offset=10000000000" in o
+                   for o in ops), ops
+
+    def test_audit_rejects_upcast(self, tables):
+        from pinot_tpu.engine.device import _width_audit
+
+        segs, _ = tables
+        ctx = BatchContext(segs)
+        cols = {"tag": np.zeros((N_SEG, 64), dtype=np.int32)}
+        with pytest.raises(AssertionError, match="upcast"):
+            _width_audit(ctx, cols, {"tag": ("|u1", 0, False, "")})
