@@ -1151,6 +1151,224 @@ _MICRO_R05_REFERENCE = {
 }
 
 
+def bench_faults(n_queries: int = 40):
+    """detail.faults: the failure-domain phase (ISSUE 6). A 3-server /
+    replication-3 cluster over real gRPC serves a group-by while the
+    fault harness blackholes one replica (800 ms connect-timeout shape)
+    and delays another by 200 ms — hedging off vs on — plus a device
+    quarantine demo (a poisoned template routes to host while another
+    keeps running on device).
+
+    Returns (detail, violations); violations non-empty fails the gate:
+    the hedged run must report ZERO query errors and a p99 within 2x the
+    healthy-cluster p99, and the quarantine breaker must isolate exactly
+    the poisoned pipeline. Runnable standalone (CI gate without the full
+    bench): ``python -m bench --phase faults``."""
+    import shutil
+
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import ClusterRegistry
+    from pinot_tpu.common import faults
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.storage.creator import build_segment
+
+    base = tempfile.mkdtemp(prefix="pinot_tpu_faults_")
+    detail: dict = {}
+    violations: list = []
+    # 2 s budget: a blackholed primary without hedging costs at most the
+    # budget (and surfaces as a flagged partial), never a broker-default
+    # 10 s hang
+    sql = ("SET timeoutMs = 2000; SELECT region, COUNT(*), SUM(amount) "
+           "FROM sales GROUP BY region ORDER BY region")
+    registry = ClusterRegistry()
+    controller = Controller(registry, os.path.join(base, "ds"))
+    servers = [
+        ServerInstance(f"srv_{i}", registry, os.path.join(base, f"s{i}"),
+                       device_executor=None)
+        for i in range(3)
+    ]
+    for s in servers:
+        s.start()
+    try:
+        schema = Schema.build(
+            name="sales",
+            dimensions=[("region", DataType.STRING)],
+            metrics=[("amount", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="sales", replication=3)
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(5)
+        rows_per, n_seg = 150_000, 4
+        for i in range(n_seg):
+            cols = {
+                "region": np.array(["na", "eu", "apac", "latam"])[
+                    rng.integers(0, 4, rows_per)],
+                "amount": rng.integers(1, 500, rows_per).astype(np.int32),
+            }
+            d = os.path.join(base, f"up_s{i}")
+            build_segment(schema, cols, d, cfg, f"sales_s{i}")
+            controller.upload_segment("sales", d)
+        t_end = time.time() + 30
+        while time.time() < t_end:
+            ev = registry.external_view("sales_OFFLINE")
+            if len(ev) == n_seg and all(len(v) == 3 for v in ev.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("faults phase: segments never fully loaded")
+
+        def run_mode(broker, n):
+            lats, errors = [], 0
+            rows0 = None
+            for _ in range(n):
+                t0 = time.perf_counter()
+                r = broker.execute(sql)
+                lats.append((time.perf_counter() - t0) * 1e3)
+                if r.get("exceptions"):
+                    errors += 1
+                else:
+                    rows = r["resultTable"]["rows"]
+                    if rows0 is None:
+                        rows0 = rows
+                    elif rows != rows0:
+                        errors += 1  # parity violation counts as an error
+            return {
+                "p50_ms": round(float(np.percentile(lats, 50)), 2),
+                "p99_ms": round(float(np.percentile(lats, 99)), 2),
+                "errors": errors,
+            }, rows0
+
+        b = Broker(registry, timeout_s=10.0)
+        healthy, rows_healthy = run_mode(b, n_queries)
+        b.close()
+        detail["healthy"] = healthy
+
+        # one replica blackholed (800 ms connect-timeout shape: the RPC
+        # hangs, then dies — long enough to dominate an unhedged tail,
+        # short enough that abandoned attempts recycle pool threads and
+        # teach the failure detector), one replica 200 ms slow
+        def arm():
+            faults.clear()
+            faults.install(faults.Fault(
+                point="transport.submit", target="srv_0",
+                mode="blackhole", delay_ms=800))
+            faults.install(faults.Fault(
+                point="transport.submit", target="srv_1",
+                mode="delay", delay_ms=200))
+
+        arm()
+        b = Broker(registry, timeout_s=10.0)
+        hedging_off, rows_off = run_mode(b, n_queries)
+        b.close()
+        detail["faulted_hedging_off"] = hedging_off
+
+        arm()
+        b = Broker(registry, timeout_s=10.0)
+        b.hedging_enabled = True
+        b.hedge_delay_s = 0.025  # fixed trigger: the sweep is about tails
+        hedging_on, rows_on = run_mode(b, n_queries)
+        b.close()
+        faults.clear()
+        detail["faulted_hedging_on"] = hedging_on
+        detail["note"] = (
+            "p50/p99 over sequential group-by queries, 3 servers x "
+            "replication 3, srv_0 blackholed (800ms) + srv_1 delayed "
+            "200ms; hedging duplicates a slow request to a replica after "
+            "25ms, first complete wins")
+
+        if rows_on != rows_healthy:
+            violations.append("hedged rows != healthy rows")
+        if hedging_on["errors"]:
+            violations.append(
+                f"hedged run had {hedging_on['errors']} query errors "
+                f"(bar: 0)")
+        if hedging_on["p99_ms"] >= 2 * healthy["p99_ms"]:
+            violations.append(
+                f"hedged p99 {hedging_on['p99_ms']}ms >= 2x healthy p99 "
+                f"{healthy['p99_ms']}ms")
+    finally:
+        faults.clear()
+        for s in servers:
+            try:
+                s.stop(drain_timeout_s=0.2)
+            except Exception:
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+
+    # ---- device quarantine demo: poisoned template → host, others stay
+    # on device (in-process engine, same fault harness)
+    from pinot_tpu.common import faults as _faults
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    qbase = tempfile.mkdtemp(prefix="pinot_tpu_quarantine_")
+    try:
+        from pinot_tpu.common.datatypes import DataType
+        from pinot_tpu.common.schema import Schema
+        from pinot_tpu.common.table_config import TableConfig
+        from pinot_tpu.storage.creator import build_segment
+
+        schema = Schema.build(
+            name="t", dimensions=[("tag", DataType.STRING)],
+            metrics=[("m", DataType.INT), ("v", DataType.INT)])
+        cfg = TableConfig(table_name="t")
+        rng = np.random.default_rng(9)
+        segs = []
+        for i in range(2):
+            cols = {
+                "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, 50_000)],
+                "m": rng.integers(0, 1000, 50_000).astype(np.int32),
+                "v": rng.integers(0, 1000, 50_000).astype(np.int32),
+            }
+            d = os.path.join(qbase, f"s{i}")
+            build_segment(schema, cols, d, cfg, f"s{i}")
+            segs.append(ImmutableSegment(d))
+        eng = QueryEngine()
+        host = QueryEngine(device_executor=None)
+        for s in segs:
+            eng.add_segment("t", s)
+            host.add_segment("t", s)
+        poisoned = "SELECT SUM(m) FROM t"
+        healthy_sql = "SELECT SUM(v) FROM t WHERE tag <> 'zz'"
+        _faults.install(_faults.Fault(
+            point="device.launch", target="sum(m)", mode="error"))
+        r_p = eng.execute(poisoned)
+        stats = eng.device.hbm_stats()
+        leaves_before = eng.device.fetch_leaves_total
+        r_h = eng.execute(healthy_sql)
+        healthy_on_device = eng.device.fetch_leaves_total > leaves_before
+        _faults.clear()
+        ok_parity = (
+            r_p["resultTable"]["rows"]
+            == host.execute(poisoned)["resultTable"]["rows"]
+            and r_h["resultTable"]["rows"]
+            == host.execute(healthy_sql)["resultTable"]["rows"])
+        detail["device_quarantine"] = {
+            "device_failures": stats["device_failures"],
+            "quarantined_pipelines": stats["quarantined_pipelines"],
+            "poisoned_answers_from_host": ok_parity,
+            "other_template_on_device": bool(healthy_on_device),
+        }
+        if stats["quarantined_pipelines"] != 1:
+            violations.append(
+                f"expected exactly 1 quarantined pipeline, got "
+                f"{stats['quarantined_pipelines']}")
+        if not healthy_on_device:
+            violations.append(
+                "healthy template fell off the device alongside the "
+                "poisoned one")
+        if not ok_parity:
+            violations.append("quarantine path broke result parity")
+    finally:
+        _faults.clear()
+        shutil.rmtree(qbase, ignore_errors=True)
+    return detail, violations
+
+
 def _load_micro_reference():
     """BENCH_r05 micro mrows_per_s per kernel: prefer the recorded
     BENCH_r05.json (driver wrapper: parsed.detail.micro, falling back to
@@ -1230,6 +1448,23 @@ def micro_regression_gate(micro: dict, tolerance: float = 0.25):
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description="pinot-tpu bench")
+    ap.add_argument(
+        "--phase", choices=("full", "faults"), default="full",
+        help="'faults' runs ONLY the failure-domain phase (no dataset "
+             "build) so CI can gate on it standalone")
+    args = ap.parse_args()
+    if args.phase == "faults":
+        detail, violations = bench_faults()
+        print(json.dumps({"metric": "faults-phase standalone",
+                          "detail": {"faults": detail}}))
+        if violations:
+            print(f"faults gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(4)
+        return
     os.makedirs(CACHE, exist_ok=True)
     smoke_gate()
     t0 = time.time()
@@ -1275,6 +1510,7 @@ def main():
     concurrency_detail = bench_concurrency(eng, SSB_QUERIES["q2_range_sum"])
     realtime_detail = bench_realtime()
     chunklet_detail = bench_chunklet()
+    faults_detail, faults_violations = bench_faults()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -1330,6 +1566,7 @@ def main():
                     "concurrency": concurrency_detail,
                     "realtime": realtime_detail,
                     "chunklet": chunklet_detail,
+                    "faults": faults_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -1387,6 +1624,10 @@ def main():
         print(f"micro regression gate FAILED vs {micro_ref_source}: "
               f"{json.dumps(micro_regressions)}", file=sys.stderr)
         sys.exit(3)
+    if faults_violations:
+        print(f"faults gate FAILED: {json.dumps(faults_violations)}",
+              file=sys.stderr)
+        sys.exit(4)
 
 
 if __name__ == "__main__":

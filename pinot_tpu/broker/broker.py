@@ -21,6 +21,8 @@ from typing import Optional
 
 from pinot_tpu.broker.segment_pruner import prune_segments
 from pinot_tpu.cluster.registry import ClusterRegistry, Role, SegmentState
+from pinot_tpu.common import faults
+from pinot_tpu.common.deadline import Deadline
 from pinot_tpu.engine.datatable import decode
 from pinot_tpu.engine.reduce import finalize, merge_intermediates
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
@@ -80,31 +82,110 @@ class QueryQuotaManager:
 
 
 class FailureDetector:
-    """Connection-level failure detector with exponential backoff retry
-    (pinot-broker/.../failuredetector/BaseExponentialBackoffRetryFailureDetector)."""
+    """Connection-level failure detector: exponential backoff + half-open
+    circuit-breaker probing
+    (pinot-broker/.../failuredetector/BaseExponentialBackoffRetryFailureDetector).
+
+    State machine per instance:
+
+        HEALTHY --mark_failure--> OPEN (backoff window, no traffic)
+        OPEN --window elapses--> HALF_OPEN (ONE probe query admitted)
+        HALF_OPEN --probe mark_success--> HEALTHY (backoff forgotten)
+        HALF_OPEN --probe mark_failure--> OPEN (backoff doubled)
+
+    The probe IS a live query the router deliberately sends (try_probe
+    consumes the slot only when the instance is actually picked); while a
+    probe is outstanding, other queries keep routing to healthy replicas
+    so a still-down server costs at most one query per backoff window."""
+
+    ST_HEALTHY, ST_OPEN, ST_HALF_OPEN = "healthy", "open", "half_open"
+    PROBE_TTL_S = 10.0  # a probe that never resolves frees the slot
 
     def __init__(self, initial_backoff_s: float = 1.0, max_backoff_s: float = 30.0):
-        self._unhealthy: dict[str, tuple[float, float]] = {}  # id -> (retry_at, backoff)
+        # id -> [retry_at, backoff, probe_started_or_None]
+        self._unhealthy: dict[str, list] = {}
         self._initial = initial_backoff_s
         self._max = max_backoff_s
         self._lock = threading.Lock()
 
     def mark_failure(self, instance_id: str) -> None:
         with self._lock:
-            _, backoff = self._unhealthy.get(instance_id, (0.0, self._initial / 2))
+            entry = self._unhealthy.get(instance_id)
+            backoff = self._initial / 2 if entry is None else entry[1]
             backoff = min(backoff * 2, self._max)
-            self._unhealthy[instance_id] = (time.time() + backoff, backoff)
+            self._unhealthy[instance_id] = [time.time() + backoff, backoff, None]
 
     def mark_success(self, instance_id: str) -> None:
         with self._lock:
             self._unhealthy.pop(instance_id, None)
 
-    def is_healthy(self, instance_id: str) -> bool:
+    def state(self, instance_id: str) -> str:
         with self._lock:
             entry = self._unhealthy.get(instance_id)
             if entry is None:
-                return True
-            return time.time() >= entry[0]  # retry window open
+                return self.ST_HEALTHY
+            return self.ST_HALF_OPEN if time.time() >= entry[0] \
+                else self.ST_OPEN
+
+    def is_healthy(self, instance_id: str) -> bool:
+        """Routable at all: healthy, or half-open (the backoff window
+        elapsed — the routed query becomes the recovery probe)."""
+        return self.state(instance_id) != self.ST_OPEN
+
+    def try_probe(self, instance_id: str) -> bool:
+        """Claim the half-open instance's single probe slot. True → the
+        caller's query is the probe (its mark_success/mark_failure
+        resolves the state); False → a probe is already in flight (or
+        the window hasn't opened) and the caller should route elsewhere."""
+        with self._lock:
+            entry = self._unhealthy.get(instance_id)
+            if entry is None:
+                return True  # healthy: not a probe at all
+            now = time.time()
+            if now < entry[0]:
+                return False  # still OPEN
+            if entry[2] is not None and now - entry[2] < self.PROBE_TTL_S:
+                return False  # probe outstanding
+            entry[2] = now
+            return True
+
+    def release_probe(self, instance_id: str) -> None:
+        """The probe query never actually ran (cancelled before start —
+        e.g. its entry settled via a hedge): free the slot so the next
+        query can probe instead of waiting out PROBE_TTL_S."""
+        with self._lock:
+            entry = self._unhealthy.get(instance_id)
+            if entry is not None:
+                entry[2] = None
+
+
+class LatencyTracker:
+    """Rolling per-server latency window → the hedging trigger delay
+    (AdaptiveServerSelector's latency EWMA role, simplified to an exact
+    small-window percentile). A server with no history hedges after the
+    default — better to hedge a touch early than never."""
+
+    WINDOW = 64
+
+    def __init__(self, default_s: float = 0.05):
+        self.default_s = default_s
+        self._samples: dict[str, list] = {}  # id -> ring of seconds
+        self._lock = threading.Lock()
+
+    def record(self, instance_id: str, seconds: float) -> None:
+        with self._lock:
+            ring = self._samples.setdefault(instance_id, [])
+            ring.append(seconds)
+            if len(ring) > self.WINDOW:
+                del ring[: len(ring) - self.WINDOW]
+
+    def p90_s(self, instance_id: str) -> float:
+        with self._lock:
+            ring = self._samples.get(instance_id)
+            if not ring:
+                return self.default_s
+            s = sorted(ring)
+            return s[min(len(s) - 1, int(len(s) * 0.9))]
 
 
 class RoutingManager:
@@ -118,11 +199,21 @@ class RoutingManager:
         self._rr = itertools.count()
 
     def routing_table(self, table: str) -> Optional[dict]:
+        routing, _ = self.routing_with_replicas(table)
+        return routing
+
+    def routing_with_replicas(self, table: str) -> tuple:
+        """(routing {instance: [segments]}, replicas {segment: [instances]}).
+
+        The replicas map is what the scatter path's failure handling
+        consumes: on a transport failure (or a hedge trigger) the broker
+        re-sends the failed instance's segment list to another serving
+        replica instead of immediately declaring ``partialResult``."""
         # route on the EXTERNAL VIEW (what servers actually serve), not the
         # ideal-state assignment — assignment may race ahead of loading
         view, records, lineage = self.registry.routing_snapshot(table)
         if not view:
-            return None
+            return None, {}
         # Segment-lineage filter (reference SegmentLineage +
         # SegmentLineageBasedSegmentPreSelector): an IN_PROGRESS replace
         # routes the FROM set (the TO segments are still loading); a
@@ -136,18 +227,34 @@ class RoutingManager:
             )
         offset = next(self._rr)
         out: dict[str, list] = {}
+        replicas: dict[str, list] = {}
         for segment, instances in view.items():
             if segment in excluded:
                 continue
             rec = records.get(segment)
             if rec is not None and rec.state == SegmentState.OFFLINE:
                 continue
-            candidates = [i for i in instances if self.failures.is_healthy(i)]
-            if not candidates:
-                candidates = instances  # all unhealthy: try anyway
-            pick = candidates[offset % len(candidates)]
+            replicas[segment] = list(instances)
+            # healthy replicas take traffic; a half-open one (backoff
+            # window elapsed) joins the pool and, when the round-robin
+            # actually picks it, claims the single probe slot — its query
+            # is the recovery probe. If the probe slot is taken, fall
+            # back to a healthy replica.
+            healthy, half_open = [], []
+            for i in instances:
+                st = self.failures.state(i)
+                if st == FailureDetector.ST_HEALTHY:
+                    healthy.append(i)
+                elif st == FailureDetector.ST_HALF_OPEN:
+                    half_open.append(i)
+            pool = healthy + half_open
+            if not pool:
+                pool, half_open = list(instances), []  # all down: try anyway
+            pick = pool[offset % len(pool)]
+            if pick in half_open and not self.failures.try_probe(pick):
+                pick = healthy[offset % len(healthy)] if healthy else pick
             out.setdefault(pick, []).append(segment)
-        return out
+        return out, replicas
 
 
 class Broker:
@@ -168,6 +275,22 @@ class Broker:
         self.quota = QueryQuotaManager(registry)
         self.failures = FailureDetector()
         self.routing = RoutingManager(registry, self.failures)
+        self.latency = LatencyTracker()
+        # failure-handling knobs (reference: pinot.broker.* config keys):
+        # retry re-sends a failed instance's segments to a replica before
+        # declaring partialResult; hedging duplicates a slow request to a
+        # second replica after the per-server rolling p90 (SET
+        # useHedging=true overrides per query)
+        from pinot_tpu.common.config import Configuration
+
+        conf = Configuration()
+        self.retry_enabled = conf.get_bool(
+            "pinot.broker.failure.retry.enabled", True)
+        self.hedging_enabled = conf.get_bool(
+            "pinot.broker.hedging.enabled", False)
+        # fixed hedge delay override; <= 0 means adaptive (rolling p90)
+        self.hedge_delay_s = conf.get_float(
+            "pinot.broker.hedging.delay.ms", 0.0) / 1e3
         self._channels: dict[str, QueryRouterChannel] = {}
         self._channels_lock = threading.Lock()
         self._request_id = itertools.count(1)
@@ -177,6 +300,29 @@ class Broker:
         for ch in self._channels.values():
             ch.close()
         self._pool.shutdown(wait=False)
+
+    def _note_abandoned(self, fut, inst: str) -> None:
+        """A straggler attempt resolved AFTER its entry settled (hedge
+        loser, cancelled-too-late retry): its outcome still feeds the
+        failure detector — a blackholed replica must not stay HEALTHY
+        just because a hedge won every race."""
+        from pinot_tpu.engine.datatable import (
+            ServerQueryError,
+            ServerShuttingDown,
+        )
+
+        try:
+            exc = fut.exception()
+        except futures.CancelledError:
+            return
+        # ServerShuttingDown is a ServerQueryError on the wire but a
+        # FAILURE to the detector (same treatment harvest gives it): a
+        # draining server must stay backed off, not bounce back healthy
+        if exc is None or (isinstance(exc, ServerQueryError)
+                           and not isinstance(exc, ServerShuttingDown)):
+            self.failures.mark_success(inst)
+        else:
+            self.failures.mark_failure(inst)
 
     def _channel(self, instance_id: str) -> Optional[QueryRouterChannel]:
         info = {i.instance_id: i for i in self.registry.instances(Role.SERVER)}.get(
@@ -246,7 +392,10 @@ class Broker:
                 return {"exceptions": [{
                     "errorCode": 429,
                     "message": f"query quota exceeded for table "
-                               f"{q.table_name!r}"}]}
+                               f"{q.table_name!r}"}],
+                    # pacing hint for clients (Retry-After analog): the
+                    # token bucket refills within about a second
+                    "retryAfterSeconds": 0.5}
             if q.options_ci().get("trace"):
                 tracer = trace.start_trace()
             resp = self._scatter_gather(q, sql)
@@ -357,21 +506,36 @@ class Broker:
         q = self._expand_star(q)
         request_id = next(self._request_id)
         # per-query timeout override (SET timeoutMs = N — the reference's
-        # timeoutMs query option)
+        # timeoutMs query option). The Deadline is THE budget: every
+        # scatter request ships the remaining window, every gather wait is
+        # clamped to it, and expiry yields a typed QUERY_TIMEOUT partial.
         opts = q.options_ci()
         timeout_s = self.timeout_s
         if "timeoutms" in opts:
             timeout_s = max(0.001, float(opts["timeoutms"]) / 1000.0)
+        deadline = Deadline(timeout_s)
+        # SET faultInject='point[@target]=mode[:arg][#times];...' arms the
+        # chaos harness from a query (one-shot per entry unless the spec
+        # says otherwise) — the SQL-driven face of PINOT_TPU_FAULTS
+        fi = opts.get("faultinject")
+        if fi:
+            for f in faults.parse_spec(str(fi)):
+                if f.times is None:
+                    f.times = 1
+                faults.install(f)
 
         scatter = []  # (instance, physical table, segments, time_filter)
+        replicas: dict = {}  # (physical, segment) -> serving instances
         n_servers = set()
         num_pruned = 0
         num_pruned_value = 0  # excluded by per-column min/max stats alone
         fully_pruned = []  # fallback: keep one segment so reduce sees a shape
         for physical, time_filter in self._physical_tables(q.table_name):
-            routing = self.routing.routing_table(physical)
+            routing, reps = self.routing.routing_with_replicas(physical)
             if not routing:
                 continue
+            for seg, insts in reps.items():
+                replicas[(physical, seg)] = insts
             records = self.registry.segments(physical)
             cfg = self.registry.table_config(physical)
             time_col = cfg.time_column if cfg is not None else None
@@ -417,69 +581,358 @@ class Broker:
         rows_lock = threading.Lock()
 
         def call(instance_id: str, physical: str, segments: list, time_filter):
+            if faults.ACTIVE:
+                # chaos seam: drop / delay / blackhole this replica's RPC
+                # (a blackhole sleeps at most the remaining budget — the
+                # gRPC deadline would have freed the thread the same way)
+                faults.inject("transport.submit", target=instance_id,
+                              bound_ms=deadline.remaining_ms())
             ch = self._channel(instance_id)
             if ch is None:
                 raise ConnectionError(f"server {instance_id} not registered")
+            # ship the REMAINING budget, not the original timeout: the
+            # server bounds every downstream wait by it and answers a
+            # typed QUERY_TIMEOUT instead of computing an abandoned result
+            budget_ms = max(1.0, deadline.remaining_ms())
             payload = make_instance_request(
                 sql, segments, request_id, self.broker_id,
                 table=physical, time_filter=time_filter,
+                timeout_ms=budget_ms,
             )
+            # small grace past the shipped budget: the server's own
+            # deadline fires first; the RPC deadline is the backstop
+            rpc_timeout_s = budget_ms / 1e3 + 0.25
+            t0 = time.perf_counter()
             if not use_streaming:
-                return [decode(ch.submit(payload, timeout_s))]
-            stream = ch.submit_streaming(payload, timeout_s)
-            parts = []
-            for block in stream:
-                r = decode(bytes(block))
-                parts.append(r)
-                n = len(next(iter(r.rows.values()))) if r.rows else 0
-                with rows_lock:
-                    rows_seen[0] += n
-                    done = rows_seen[0] >= row_budget
-                if done:
-                    stream.cancel()
-                    break
+                parts = [decode(ch.submit(payload, rpc_timeout_s))]
+            else:
+                stream = ch.submit_streaming(payload, rpc_timeout_s)
+                parts = []
+                contributed = 0
+                try:
+                    for block in stream:
+                        r = decode(bytes(block))
+                        parts.append(r)
+                        n = len(next(iter(r.rows.values()))) if r.rows else 0
+                        with rows_lock:
+                            rows_seen[0] += n
+                            contributed += n
+                            done = rows_seen[0] >= row_budget
+                        if done:
+                            stream.cancel()
+                            break
+                except BaseException:
+                    # a failed attempt's blocks are DISCARDED: roll their
+                    # rows back out of the shared budget, or a successful
+                    # retry would report a "complete" result that silently
+                    # stopped other entries' streams short of LIMIT
+                    with rows_lock:
+                        rows_seen[0] -= contributed
+                    raise
+            # rolling latency feeds the adaptive hedge delay (p90)
+            self.latency.record(instance_id, time.perf_counter() - t0)
             return parts
 
-        futs = {
-            self._pool.submit(call, inst, phys, segs, tf): inst
-            for inst, phys, segs, tf in scatter
-        }
-        from pinot_tpu.engine.datatable import NoSegmentsHosted, ServerQueryError
+        from pinot_tpu.engine.datatable import (
+            NoSegmentsHosted,
+            QueryTimeoutError,
+            ServerQueryError,
+            ServerShuttingDown,
+        )
+
+        # ---- scatter with per-entry failure handling ---------------------
+        # Each scatter entry tracks every attempt (primary + retry +
+        # hedge) WITH the segment list that attempt covers: a retry may
+        # have to SPLIT the failed instance's segments across several
+        # replicas when no single replica serves them all, and the reduce
+        # must never count a segment twice when both a primary and its
+        # hedge answer. Transient failures of a fully-served entry are
+        # dropped (the result is complete); only unrecovered failures
+        # surface as partialResult exceptions.
+        entries_lock = threading.Lock()
+        entries = []
+
+        def submit_attempt(e, inst, segs=None):
+            segs = e["segs"] if segs is None else segs
+            fut = self._pool.submit(call, inst, e["phys"], segs, e["tf"])
+            with entries_lock:
+                e["futs"].append((fut, inst, frozenset(segs)))
+            fut.add_done_callback(lambda _f, _ev=e["ev"]: _ev.set())
+            return fut
+
+        def alternate_for(e):
+            """A not-yet-attempted replica serving EVERY segment of the
+            entry (healthy first, then backing-off as a last resort).
+            None when no single replica covers the list (hedging skips;
+            retry falls back to a split — retry_groups)."""
+            cands = None
+            for seg in e["segs"]:
+                insts = set(replicas.get((e["phys"], seg), ()))
+                cands = insts if cands is None else cands & insts
+            cands = [i for i in (cands or ()) if i not in e["attempted"]]
+            healthy = [i for i in cands if self.failures.is_healthy(i)]
+            pool = healthy or cands
+            return pool[0] if pool else None
+
+        def retry_groups(e):
+            """{instance: [segments]} re-covering the entry's list on
+            not-yet-attempted replicas, split per segment when needed
+            (healthy replicas first; fewest instances greedily). Segments
+            with no remaining replica are left out — they surface as the
+            partial's exceptions."""
+            groups: dict = {}
+            for seg in e["segs"]:
+                cands = [i for i in replicas.get((e["phys"], seg), ())
+                         if i not in e["attempted"]]
+                healthy = [i for i in cands if self.failures.is_healthy(i)]
+                pool = healthy or cands
+                if not pool:
+                    continue
+                pick = next((i for i in pool if i in groups), pool[0])
+                groups.setdefault(pick, []).append(seg)
+            return groups
+
+        for inst, phys, segs, tf in scatter:
+            e = {
+                "inst": inst, "phys": phys, "segs": segs, "tf": tf,
+                "futs": [], "ev": threading.Event(), "attempted": {inst},
+                "consumed": set(),
+            }
+            submit_attempt(e, inst)
+            entries.append(e)
+
+        # hedging (SET useHedging=true / pinot.broker.hedging.enabled):
+        # after the target replica's rolling p90 (or the configured fixed
+        # delay), duplicate a still-unanswered request to a second
+        # replica; first complete wins, the loser is cancelled/ignored.
+        # Streaming selections don't hedge — the duplicate's blocks would
+        # double-count against the shared row budget.
+        hedging = (not use_streaming) and (
+            opts.get("usehedging") is True
+            or (self.hedging_enabled and opts.get("usehedging") is not False))
+
+        def maybe_hedge(e):
+            if deadline.expired():
+                return
+            with entries_lock:
+                if any(f.done() for f, _i, _s in e["futs"]):
+                    return
+                alt = alternate_for(e)
+                # no single replica covers the list: hedge the split form
+                # (disjoint subsets — the coverage-aware resolve composes
+                # them exactly like a split retry)
+                groups = {alt: e["segs"]} if alt is not None \
+                    else retry_groups(e)
+                if not groups:
+                    return
+                e["attempted"].update(groups)
+            self.metrics.count("hedgedRequests")
+            for inst2, segs2 in groups.items():
+                submit_attempt(e, inst2, segs2)
+
+        timers = []
+        if hedging:
+            for e in entries:
+                fixed = self.hedge_delay_s
+                delay = fixed if fixed > 0 else self.latency.p90_s(e["inst"])
+                delay = max(0.005, min(delay, deadline.remaining_s() * 0.5))
+                t = threading.Timer(delay, maybe_hedge, args=(e,))
+                t.daemon = True
+                t.start()
+                timers.append(t)
 
         results, exceptions = [], []
         query_errors = []
         server_traces = {}
-        responded = set()  # instances, not blocks (streaming yields many)
+        responded = set()  # instances whose response was USED
+        attempted_all = set()
+
+        def harvest(e):
+            """Resolve one entry within the deadline → (successes, errors)
+            where successes is a list of (parts, inst) whose segment
+            coverage is DISJOINT (no segment reduced twice even when both
+            a primary and its hedge answered) and errors is the
+            unrecovered (errorCode, message) list — empty when the entry
+            was fully served."""
+            retried = False
+            errors = []  # (errorCode, message) — dropped if fully served
+            successes = []  # (covered frozenset, parts, inst)
+            all_segs = frozenset(e["segs"])
+
+            def resolved():
+                """Disjoint success subset covering the whole entry, or
+                None. A single full-coverage attempt (primary or hedge)
+                wins outright; split retries compose by disjoint union."""
+                full = next((s for s in successes if s[0] >= all_segs),
+                            None)
+                if full is not None:
+                    return [full]
+                chosen, covered = [], set()
+                for s in successes:
+                    if not (s[0] & covered):
+                        chosen.append(s)
+                        covered |= s[0]
+                return chosen if covered >= all_segs else None
+
+            def best_partial():
+                """Maximal disjoint subset when full coverage is out of
+                reach (partialResult: honest parts + honest exceptions)."""
+                chosen, covered = [], set()
+                for s in successes:
+                    if not (s[0] & covered):
+                        chosen.append(s)
+                        covered |= s[0]
+                return chosen
+
+            def try_retry():
+                nonlocal retried
+                if not self.retry_enabled or retried or deadline.expired():
+                    return
+                groups = retry_groups(e)
+                if not groups:
+                    return
+                retried = True
+                self.metrics.count("retriedRequests")
+                with entries_lock:
+                    e["attempted"].update(groups)
+                for inst2, segs2 in groups.items():
+                    submit_attempt(e, inst2, segs2)
+
+            def finish(done):
+                """Cancel/ignore still-pending attempts, settle errors.
+                Attempts that can no longer be cancelled (already
+                running — e.g. the blackholed loser of a won hedge race)
+                still report their eventual outcome to the failure
+                detector, so a dead replica doesn't stay HEALTHY just
+                because a hedge always wins first."""
+                with entries_lock:
+                    futs = list(e["futs"])
+                for f, i, _s in futs:
+                    if id(f) in e["consumed"]:
+                        continue
+                    if f.cancel():
+                        # the attempt never ran: if its routing claimed a
+                        # half-open probe slot, free it — no outcome will
+                        self.failures.release_probe(i)
+                    else:
+                        f.add_done_callback(
+                            lambda _f, _i=i: self._note_abandoned(_f, _i))
+                if done is not None:
+                    if errors:
+                        # a replica answered after a failure: recovered —
+                        # the result is complete, no partialResult
+                        self.metrics.count("recoveredRequests")
+                    return [(s[1], s[2]) for s in done], []
+                return [(s[1], s[2]) for s in best_partial()], errors
+
+            while True:
+                with entries_lock:
+                    futs = list(e["futs"])
+                ready = [t for t in futs
+                         if t[0].done() and id(t[0]) not in e["consumed"]]
+                if not ready:
+                    done = resolved()
+                    if done is not None:
+                        return finish(done)
+                    live = [t for t in futs if id(t[0]) not in e["consumed"]]
+                    if not live:
+                        return finish(None)  # every attempt consumed
+                    left = deadline.remaining_s()
+                    if left <= 0:
+                        # budget gone with attempts still in flight:
+                        # typed QUERY_TIMEOUT per pending instance — the
+                        # broker answers within deadline + grace, never
+                        # hangs on a straggler
+                        errors.extend(
+                            (250, f"QUERY_TIMEOUT: {i} did not respond "
+                                  f"within the {timeout_s * 1e3:.0f}ms "
+                                  f"query budget")
+                            for _f, i, _s in live)
+                        return finish(None)
+                    e["ev"].wait(min(left, 0.25))
+                    e["ev"].clear()
+                    continue
+                for fut, inst, segs_of in ready:
+                    e["consumed"].add(id(fut))
+                    if fut.cancelled():
+                        continue
+                    try:
+                        parts = fut.result()
+                    except NoSegmentsHosted:
+                        # benign routing/sync race: segments moved between
+                        # the external-view read and the RPC; not a
+                        # failure — the attempt's share counts covered
+                        self.failures.mark_success(inst)
+                        successes.append((segs_of, [], inst))
+                        continue
+                    except QueryTimeoutError as exc:
+                        # server-side typed timeout: the server is healthy,
+                        # the budget just ran out there
+                        self.failures.mark_success(inst)
+                        errors.append((250, f"{inst}: {exc}"))
+                        continue  # a hedge may still win
+                    except ServerShuttingDown as exc:
+                        # retriable by contract: the submit was rejected
+                        # before any execution touched the data
+                        self.failures.mark_failure(inst)
+                        errors.append(
+                            (427, f"SERVER_NOT_RESPONDING: {inst}: {exc}"))
+                        try_retry()
+                        continue
+                    except ServerQueryError as exc:
+                        # query-level error (bad column etc.): the server
+                        # is healthy; report in-band, don't poison the
+                        # detector, and don't retry — a replica would fail
+                        # identically
+                        self.failures.mark_success(inst)
+                        query_errors.append(
+                            {"errorCode": 200, "message": f"{inst}: {exc}"})
+                        return finish(None)
+                    except Exception as exc:  # noqa: BLE001 — transport
+                        self.failures.mark_failure(inst)
+                        errors.append(
+                            (427, f"SERVER_NOT_RESPONDING: {inst}: {exc}"))
+                        try_retry()
+                        continue
+                    self.failures.mark_success(inst)
+                    successes.append((segs_of, parts, inst))
+                done = resolved()
+                if done is not None:
+                    return finish(done)
+
         with span("broker.scatter_gather"):
-            for fut, inst in futs.items():
-                try:
-                    for r in fut.result(timeout=timeout_s + 1):
+            for e in entries:
+                served, errs = harvest(e)
+                attempted_all |= e["attempted"]
+                exceptions.extend(
+                    {"errorCode": code, "message": msg}
+                    for code, msg in errs)
+                for parts, inst in served:
+                    for r in parts:
                         if r.trace is not None:
                             server_traces[inst] = r.trace
                         results.append(r)
-                    responded.add(inst)
-                    self.failures.mark_success(inst)
-                except NoSegmentsHosted:
-                    # benign routing/sync race: segments moved between the
-                    # external-view read and the RPC; not a server failure
-                    self.failures.mark_success(inst)
-                except ServerQueryError as e:
-                    # query-level error (bad column etc.): the server is
-                    # healthy; report in-band, don't poison the detector
-                    self.failures.mark_success(inst)
-                    query_errors.append(
-                        {"errorCode": 200, "message": f"{inst}: {e}"}
-                    )
-                except Exception as e:  # noqa: BLE001 — transport failure
-                    self.failures.mark_failure(inst)
-                    exceptions.append(
-                        {"errorCode": 427,
-                         "message": f"SERVER_NOT_RESPONDING: {inst}: {e}"}
-                    )
+                    if parts:
+                        responded.add(inst)
+        for t in timers:
+            t.cancel()
+        if any(x["errorCode"] == 250 for x in exceptions):
+            self.metrics.count("queryTimeouts")
         if query_errors:
             return {"exceptions": query_errors}
         if not results:
             self.metrics.count("serverFailures", len(exceptions))
+            if any(x["errorCode"] == 250 for x in exceptions):
+                # nothing answered before the budget expired: a typed
+                # in-band QUERY_TIMEOUT response, delivered promptly —
+                # not an opaque ConnectionError after N server waits
+                return {
+                    "exceptions": exceptions,
+                    "partialResult": True,
+                    "numServersQueried": len(n_servers | attempted_all),
+                    "numServersResponded": len(responded),
+                    "requestId": request_id,
+                }
             raise ConnectionError(f"all servers failed: {exceptions}")
 
         with span("broker.reduce"):
@@ -493,7 +946,10 @@ class Broker:
             {
                 "exceptions": exceptions,
                 "partialResult": bool(exceptions),
-                "numServersQueried": len(n_servers),
+                # queried counts every instance the broker dispatched to
+                # (primary fan-out + retries + hedges); responded counts
+                # the instances whose answers the reduce actually used
+                "numServersQueried": len(n_servers | attempted_all),
                 "numServersResponded": len(responded),
                 "numDocsScanned": stats.num_docs_scanned,
                 "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,

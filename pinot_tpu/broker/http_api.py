@@ -50,11 +50,14 @@ class BrokerHttpServer:
             def log_message(self, *args):  # quiet
                 pass
 
-            def _send(self, code: int, payload: dict) -> None:
+            def _send(self, code: int, payload: dict,
+                      headers: dict = None) -> None:
                 body = json.dumps(payload).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -129,7 +132,22 @@ class BrokerHttpServer:
                                        f"{denied!r} for principal "
                                        f"{principal!r}"}]})
                         return
-                    self._send(200, outer.broker.execute(sql))
+                    resp = outer.broker.execute(sql)
+                    excs = resp.get("exceptions") or []
+                    if excs and all(x.get("errorCode") == 429 for x in excs):
+                        # over-quota: a real 429 status + Retry-After so
+                        # standards clients (and our DB-API driver) can
+                        # back off and retry instead of failing the call.
+                        # The header derives from the broker's own pacing
+                        # hint, ceiled to RFC delta-seconds (integers)
+                        import math
+
+                        after = math.ceil(float(
+                            resp.get("retryAfterSeconds", 1.0)))
+                        self._send(429, resp,
+                                   headers={"Retry-After": str(max(1, after))})
+                        return
+                    self._send(200, resp)
                 except Exception as e:  # noqa: BLE001
                     self._send(
                         200,

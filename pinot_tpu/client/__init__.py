@@ -189,11 +189,40 @@ class Connection:
         self._timeout_s = timeout_s
         self._closed = False
 
+    # over-quota (429) handling: one bounded retry after Retry-After —
+    # a per-table QPS quota is a *pacing* signal, not a hard failure;
+    # the sleep is capped so a hostile/buggy header can't hang a client
+    MAX_RETRY_AFTER_S = 5.0
+
+    @staticmethod
+    def _retry_after_s(value) -> float:
+        try:
+            return max(0.05, min(float(value), Connection.MAX_RETRY_AFTER_S))
+        except (TypeError, ValueError):
+            return 0.5
+
+    @staticmethod
+    def _is_quota_rejection(resp: dict) -> bool:
+        excs = resp.get("exceptions") or []
+        return bool(excs) and all(x.get("errorCode") == 429 for x in excs)
+
     def _execute(self, sql: str) -> dict:
         if self._closed:
             raise ProgrammingError("connection is closed")
         if self._broker is not None:
-            return self._broker.execute(sql)
+            resp = self._broker.execute(sql)
+            if self._is_quota_rejection(resp):
+                # in-process brokers ship the 429 in-band; honor the
+                # response's own hint when present, then retry ONCE
+                import time
+
+                time.sleep(self._retry_after_s(
+                    resp.get("retryAfterSeconds", 0.5)))
+                resp = self._broker.execute(sql)
+            return resp
+        return self._execute_http(sql, retry_quota=True)
+
+    def _execute_http(self, sql: str, retry_quota: bool) -> dict:
         headers = {"Content-Type": "application/json"}
         if self._auth_header:
             headers["Authorization"] = self._auth_header
@@ -213,6 +242,14 @@ class Connection:
                 raise DatabaseError(
                     "authentication failed (HTTP 401): check the "
                     "connection's auth=(user, password)") from e
+            if e.code == 429 and retry_quota:
+                # over-quota: back off for the broker's Retry-After
+                # (bounded) and retry once before surfacing the error
+                import time
+
+                time.sleep(self._retry_after_s(
+                    e.headers.get("Retry-After") if e.headers else None))
+                return self._execute_http(sql, retry_quota=False)
             raise DatabaseError(f"broker returned HTTP {e.code}") from e
         except Exception as e:  # noqa: BLE001 — transport failure
             raise DatabaseError(f"broker unreachable: {e}") from e

@@ -38,6 +38,19 @@ class NoSegmentsHosted(ServerQueryError):
     race; the broker skips this partial without marking a failure)."""
 
 
+class QueryTimeoutError(ServerQueryError):
+    """The server aborted because the query's propagated deadline expired
+    (errorCode 250 shape). The server is HEALTHY — the broker reports the
+    timeout in-band as a partial, without poisoning its failure
+    detector."""
+
+
+class ServerShuttingDown(ServerQueryError):
+    """The server is draining for shutdown and rejected the submit before
+    execution. RETRIABLE: the broker should re-send the segment list to a
+    replica — the data was never touched."""
+
+
 def encode_error(kind: str, message: str) -> bytes:
     import json as _json
 
@@ -274,6 +287,10 @@ def decode(data: bytes) -> IntermediateResult:
         info = json.loads(data[4:].decode("utf-8"))
         if info.get("kind") == "no_segments":
             raise NoSegmentsHosted(info["message"])
+        if info.get("kind") == "query_timeout":
+            raise QueryTimeoutError(info["message"])
+        if info.get("kind") == "server_shutting_down":
+            raise ServerShuttingDown(info["message"])
         raise ServerQueryError(info["message"])
     if data[:4] != MAGIC:
         raise ValueError("bad DataTable magic")

@@ -19,14 +19,17 @@ merge: groups are already aligned across segments when the scatter lands.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
+import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from pinot_tpu.common import faults
 from pinot_tpu.engine import aggspec
 from pinot_tpu.engine.inflight import InflightLaunch, LaunchCoalescer
 from pinot_tpu.engine.params import (
@@ -61,6 +64,26 @@ MAX_PRESENCE_CELLS = 1 << 24      # distinctcount (G, C) presence guard
 # min(num_groups_limit, this)); overflow falls back to the host path
 MAX_SORTED_GROUPS = 1 << 17
 SORTED_AGGS = ("count", "sum", "avg", "min", "max", "minmaxrange")
+
+log = logging.getLogger("pinot_tpu.engine.device")
+
+# device-runtime failure detection (launch/fetch recovery): jaxlib raises
+# XlaRuntimeError for device-side faults (RESOURCE_EXHAUSTED / INTERNAL /
+# device OOM); exact types vary across jax versions, so match by type
+# name across the MRO, plus the fault harness's simulated form
+_DEVICE_ERROR_NAMES = frozenset(
+    ("XlaRuntimeError", "InternalError", "ResourceExhausted",
+     "ResourceExhaustedError"))
+
+
+def _is_device_runtime_error(e) -> bool:
+    """True for failures of the DEVICE runtime (recoverable by evict +
+    retry + host fallback) as opposed to template-build/user errors."""
+    if isinstance(e, faults.InjectedDeviceError):
+        return True
+    if any(t.__name__ in _DEVICE_ERROR_NAMES for t in type(e).__mro__):
+        return True
+    return isinstance(e, RuntimeError) and "RESOURCE_EXHAUSTED" in str(e)
 
 
 def segment_device_eligible(seg) -> bool:
@@ -960,6 +983,15 @@ class DeviceExecutor:
         self.batch_hits = 0
         self.batch_misses = 0
         self.batch_evictions = 0
+        # device-error recovery (failure-domain hardening): per-(template,
+        # batch) failure counts feed a quarantine circuit breaker — a
+        # pipeline that keeps failing on device routes to the host path
+        # so one poisoned shape can't take down the executor. Counters
+        # surface through hbm_stats() and the server's /metrics gauges.
+        self.launch_failures = 0         # device-runtime failures observed
+        self._pipeline_failures: dict = {}   # (template, batch_key) -> n
+        self._quarantined: dict = {}         # key -> quarantined-at ts
+        self._poisoned_batches: set = set()  # evict once their pins drain
         # last-launch capture for kernel profiling (bench breakdown):
         # (pipeline, cols, n_docs, params, bytes_in). OPT-IN: retaining
         # the launch pins a whole batch's HBM past the batch cache's
@@ -1092,6 +1124,11 @@ class DeviceExecutor:
                 "batch_hits": self.batch_hits,
                 "batch_misses": self.batch_misses,
                 "batch_evictions": self.batch_evictions,
+                # device-error recovery counters (failure-domain view):
+                # launch/fetch device-runtime failures and pipelines the
+                # circuit breaker has routed to host
+                "device_failures": self.launch_failures,
+                "quarantined_pipelines": len(self._quarantined),
             }
         per_batch = [
             {
@@ -1125,9 +1162,126 @@ class DeviceExecutor:
             else:
                 self._inflight_launches.pop(key, None)
             self.inflight -= 1
+            # a fetch-time device failure marked this batch poisoned:
+            # evict it as soon as the last in-flight pin drains, so the
+            # next query re-uploads fresh device buffers
+            if key in self._poisoned_batches \
+                    and key not in self._inflight_launches:
+                self._poisoned_batches.discard(key)
+                if self._batches.pop(key, None) is not None:
+                    self.batch_evictions += 1
         # byte cap re-check after the fetch (columns materialize lazily,
         # so the batch may have grown during this query)
         self._evict(keep=key)
+
+    # ---- device-error recovery (launch/fetch failures) -------------------
+    QUARANTINE_AFTER = 2       # failures of one (template, batch) → host
+    QUARANTINE_TTL_S = 300.0   # then probe the device again (half-open)
+    MAX_FAILURE_KEYS = 1024    # failure-count map bound (diverse workloads)
+
+    def _record_device_failure(self, template, batch_key) -> bool:
+        """Count a device-runtime failure against (template, batch) and
+        trip the quarantine breaker past the threshold. Compiled
+        pipelines for the template are dropped (a retry recompiles from
+        scratch). Returns True when the key is now quarantined."""
+        with self._lock:
+            self.launch_failures += 1
+            key = (template, batch_key)
+            if key not in self._pipeline_failures and \
+                    len(self._pipeline_failures) >= self.MAX_FAILURE_KEYS:
+                self._pipeline_failures.pop(
+                    next(iter(self._pipeline_failures)))
+            n = self._pipeline_failures.get(key, 0) + 1
+            self._pipeline_failures[key] = n
+            if n >= self.QUARANTINE_AFTER:
+                self._quarantined[key] = time.monotonic()
+            for pk in [pk for pk in self._pipelines if pk[0] == template]:
+                self._pipelines.pop(pk)
+            return key in self._quarantined
+
+    def _note_device_success(self, template, batch_key) -> None:
+        """A successful fetch clears the key's strike count: the breaker
+        trips on failures close together, not on two transient faults a
+        week apart over thousands of good launches."""
+        with self._lock:
+            self._pipeline_failures.pop((template, batch_key), None)
+
+    def _is_quarantined(self, template, batch_key) -> bool:
+        with self._lock:
+            key = (template, batch_key)
+            ts = self._quarantined.get(key)
+            if ts is None:
+                return False
+            if time.monotonic() - ts >= self.QUARANTINE_TTL_S:
+                # half-open: after the cooldown the next launch probes the
+                # device again with a fresh strike count — two more
+                # failures re-quarantine for another window
+                self._quarantined.pop(key, None)
+                self._pipeline_failures.pop(key, None)
+                return False
+            return True
+
+    def reset_quarantine(self) -> None:
+        """Operational reset (tests / admin): forget failure history."""
+        with self._lock:
+            self._pipeline_failures.clear()
+            self._quarantined.clear()
+
+    def _evict_batch(self, key) -> bool:
+        """Drop the implicated BatchContext after a device failure so a
+        retry re-uploads fresh buffers (RESOURCE_EXHAUSTED usually means
+        this batch's blocks are what needs freeing). Batches other
+        launches still pin are deferred to _release_launch via the
+        poisoned set."""
+        with self._lock:
+            if key in self._inflight_launches:
+                self._poisoned_batches.add(key)
+                return False
+            if self._batches.pop(key, None) is not None:
+                self.batch_evictions += 1
+                return True
+            return False
+
+    def on_fetch_device_error(self, e, template, batch_key) -> None:
+        """InflightLaunch.fetch error hook: a device-runtime failure on
+        the blocking fetch counts toward the quarantine breaker, marks
+        the batch for eviction, and converts to DeviceUnsupported — the
+        engine then re-runs the batch's segments on the host through its
+        fallback gate. Non-device errors return so the caller re-raises
+        the original."""
+        if not _is_device_runtime_error(e):
+            return
+        # a coalesced cohort re-raises ONE shared exception to every
+        # member: count the failure event once, not once per member —
+        # otherwise a single transient fault on a 2+-member cohort trips
+        # the 2-strike quarantine instantly
+        if not getattr(e, "_pinot_failure_counted", False):
+            try:
+                e._pinot_failure_counted = True
+            except Exception:  # noqa: BLE001 — slotted exceptions
+                pass
+            quarantined = self._record_device_failure(template, batch_key)
+            self._evict_batch(batch_key)
+            log.warning(
+                "device fetch failed (%s: %s); batch evicted%s — host "
+                "fallback", type(e).__name__, e,
+                ", pipeline QUARANTINED to host" if quarantined else "")
+        raise DeviceUnsupported(
+            f"device fetch failed ({type(e).__name__}); host fallback"
+        ) from e
+
+    @staticmethod
+    def _fault_target(q) -> str:
+        """Stable per-query-shape label the fault harness matches
+        ``target`` filters against (lets a chaos test poison ONE
+        template while others keep running on device)."""
+        bits = [q.table_name or ""]
+        for a in (q.aggregations() or ()):
+            arg = a.args[0].name if a.args and a.args[0].is_identifier \
+                else ""
+            bits.append(f"{a.name}({arg})")
+        bits.extend(g.name for g in (q.group_by or ()) if g.is_identifier)
+        return ":".join(bits)
 
     def _make_resolve(self, bufs_dev, layout):
         """fetch-phase closure shared by solo and cohort launches: ONE
@@ -1136,6 +1290,8 @@ class DeviceExecutor:
         def resolve():
             import time as _time
 
+            if faults.ACTIVE:
+                faults.inject("device.fetch")
             _t_get = _time.perf_counter()
             bufs = jax.device_get(bufs_dev)
             # blocking wait = link round trip + kernel; bench subtracts it
@@ -1243,17 +1399,44 @@ class DeviceExecutor:
         # the batch stays pinned for the WHOLE launch — template build and
         # column materialization included, not just the dispatched flight
         # (retain=True takes the pin atomically with the cache insert)
-        ctx = self.batch_for(segments, retain=True)
         batch_key = self._batch_key(segments)
-        try:
-            return self._launch_pinned(q, ctx, batch_key, segments,
-                                       aggs, final, alive)
-        except BaseException:
-            self._release_launch(batch_key)
-            raise
+        last_err = None
+        for attempt in (0, 1):  # one in-place retry after a device failure
+            ctx = self.batch_for(segments, retain=True)
+            tpl_box: list = []
+            try:
+                return self._launch_pinned(q, ctx, batch_key, segments,
+                                           aggs, final, alive, tpl_box)
+            except BaseException as e:
+                self._release_launch(batch_key)
+                if not _is_device_runtime_error(e):
+                    raise
+                # device-runtime failure (XlaRuntimeError /
+                # RESOURCE_EXHAUSTED, real or injected): count it toward
+                # the quarantine breaker, evict the implicated batch so
+                # the retry re-uploads fresh buffers, retry ONCE on
+                # device, then fall back to the host path
+                last_err = e
+                quarantined = False
+                if tpl_box:
+                    quarantined = self._record_device_failure(
+                        tpl_box[0], batch_key)
+                else:
+                    with self._lock:
+                        self.launch_failures += 1
+                self._evict_batch(batch_key)
+                if attempt == 0 and not quarantined:
+                    log.warning(
+                        "device launch failed (%s: %s); batch evicted, "
+                        "retrying once on device", type(e).__name__, e)
+                    continue
+        raise DeviceUnsupported(
+            f"device launch failed after retry "
+            f"({type(last_err).__name__}: {last_err}); host fallback"
+        ) from last_err
 
     def _launch_pinned(self, q, ctx, batch_key, segments, aggs,
-                       final, alive_hint=None) -> InflightLaunch:
+                       final, alive_hint=None, tpl_box=None) -> InflightLaunch:
         params: dict = {}
         counter = [0]
 
@@ -1316,6 +1499,19 @@ class DeviceExecutor:
         )
         template = (shape, filter_tpl, group_cols, group_cards, agg_tpls,
                     sorted_k, final)
+        if tpl_box is not None:
+            # publish the template to launch()'s recovery handler so a
+            # device-runtime failure below is counted per-(template, batch)
+            tpl_box.append(template)
+        if self._is_quarantined(template, batch_key):
+            # circuit breaker: this (template, batch) failed on device
+            # QUARANTINE_AFTER times — route it to the host path while
+            # every other template keeps running on device
+            raise DeviceUnsupported(
+                "pipeline quarantined to host after repeated device "
+                "failures")
+        if faults.ACTIVE:
+            faults.inject("device.launch", target=self._fault_target(q))
 
         opts = q.options_ci()
 

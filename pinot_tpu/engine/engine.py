@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Optional
 
+from pinot_tpu.common.deadline import QueryTimeout
 from pinot_tpu.engine.host import HostExecutor
 from pinot_tpu.engine.reduce import finalize, merge_intermediates
 from pinot_tpu.query.context import (
@@ -238,8 +239,16 @@ class QueryEngine:
         return self.execute_segments_async(q, segments, terminal)()
 
     def execute_segments_async(self, q: QueryContext, segments,
-                               terminal: bool = False, fallback_gate=None):
+                               terminal: bool = False, fallback_gate=None,
+                               deadline=None):
         """LAUNCH phase of execute_segments → zero-arg fetch() closure.
+
+        ``deadline`` (common/deadline.py Deadline, optional): the query's
+        propagated end-to-end budget. Checked before each host segment
+        scan, before each blocking device fetch, and before each
+        host-fallback re-scan — an expired budget aborts with a typed
+        QueryTimeout (releasing every still-pinned in-flight launch)
+        instead of finishing work the client already abandoned.
 
         Everything CPU-bound runs here — pruning, star-tree/metadata fast
         paths, the device template build + NON-BLOCKING dispatch
@@ -362,9 +371,10 @@ class QueryEngine:
                         # whole, not per block).
                         hint = [id(s) not in scan_pruned for s in g] \
                             if g is device_sealed else None
-                        device_handles.append(
-                            (self.device.launch(q, g, final=final,
-                                                alive=hint), g))
+                        handle = self.device.launch(q, g, final=final,
+                                                    alive=hint)
+                        handle.deadline = deadline
+                        device_handles.append((handle, g))
                 except DeviceUnsupported:
                     for h, _ in device_handles:
                         h.release()
@@ -382,8 +392,11 @@ class QueryEngine:
             # dispatched device batches' link round trip; a host failure
             # must release the in-flight handles or their batch pins leak
             try:
-                host_results = [self.host.execute_segment(q, s)
-                                for s in host_segs]
+                host_results = []
+                for s in host_segs:
+                    if deadline is not None:
+                        deadline.check("host scan")
+                    host_results.append(self.host.execute_segment(q, s))
             except BaseException:
                 for h, _ in device_handles:
                     h.release()
@@ -394,31 +407,53 @@ class QueryEngine:
             ran = executed
             fallback_pruned = []  # stats-pruned members of fallen-back handles
             if device_handles:
-                for handle, segs_of_handle in device_handles:
-                    try:
-                        res.append(handle.fetch())
-                    except DeviceUnsupported:
-                        # fetch-time fallback (sorted group-table
-                        # overflow): the device must never shape
-                        # truncation policy. The host re-scan is heavy
-                        # CPU work — route it through the caller's
-                        # admission gate when one is provided. Members the
-                        # metadata pruner already proved empty (kept in
-                        # the batch only for batch-key stability) don't
-                        # re-scan; they count as pruned like the
-                        # launch-refused path.
-                        live = [s for s in segs_of_handle
-                                if id(s) not in scan_pruned]
-                        fallback_pruned.extend(
-                            s for s in segs_of_handle
-                            if id(s) in scan_pruned)
+                # ANY failure below must drop every remaining in-flight
+                # launch's batch pin (handle.release is idempotent after
+                # fetch), or the batches stay unevictable and the
+                # coalescer's pressure signal never drains — the guard
+                # covers QueryTimeout, fallback-gate rejections, AND
+                # unexpected errors alike
+                pending = list(device_handles)
+                try:
+                    while pending:
+                        handle, segs_of_handle = pending.pop(0)
+                        try:
+                            res.append(handle.fetch())
+                        except DeviceUnsupported:
+                            # fetch-time fallback (sorted group-table
+                            # overflow, or a device-runtime failure the
+                            # executor converted after counting it toward
+                            # its quarantine breaker): the device must
+                            # never shape truncation policy. The host
+                            # re-scan is heavy CPU work — route it through
+                            # the caller's admission gate when one is
+                            # provided. Members the metadata pruner
+                            # already proved empty (kept in the batch only
+                            # for batch-key stability) don't re-scan; they
+                            # count as pruned like the launch-refused
+                            # path.
+                            live = [s for s in segs_of_handle
+                                    if id(s) not in scan_pruned]
+                            fallback_pruned.extend(
+                                s for s in segs_of_handle
+                                if id(s) in scan_pruned)
 
-                        def _host_rerun(_segs=live):
-                            return [self.host.execute_segment(q, s)
-                                    for s in _segs]
+                            def _host_rerun(_segs=live):
+                                out = []
+                                for s in _segs:
+                                    if deadline is not None:
+                                        deadline.check("host fallback scan")
+                                    out.append(
+                                        self.host.execute_segment(q, s))
+                                return out
 
-                        res.extend(_host_rerun() if fallback_gate is None
-                                   else fallback_gate(_host_rerun))
+                            res.extend(
+                                _host_rerun() if fallback_gate is None
+                                else fallback_gate(_host_rerun))
+                except BaseException:
+                    for h, _ in pending:
+                        h.release()
+                    raise
             if fallback_pruned:
                 dropped = {id(s) for s in fallback_pruned}
                 ran = [s for s in ran if id(s) not in dropped]

@@ -46,17 +46,40 @@ class InflightLaunch:
         self._batch_key = batch_key
         self._resolve = resolve
         self._done = False
+        # optional per-query Deadline (common/deadline.py), set by the
+        # engine when the request carried a budget: an expired deadline
+        # aborts BEFORE the blocking device_get (which itself cannot be
+        # interrupted) with a typed QueryTimeout
+        self.deadline = None
 
     def fetch(self):
         """Blocking phase: resolve the packed buffer → IntermediateResult.
         Raises DeviceUnsupported on fetch-time fallbacks (sorted group
-        table overflow) — the caller re-runs the batch on the host path.
-        One-shot: the batch pin is dropped whether or not it succeeds."""
+        table overflow) — the caller re-runs the batch on the host path —
+        and QueryTimeout when the query's deadline expired before the
+        link wait began. One-shot: the batch pin is dropped whether or
+        not it succeeds."""
         if self._done:
             raise RuntimeError("InflightLaunch.fetch() called twice")
         self._done = True
         try:
-            outs = self._resolve()
+            if self.deadline is not None:
+                self.deadline.check("device fetch")
+            try:
+                outs = self._resolve()
+            except Exception as e:  # noqa: BLE001 — may convert to fallback
+                # device-runtime failures (XlaRuntimeError /
+                # RESOURCE_EXHAUSTED, real or injected) convert to the
+                # host-fallback signal after the executor records them
+                # toward the quarantine breaker; anything else re-raises
+                self._executor.on_fetch_device_error(
+                    e, self._template, self._batch_key)
+                raise
+            # success clears the quarantine breaker's strike count — the
+            # breaker is for failures close together, not two transient
+            # faults a week apart
+            self._executor._note_device_success(
+                self._template, self._batch_key)
             return self._executor._to_intermediate(
                 self._q, self._ctx, self._template, outs, self._aggs)
         finally:

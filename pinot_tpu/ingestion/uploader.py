@@ -35,6 +35,8 @@ class ControllerSegmentUploader(SegmentUploader):
         self.backoff_s = backoff_s
 
     def upload(self, table: str, segment_dir: str) -> str:
+        import random
+
         last: Optional[Exception] = None
         for attempt in range(self.max_attempts):
             try:
@@ -42,7 +44,12 @@ class ControllerSegmentUploader(SegmentUploader):
             except Exception as e:  # noqa: BLE001 — retried, then surfaced
                 last = e
                 if attempt + 1 < self.max_attempts:
-                    sleep = self.backoff_s * (2 ** attempt)
+                    # jittered exponential backoff (0.5x-1.0x of the
+                    # step): a batch job's N workers failing on the same
+                    # controller blip must not retry in lockstep and
+                    # re-stampede it at exactly backoff*2^k
+                    sleep = self.backoff_s * (2 ** attempt) \
+                        * (0.5 + random.random() * 0.5)
                     log.warning(
                         "segment upload %s/%s attempt %d failed (%s); "
                         "retrying in %.1fs", table, segment_dir,

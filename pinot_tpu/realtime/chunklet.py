@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import sys
 import threading
 import time
@@ -50,6 +51,8 @@ import numpy as np
 from pinot_tpu.common.datatypes import FieldRole
 from pinot_tpu.storage.dictionary import Dictionary
 from pinot_tpu.storage.segment import ColumnMetadata, Encoding, SegmentMetadata
+
+log = logging.getLogger("pinot_tpu.realtime.chunklet")
 
 
 def _use_dictionary(spec, no_dict_cols) -> bool:
@@ -312,7 +315,18 @@ class ChunkletIndex:
     def promote(self, limit: int = None) -> int:
         """Seal every full chunklet below the published doc count (writer
         thread; the lock only defends against an explicit second caller).
-        Returns the number of blocks promoted."""
+        Returns the number of blocks promoted.
+
+        Failure semantics: chunklets publish append-only AFTER they are
+        fully built, so a promotion failure (including an injected one)
+        leaves the index consistent — the unfrozen rows simply stay on
+        the host tail path and queries remain correct; consume loops
+        treat the raise as non-fatal and retry on the next batch."""
+        from pinot_tpu.common import faults
+
+        if faults.ACTIVE:
+            faults.inject("chunklet.promote",
+                          target=getattr(self.segment, "name", None))
         made = 0
         with self._promote_lock:
             while limit is None or made < limit:
@@ -431,7 +445,14 @@ def consume_stream_batches(segment, consumer, decoder, start_offset,
                     if on_error is not None:
                         on_error(None, e)
     if promote and segment.chunklet_index is not None:
-        segment.chunklet_index.promote()
+        try:
+            segment.chunklet_index.promote()
+        except Exception:  # noqa: BLE001 — promotion is an optimization
+            # a failed promotion must not drop ingested rows or kill the
+            # consume loop: the unfrozen rows keep serving from the host
+            # tail and the next batch retries the promotion
+            log.exception("chunklet promotion failed; rows stay on the "
+                          "host tail path")
     return indexed, next_offset, fetched
 
 

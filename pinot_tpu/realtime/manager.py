@@ -238,8 +238,17 @@ class RealtimePartitionManager:
                     ci = self.segment.chunklet_index
                     if ci is not None:
                         # incremental seal: promote every full frozen block
-                        # so queries ride the device path while consuming
-                        ci.promote()
+                        # so queries ride the device path while consuming.
+                        # Promotion failure is NON-FATAL: the rows are
+                        # already indexed and keep serving from the host
+                        # tail; the next batch retries
+                        try:
+                            ci.promote()
+                        except Exception:  # noqa: BLE001 — optimization
+                            log.exception(
+                                "chunklet promotion failed for %s; rows "
+                                "stay on the host tail path",
+                                self.segment.name)
                 else:
                     time.sleep(self.idle_sleep_s)
                 if self._should_flush():

@@ -56,12 +56,24 @@ def serve_segment_tar(server, request: bytes):
 
 
 def peer_download(registry, table: str, name: str, dest_dir: str,
-                  self_id: str, tls=None, timeout_s: float = 60.0) -> str:
+                  self_id: str, tls=None, timeout_s: float = 60.0,
+                  deadline=None) -> str:
     """Try every ONLINE replica of (table, segment) from the external view
     (excluding ``self_id``); untar the first successful stream into
     ``dest_dir`` (the caller's final path — may carry a CRC-versioned
     dirname). Returns ``dest_dir``; raises RuntimeError when no peer can
-    serve it."""
+    serve it.
+
+    ``deadline`` (common/deadline.py Deadline, optional): the CALLER's
+    budget — each replica attempt's stream timeout is clamped to the
+    remaining window (previously a fixed 60 s per replica, so a hung
+    peer chain could stall a caller for minutes), and no further replica
+    is tried once it expires. A mid-stream timeout cleans up the
+    partially-written download the same way the ``os.replace``-failure
+    path does: the extraction dir is removed in the per-candidate
+    ``finally`` and the spool is a TemporaryFile that never survives the
+    attempt."""
+    from pinot_tpu.common import faults
     from pinot_tpu.transport.grpc_transport import QueryRouterChannel
 
     ev = registry.external_view(table)
@@ -70,16 +82,25 @@ def peer_download(registry, table: str, name: str, dest_dir: str,
     req = json.dumps({"table": table, "segment": name}).encode("utf-8")
     errors = []
     for inst_id in candidates:
+        if deadline is not None and deadline.expired():
+            errors.append("deadline expired before trying remaining "
+                          f"replicas {candidates[candidates.index(inst_id):]}")
+            break
+        attempt_timeout_s = timeout_s if deadline is None \
+            else max(0.001, deadline.clamp(timeout_s))
         info = infos.get(inst_id)
         if info is None or not getattr(info, "grpc_port", None):
             continue
         ch = QueryRouterChannel(f"{info.host}:{info.grpc_port}", tls=tls)
         tmp = f"{dest_dir}.peer{os.getpid()}"
         try:
+            if faults.ACTIVE:
+                faults.inject("peer.fetch", target=inst_id)
             import tempfile
 
             with tempfile.TemporaryFile(prefix="peer_dl_") as spool:
-                for chunk in ch.fetch_segment(req, timeout_s=timeout_s):
+                for chunk in ch.fetch_segment(
+                        req, timeout_s=attempt_timeout_s):
                     spool.write(chunk)
                 spool.seek(0)
                 shutil.rmtree(tmp, ignore_errors=True)

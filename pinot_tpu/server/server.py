@@ -25,6 +25,8 @@ from pinot_tpu.cluster.registry import (
     SegmentRecord,
     SegmentState,
 )
+from pinot_tpu.common import faults
+from pinot_tpu.common.deadline import Deadline, QueryTimeout
 from pinot_tpu.engine.datatable import encode, encode_error
 from pinot_tpu.engine.engine import QueryEngine
 from pinot_tpu.engine.reduce import trim_group_by
@@ -101,13 +103,29 @@ class ServerInstance:
         )
         self._tls = tls
         self.sync_interval_s = sync_interval_s
+        from pinot_tpu.common.config import Configuration
+
+        conf = Configuration()
         if scheduler_name is None:
             # config-selected like the reference's
             # pinot.server.query.scheduler.name (fcfs | tokenbucket)
-            from pinot_tpu.common.config import Configuration
-
-            scheduler_name = Configuration().get(
+            scheduler_name = conf.get(
                 "pinot.server.query.scheduler.name", "fcfs")
+        # graceful-shutdown drain window (the reference's
+        # pinot.server.shutdown.timeout.ms shutdown hook): stop() rejects
+        # NEW submits immediately (SERVER_SHUTTING_DOWN — retriable at the
+        # broker) and waits up to this long for in-flight queries to drain
+        self.drain_timeout_s = conf.get_float(
+            "pinot.server.shutdown.drain.timeout.ms", 10_000.0) / 1e3
+        # adopt-path peer-fetch retry window + per-attempt peer download
+        # timeout (previously hardcoded 10 s / 60 s)
+        self.peer_retry_timeout_s = conf.get_float(
+            "pinot.server.segment.peer.retry.timeout.ms", 10_000.0) / 1e3
+        self.peer_download_timeout_s = conf.get_float(
+            "pinot.server.segment.peer.download.timeout.ms", 60_000.0) / 1e3
+        self._shutting_down = False
+        self._inflight_queries = 0
+        self._inflight_cond = threading.Condition()
         self.scheduler = make_scheduler(
             scheduler_name, max_concurrent=max_concurrent_queries,
             max_queued=max_queued_queries)
@@ -146,7 +164,8 @@ class ServerInstance:
             # each, not a full hbm_stats() snapshot 5x per scrape
             for gname, attr in (("deviceBatchHits", "batch_hits"),
                                 ("deviceBatchMisses", "batch_misses"),
-                                ("deviceBatchEvictions", "batch_evictions")):
+                                ("deviceBatchEvictions", "batch_evictions"),
+                                ("deviceLaunchFailures", "launch_failures")):
                 self.metrics.gauge(
                     gname, (lambda _a=attr, _d=dev: getattr(_d, _a)),
                     tag=instance_id)
@@ -156,6 +175,12 @@ class ServerInstance:
             self.metrics.gauge(
                 "deviceNarrowSavedBytes",
                 (lambda _d=dev: _d.narrow_saved_bytes()), tag=instance_id)
+            # quarantine breaker visibility: pipelines the device-error
+            # recovery has routed to host (a non-zero value alongside
+            # rising deviceLaunchFailures = a poisoned template/batch)
+            self.metrics.gauge(
+                "deviceQuarantinedPipelines",
+                (lambda _d=dev: len(_d._quarantined)), tag=instance_id)
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
@@ -182,7 +207,26 @@ class ServerInstance:
         )
         self._sync_thread.start()
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout_s: float = None) -> None:
+        """Graceful shutdown: reject NEW submits immediately with a
+        retriable SERVER_SHUTTING_DOWN (the broker re-routes their
+        segment lists to replicas), then drain in-flight queries for up
+        to the configured window
+        (``pinot.server.shutdown.drain.timeout.ms``; the old behavior
+        was an unconditional hard stop) before tearing transport down."""
+        drain = self.drain_timeout_s if drain_timeout_s is None \
+            else drain_timeout_s
+        self._shutting_down = True
+        drain_deadline = time.monotonic() + max(0.0, drain)
+        with self._inflight_cond:
+            while self._inflight_queries > 0:
+                left = drain_deadline - time.monotonic()
+                if left <= 0:
+                    log.warning(
+                        "shutdown drain window (%.1fs) elapsed with %d "
+                        "queries in flight", drain, self._inflight_queries)
+                    break
+                self._inflight_cond.wait(min(left, 0.1))
         self._stop.set()
         # drop the callable gauges: their closures would otherwise pin this
         # instance (and its loaded segments) in the process-global registry
@@ -190,7 +234,8 @@ class ServerInstance:
         self.metrics.remove_gauge("schedulerRejected", tag=self.instance_id)
         for gname in ("deviceResidentBytes", "deviceNarrowSavedBytes",
                       "deviceBatchHits", "deviceBatchMisses",
-                      "deviceBatchEvictions"):
+                      "deviceBatchEvictions", "deviceLaunchFailures",
+                      "deviceQuarantinedPipelines"):
             self.metrics.remove_gauge(gname, tag=self.instance_id)
         if self._sync_thread is not None:
             self._sync_thread.join(5)
@@ -201,13 +246,21 @@ class ServerInstance:
 
     # ---- query path ------------------------------------------------------
     @staticmethod
-    def _request_timeout_s(q):
-        """Per-query SET timeoutMs from the compiled options, honored by the
-        scheduler's ADMISSION wait: a query whose budget elapsed queueing
-        must not start and burn a worker the broker already abandoned
-        (the server-side half of the reference's timeoutMs option)."""
-        v = q.options_ci().get("timeoutms")
-        return max(0.001, float(v) / 1000.0) if v is not None else None
+    def _request_deadline(req: dict, q=None):
+        """Per-query Deadline. The broker-shipped REMAINING budget
+        (``timeoutMs`` in the instance request — what the broker had left
+        at send time) wins; ``SET timeoutMs`` from the compiled options
+        covers direct/embedded submits that never crossed a broker. Every
+        downstream wait (compile semaphore, scheduler admission, device
+        fetch, host fallback gate) is bounded by it and aborts with a
+        typed QUERY_TIMEOUT instead of running to completion after the
+        client gave up. None = no budget."""
+        v = req.get("timeoutMs")
+        if v is None and q is not None:
+            v = q.options_ci().get("timeoutms")
+        if v is None:
+            return None
+        return Deadline.after_ms(max(1.0, float(v)))
 
     @staticmethod
     def _scheduler_group(q, req: dict) -> str:
@@ -224,15 +277,22 @@ class ServerInstance:
                 name = name[: -len(suffix)]
         return name
 
-    def _compile_admitted(self, sql: str):
+    def _compile_admitted(self, sql: str, deadline: Deadline = None):
         """SQL compile bounded by a small semaphore (ADVICE r5): compile
         runs pre-admission on the transport thread, so without a bound a
         saturated server burns unbounded CPU parsing queries it will
         reject. The semaphore wait ships as the ``compileQueueMs`` timer;
         waiting out the bound is a scheduling rejection, not a server
-        fault."""
+        fault — unless the query's own deadline expired first, which is a
+        QUERY_TIMEOUT."""
         t0 = time.perf_counter()
-        if not self._compile_sem.acquire(timeout=self._compile_timeout_s):
+        wait_s = self._compile_timeout_s if deadline is None \
+            else deadline.clamp(self._compile_timeout_s)
+        if not self._compile_sem.acquire(timeout=wait_s):
+            if deadline is not None and deadline.expired():
+                raise QueryTimeout(
+                    "QUERY_TIMEOUT at compile admission: budget exhausted "
+                    "waiting for a compile slot")
             raise SchedulerSaturated(
                 f"compile queue full (no compile slot within "
                 f"{self._compile_timeout_s}s)")
@@ -257,23 +317,65 @@ class ServerInstance:
         exceed ``queries`` on the dashboard. Compile runs BEFORE admission
         — the scheduler group and timeout come from the compiled context,
         and a parse error must not burn a concurrency slot — bounded by
-        the compile semaphore (_compile_admitted)."""
+        the compile semaphore (_compile_admitted).
+
+        Shutdown drain: once stop() flips ``_shutting_down``, new submits
+        are rejected immediately with a retriable SERVER_SHUTTING_DOWN
+        (the broker re-routes them to replicas) while queries already
+        counted in ``_inflight_queries`` drain inside the configured
+        window."""
         req = parse_instance_request(request)
+        with self._inflight_cond:
+            if self._shutting_down:
+                self.metrics.count("queriesRejected")
+                return encode_error(
+                    "server_shutting_down",
+                    f"SERVER_SHUTTING_DOWN: {self.instance_id} is "
+                    f"draining for shutdown")
+            self._inflight_queries += 1
+        try:
+            return self._submit_inner(req)
+        finally:
+            with self._inflight_cond:
+                self._inflight_queries -= 1
+                self._inflight_cond.notify_all()
+
+    def _submit_inner(self, req: dict) -> bytes:
+        deadline = self._request_deadline(req)
         try:
             self.metrics.count("queries")
-            q = self._compile_admitted(req["sql"])
+            q = self._compile_admitted(req["sql"], deadline)
+            if deadline is None:
+                # no broker-shipped budget: fall back to SET timeoutMs
+                # from the now-compiled options (embedded submits)
+                deadline = self._request_deadline(req, q)
             # NOTE: the latency timer lives inside the launch/fetch pair —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
             acct: dict = {}
             finish = self.scheduler.run(
-                lambda: self._handle_submit_launch(req, q, acct),
-                queue_timeout_s=self._request_timeout_s(q),
+                lambda: self._handle_submit_launch(req, q, acct, deadline),
+                queue_timeout_s=None if deadline is None
+                else max(0.001, deadline.remaining_s()),
                 group=self._scheduler_group(q, req),
                 stats_out=acct)
             # slot released: the link wait below must not hold admission
             return finish()
+        except faults.FaultInjected:
+            # injected server crash: escape the in-band error path — the
+            # RPC must die at the transport level, like a process kill
+            raise
+        except QueryTimeout as e:
+            # the propagated deadline expired at one of the waits: typed
+            # in-band partial (errorCode 250 shape); the server is healthy
+            self.metrics.count("queryTimeouts")
+            return encode_error("query_timeout", str(e))
         except SchedulerSaturated as e:
+            if deadline is not None and deadline.expired():
+                self.metrics.count("queryTimeouts")
+                return encode_error(
+                    "query_timeout",
+                    f"QUERY_TIMEOUT at scheduler admission: {e}")
             # admission rejection is a query-level error: the server is
             # healthy (broker must not poison its failure detector)
             self.metrics.count("queriesRejected")
@@ -282,7 +384,8 @@ class ServerInstance:
             self.metrics.count("queryErrors")
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
-    def _handle_submit_launch(self, req: dict, q, acct: dict = None):
+    def _handle_submit_launch(self, req: dict, q, acct: dict = None,
+                              deadline: Deadline = None):
         """LAUNCH phase (runs under the scheduler slot) → zero-arg FETCH
         closure the transport thread invokes after the slot is released.
         Segment refs, the latency timer, and the tracer span BOTH phases;
@@ -333,16 +436,26 @@ class ServerInstance:
             # requested-but-missing segments (assignment raced ahead of
             # loading) are simply absent from this partial, like the
             # reference's missing-segment accounting
+            if faults.ACTIVE:
+                # injected mid-query server crash: segments acquired, the
+                # query is "executing" — the raise escapes in-band
+                # handling (see _submit_inner) and kills the RPC at the
+                # transport level; cleanup() still runs via the
+                # BaseException path so the process itself stays sound
+                faults.inject("server.crash", target=self.instance_id)
             with span("server.execute"):
                 # the fetch-time host fallback (sorted-table overflow) is
                 # heavy CPU work on a slot-free thread: re-admit it
                 # through the scheduler so a fallback storm can't escape
-                # the concurrency cap (saturation rejects it in-band)
+                # the concurrency cap (saturation rejects it in-band);
+                # the admission wait is bounded by the query's REMAINING
+                # deadline at gate time, not the original budget
                 gate = (lambda fn: self.scheduler.run(
-                    fn, queue_timeout_s=self._request_timeout_s(q),
+                    fn, queue_timeout_s=None if deadline is None
+                    else max(0.001, deadline.remaining_s()),
                     group=self._scheduler_group(q, req)))
                 fetch_merged = self.engine.execute_segments_async(
-                    q, segments, fallback_gate=gate)
+                    q, segments, fallback_gate=gate, deadline=deadline)
         except BaseException:
             cleanup()
             raise
@@ -382,25 +495,51 @@ class ServerInstance:
         The per-request row budget (offset+limit) stops segment execution
         early — selection without ORDER BY is any-subset semantics."""
         req = parse_instance_request(request)
+        with self._inflight_cond:
+            rejected = self._shutting_down
+            if rejected:
+                self.metrics.count("queriesRejected")
+            else:
+                self._inflight_queries += 1
+        if rejected:
+            # yield OUTSIDE the condition lock: the generator suspends at
+            # the yield while gRPC writes the block, and a slow client
+            # must not park the server-wide lock every submit acquires
+            yield encode_error(
+                "server_shutting_down",
+                f"SERVER_SHUTTING_DOWN: {self.instance_id} is "
+                f"draining for shutdown")
+            return
         try:
             # count at receive time, pre-compile — same invariant as the
             # unary path: queryErrors <= queries even on parse errors;
             # compile rides the same pre-admission semaphore bound
             self.metrics.count("queries")
-            q = self._compile_admitted(req["sql"])
+            deadline = self._request_deadline(req)
+            q = self._compile_admitted(req["sql"], deadline)
+            if deadline is None:
+                deadline = self._request_deadline(req, q)
             yield from self.scheduler.run(
-                lambda: self._stream_blocks(req, q),
-                queue_timeout_s=self._request_timeout_s(q),
+                lambda: self._stream_blocks(req, q, deadline),
+                queue_timeout_s=None if deadline is None
+                else max(0.001, deadline.remaining_s()),
                 group=self._scheduler_group(q, req),
             )
+        except QueryTimeout as e:
+            self.metrics.count("queryTimeouts")
+            yield encode_error("query_timeout", str(e))
         except SchedulerSaturated as e:
             self.metrics.count("queriesRejected")
             yield encode_error("query_error", f"QUERY_SCHEDULING_TIMEOUT: {e}")
         except Exception as e:  # noqa: BLE001 — in-band, like unary
             self.metrics.count("queryErrors")
             yield encode_error("query_error", f"{type(e).__name__}: {e}")
+        finally:
+            with self._inflight_cond:
+                self._inflight_queries -= 1
+                self._inflight_cond.notify_all()
 
-    def _stream_blocks(self, req: dict, q):
+    def _stream_blocks(self, req: dict, q, deadline: Deadline = None):
         """Materialize the block list under the scheduler slot (bounded by
         the row budget), releasing the slot before slow network drain.
         Returning a LIST (not a generator) is load-bearing: the scheduler
@@ -430,6 +569,8 @@ class ServerInstance:
             unexecuted_docs = 0  # pruned/budget-skipped: count toward totalDocs
             remaining = list(segments)
             while remaining:
+                if deadline is not None:
+                    deadline.check("streaming segment scan")
                 seg = remaining.pop(0)
                 if self.engine.pruner.prune(q, seg):
                     pruned += 1
@@ -512,7 +653,8 @@ class ServerInstance:
             from pinot_tpu.server.peer import peer_download
 
             return peer_download(self.registry, table, rec.name, local,
-                                 self.instance_id, tls=self._tls)
+                                 self.instance_id, tls=self._tls,
+                                 timeout_s=self.peer_download_timeout_s)
         if os.path.isdir(local):  # another loader won the copy race
             shutil.rmtree(tmp, ignore_errors=True)
         else:
@@ -653,17 +795,24 @@ class ServerInstance:
     def _peer_fetch(self, table: str, segment_name: str, dest_dir: str) -> str:
         """Adopt-path fallback when the winner's published location is
         unreachable: download from a serving replica. Retries briefly —
-        the external view can lag the winner's publish by a sync tick."""
+        the external view can lag the winner's publish by a sync tick.
+        The retry window is config-driven
+        (``pinot.server.segment.peer.retry.timeout.ms``; was a hardcoded
+        10 s) and the SAME Deadline bounds every per-replica stream
+        inside peer_download, so a hung peer can't hold the consume loop
+        past the window."""
         from pinot_tpu.server.peer import peer_download
 
-        deadline = time.time() + 10.0
+        deadline = Deadline(self.peer_retry_timeout_s)
         while True:
             try:
                 return peer_download(self.registry, table, segment_name,
                                      dest_dir, self.instance_id,
-                                     tls=self._tls)
+                                     tls=self._tls,
+                                     timeout_s=self.peer_download_timeout_s,
+                                     deadline=deadline)
             except Exception:
-                if time.time() >= deadline:
+                if deadline.expired():
                     raise
                 time.sleep(0.3)
 

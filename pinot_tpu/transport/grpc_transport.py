@@ -32,10 +32,15 @@ FETCH_SEGMENT_METHOD = "/pinot.PinotQueryServer/FetchSegment"
 
 def make_instance_request(sql: str, segments: list, request_id: int,
                           broker_id: str = "", trace: bool = False,
-                          table: str = None, time_filter: dict = None) -> bytes:
+                          table: str = None, time_filter: dict = None,
+                          timeout_ms: float = None) -> bytes:
     """``table``: physical table override (hybrid split sends the same SQL to
     X_OFFLINE and X_REALTIME); ``time_filter``: {column, op le|gt, value}
-    AND-ed server-side (the time-boundary predicate)."""
+    AND-ed server-side (the time-boundary predicate); ``timeout_ms``: the
+    query's REMAINING deadline budget at send time — the server bounds
+    every downstream wait by it and answers QUERY_TIMEOUT instead of
+    executing work the broker already abandoned (the reference ships
+    timeoutMs in the InstanceRequest the same way)."""
     return json.dumps(
         {
             "sql": sql,
@@ -45,6 +50,7 @@ def make_instance_request(sql: str, segments: list, request_id: int,
             "traceEnabled": trace,
             "table": table,
             "timeFilter": time_filter,
+            "timeoutMs": timeout_ms,
         }
     ).encode("utf-8")
 

@@ -1,0 +1,664 @@
+"""Failure-domain chaos suite (ISSUE 6).
+
+The contract under test: under every injected fault — transport drop,
+slow replica, blackholed replica, mid-query server crash, device launch /
+fetch failure, chunklet-promotion failure — a query returns either the
+CORRECT full result or a correctly-flagged ``partialResult`` with honest
+stats (never a hang, a wrong answer, or an unflagged partial), and a
+query whose deadline expires comes back as a typed QUERY_TIMEOUT
+(errorCode 250) within deadline + 1 s. Plus the broker FailureDetector's
+half-open circuit-breaker state machine and the device executor's
+quarantine breaker routing a poisoned template to host while other
+templates keep running on device.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker, FailureDetector, LatencyTracker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common import faults
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.deadline import Deadline, QueryTimeout
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+
+@pytest.fixture(autouse=True)
+def clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# fault registry + deadline primitives
+# ---------------------------------------------------------------------------
+
+
+class TestFaultRegistry:
+    def test_inactive_by_default_and_zero_when_cleared(self):
+        assert faults.ACTIVE is False
+        f = faults.install(faults.Fault(point="p", mode="error"))
+        assert faults.ACTIVE is True
+        faults.clear()
+        assert faults.ACTIVE is False
+        # cleared: inject is a no-op even for the old point
+        faults.inject("p")
+        assert f.fired == 0
+
+    def test_error_delay_and_times(self):
+        f = faults.install(faults.Fault(point="p", mode="error", times=2))
+        for _ in range(2):
+            with pytest.raises(faults.FaultInjected):
+                faults.inject("p")
+        faults.inject("p")  # disarmed after 2 firings
+        assert f.fired == 2
+        faults.clear()
+        faults.install(faults.Fault(point="d", mode="delay", delay_ms=30))
+        t0 = time.perf_counter()
+        faults.inject("d")
+        assert time.perf_counter() - t0 >= 0.025
+
+    def test_target_substring_match(self):
+        faults.install(faults.Fault(point="p", target="server_1",
+                                    mode="error"))
+        faults.inject("p", target="server_2")  # no match
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("p", target="server_1")
+
+    def test_blackhole_bounded_by_caller_deadline(self):
+        faults.install(faults.Fault(point="p", mode="blackhole",
+                                    delay_ms=60_000))
+        t0 = time.perf_counter()
+        with pytest.raises(faults.FaultInjected):
+            faults.inject("p", bound_ms=50)
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_parse_spec(self):
+        fs = faults.parse_spec(
+            "transport.submit@server_1=blackhole:500;"
+            "device.launch=error#2; chunklet.promote=delay:10")
+        assert [f.point for f in fs] == [
+            "transport.submit", "device.launch", "chunklet.promote"]
+        assert fs[0].target == "server_1" and fs[0].delay_ms == 500
+        assert fs[1].times == 2 and fs[1].target is None
+        assert fs[2].mode == "delay"
+
+    def test_device_points_raise_device_error(self):
+        faults.install(faults.Fault(point="device.launch", mode="error"))
+        with pytest.raises(faults.InjectedDeviceError):
+            faults.inject("device.launch")
+
+
+class TestDeadline:
+    def test_remaining_and_expiry(self):
+        dl = Deadline(0.05)
+        assert not dl.expired()
+        assert 0 < dl.remaining_s() <= 0.05
+        assert dl.clamp(10.0) <= 0.05
+        time.sleep(0.06)
+        assert dl.expired()
+        assert dl.clamp(10.0) == 0.0
+        with pytest.raises(QueryTimeout, match="QUERY_TIMEOUT at here"):
+            dl.check("here")
+
+
+# ---------------------------------------------------------------------------
+# FailureDetector state machine (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestFailureDetectorStateMachine:
+    def test_failure_backoff_halfopen_probe_recovery(self):
+        fd = FailureDetector(initial_backoff_s=0.1, max_backoff_s=1.0)
+        assert fd.state("s") == FailureDetector.ST_HEALTHY
+        assert fd.is_healthy("s")
+
+        fd.mark_failure("s")
+        assert fd.state("s") == FailureDetector.ST_OPEN
+        assert not fd.is_healthy("s")
+        assert not fd.try_probe("s")  # window not yet open
+
+        assert wait_until(
+            lambda: fd.state("s") == FailureDetector.ST_HALF_OPEN, 1.0)
+        assert fd.is_healthy("s")  # routable: the query IS the probe
+        assert fd.try_probe("s")   # first caller claims the probe slot
+        assert not fd.try_probe("s")  # single probe per window
+
+        fd.mark_success("s")  # probe succeeded
+        assert fd.state("s") == FailureDetector.ST_HEALTHY
+        assert fd.try_probe("s")  # healthy: not a probe at all
+
+    def test_probe_failure_doubles_backoff(self):
+        fd = FailureDetector(initial_backoff_s=0.05, max_backoff_s=10.0)
+        fd.mark_failure("s")
+        first_backoff = fd._unhealthy["s"][1]
+        assert wait_until(
+            lambda: fd.state("s") == FailureDetector.ST_HALF_OPEN, 1.0)
+        assert fd.try_probe("s")
+        fd.mark_failure("s")  # probe failed → OPEN again, doubled
+        assert fd.state("s") == FailureDetector.ST_OPEN
+        assert fd._unhealthy["s"][1] == pytest.approx(first_backoff * 2)
+
+    def test_backoff_caps_at_max(self):
+        fd = FailureDetector(initial_backoff_s=1.0, max_backoff_s=2.0)
+        for _ in range(6):
+            fd.mark_failure("s")
+        assert fd._unhealthy["s"][1] <= 2.0
+
+
+class TestLatencyTracker:
+    def test_p90_and_default(self):
+        lt = LatencyTracker(default_s=0.07)
+        assert lt.p90_s("x") == 0.07  # no samples
+        for v in range(100):
+            lt.record("x", v / 1000.0)
+        # rolling window keeps the last 64 samples (36..99 ms)
+        p90 = lt.p90_s("x")
+        assert 0.085 <= p90 <= 0.1
+
+
+# ---------------------------------------------------------------------------
+# cluster-level chaos: transport faults, crash, deadline, partial results
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "deepstore"))
+    servers = [
+        ServerInstance(f"server_{i}", registry, str(tmp_path / f"srv{i}"),
+                       device_executor=None)
+        for i in range(3)
+    ]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=10.0)
+    yield registry, controller, servers, broker
+    faults.clear()
+    broker.close()
+    for s in servers:
+        try:
+            s.stop(drain_timeout_s=0.5)
+        except Exception:
+            pass
+
+
+def _push_table(tmp_path, controller, registry, n_segments=4, rows=2000,
+                replication=3):
+    schema = Schema.build(
+        name="sales",
+        dimensions=[("region", DataType.STRING)],
+        metrics=[("amount", DataType.INT)],
+    )
+    cfg = TableConfig(table_name="sales", replication=replication)
+    controller.add_table(cfg, schema)
+    rng = np.random.default_rng(11)
+    total = 0
+    for i in range(n_segments):
+        amounts = rng.integers(1, 500, rows).astype(np.int32)
+        total += int(amounts.sum())
+        cols = {
+            "region": np.array(["na", "eu", "apac"])[
+                rng.integers(0, 3, rows)],
+            "amount": amounts,
+        }
+        d = str(tmp_path / f"up_s{i}")
+        build_segment(schema, cols, d, cfg, f"sales_s{i}")
+        controller.upload_segment("sales", d)
+    assert wait_until(
+        lambda: all(
+            len(insts) >= min(replication, 3)
+            for insts in registry.external_view("sales_OFFLINE").values())
+        and len(registry.external_view("sales_OFFLINE")) == n_segments)
+    return total, n_segments * rows
+
+
+SQL = "SELECT COUNT(*), SUM(amount) FROM sales"
+
+
+class TestTransportFaults:
+    def test_drop_recovers_via_replica_retry(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        # drop the first RPC to one instance: the broker must re-send that
+        # segment list to a replica and return a COMPLETE result
+        faults.install(faults.Fault(point="transport.submit",
+                                    target="server_1", mode="error",
+                                    times=1))
+        r = broker.execute(SQL)
+        assert r.get("exceptions") == [], r
+        assert r.get("partialResult") is False
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+        # retry attempts count into numServersQueried; everything answered
+        assert r["numServersQueried"] >= r["numServersResponded"] >= 1
+
+    def test_slow_replica_still_correct(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        faults.install(faults.Fault(point="transport.submit",
+                                    target="server_2", mode="delay",
+                                    delay_ms=200))
+        r = broker.execute(SQL)
+        assert r.get("exceptions") == [], r
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+    def test_blackhole_with_hedging_zero_errors(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        faults.install(faults.Fault(point="transport.submit",
+                                    target="server_0", mode="blackhole"))
+        for _ in range(3):
+            r = broker.execute(f"SET useHedging = true; {SQL}")
+            assert r.get("exceptions") == [], r
+            assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+    def test_unrecoverable_failure_flags_partial(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        # EVERY instance drops the RPC once and retries are dropped too:
+        # the response must be a flagged partial (or all-failed error),
+        # never an unflagged wrong answer
+        faults.install(faults.Fault(point="transport.submit", mode="error"))
+        try:
+            r = broker.execute(SQL)
+        except ConnectionError:
+            return  # all servers failed: surfaced loudly — acceptable
+        if r.get("exceptions"):
+            assert r.get("partialResult") in (True, None) or \
+                r.get("resultTable") is None
+        else:  # pool raced a success through: must then be complete
+            assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+
+class TestServerCrashMidQuery:
+    def test_crash_recovers_on_replica(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        # the crash fires mid-query (segments acquired) and kills the RPC
+        # at the transport level; the broker retries on replicas
+        faults.install(faults.Fault(point="server.crash",
+                                    target="server_1", mode="crash",
+                                    times=1))
+        r = broker.execute(SQL)
+        assert r.get("exceptions") == [], r
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+    def test_crash_leaves_server_consistent(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        faults.install(faults.Fault(point="server.crash", mode="crash",
+                                    times=3))
+        broker.execute(SQL)  # every replica "crashes" (partial/failed)
+        faults.clear()
+        # the crash path released segment refs and scheduler slots: the
+        # same servers answer the next query completely
+        r = broker.execute(SQL)
+        assert r.get("exceptions") == [], r
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+
+class TestDeadlinePropagation:
+    def test_expired_deadline_returns_250_within_grace(self, cluster,
+                                                       tmp_path):
+        registry, controller, servers, broker = cluster
+        _push_table(tmp_path, controller, registry)
+        # every replica sits on the RPC for 2 s against a 300 ms budget
+        faults.install(faults.Fault(point="transport.submit", mode="delay",
+                                    delay_ms=2000))
+        t0 = time.perf_counter()
+        r = broker.execute(f"SET timeoutMs = 300; {SQL}")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.3 + 1.0, elapsed  # deadline + 1 s, never a hang
+        assert r.get("exceptions"), r
+        assert all(x["errorCode"] == 250 for x in r["exceptions"]), r
+        assert r.get("partialResult") is True
+
+    def test_wire_carries_remaining_budget(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _push_table(tmp_path, controller, registry)
+        import json
+
+        from pinot_tpu.transport.grpc_transport import QueryRouterChannel
+
+        seen = []
+        orig = QueryRouterChannel.submit
+
+        def spy(self, payload, timeout_s):
+            seen.append(json.loads(payload.decode()).get("timeoutMs"))
+            return orig(self, payload, timeout_s)
+
+        QueryRouterChannel.submit = spy
+        try:
+            r = broker.execute(f"SET timeoutMs = 5000; {SQL}")
+            assert not r.get("exceptions"), r
+        finally:
+            QueryRouterChannel.submit = orig
+        assert seen and all(v is not None and 0 < v <= 5000 for v in seen)
+
+    def test_server_side_timeout_is_typed(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _push_table(tmp_path, controller, registry)
+        # an ALREADY-expired budget on the wire: the server must answer
+        # the typed in-band QUERY_TIMEOUT, not execute
+        import json
+
+        from pinot_tpu.engine.datatable import QueryTimeoutError, decode
+        from pinot_tpu.transport.grpc_transport import make_instance_request
+
+        server = servers[0]
+        segs = [s for t in server.engine.tables.values()
+                for s in t.segments][:1]
+        assert segs
+        # the server starts its own clock at receive, so a tiny budget
+        # alone races execution speed (a cached compile over a small
+        # segment can legitimately finish inside 1 ms). Exhaust the
+        # compile semaphore instead: the submit provably waits at a
+        # deadline-checked seam until its 50 ms budget expires.
+        held = 0
+        while server._compile_sem.acquire(blocking=False):
+            held += 1
+        assert held > 0
+        try:
+            payload = make_instance_request(
+                SQL, segs, 1, "b", table="sales_OFFLINE", timeout_ms=50.0)
+            out = server._handle_submit(payload)
+        finally:
+            for _ in range(held):
+                server._compile_sem.release()
+        with pytest.raises(QueryTimeoutError):
+            decode(out)
+        assert json.loads(out[4:])["kind"] == "query_timeout"
+
+
+class TestPartialResultContract:
+    def test_dead_server_partial_with_honest_counts(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        servers = [
+            ServerInstance(f"server_{i}", registry, str(tmp_path / f"s{i}"),
+                           device_executor=None)
+            for i in range(3)
+        ]
+        for s in servers:
+            s.start()
+        broker = Broker(registry, timeout_s=5.0)
+        try:
+            total, n_rows = _push_table(tmp_path, controller, registry,
+                                        replication=1)
+            # hard-kill one server (transport gone, registry entry stays):
+            # with replication=1 its segments are unrecoverable
+            victim = servers[1]
+            victim.transport.stop()
+            r = broker.execute(SQL)
+            assert r.get("partialResult") is True
+            assert r["exceptions"], r
+            assert all(x["errorCode"] in (427, 250) for x in r["exceptions"])
+            # honest counts: every instance we dispatched to vs the ones
+            # whose answers the reduce used
+            assert r["numServersQueried"] == 3
+            assert r["numServersResponded"] == 2
+            # honest data: fewer rows than the full table, flagged partial
+            assert r["resultTable"]["rows"][0][0] < n_rows
+        finally:
+            broker.close()
+            for s in servers:
+                try:
+                    s.stop(drain_timeout_s=0.2)
+                except Exception:
+                    pass
+
+    def test_shutting_down_server_is_retried(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        # flip one server into drain mode WITHOUT stopping transport: new
+        # submits get SERVER_SHUTTING_DOWN, which the broker treats as
+        # retriable — the query must come back complete via replicas
+        servers[2]._shutting_down = True
+        r = broker.execute(SQL)
+        assert r.get("exceptions") == [], r
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+
+
+class TestShutdownDrain:
+    def test_rejects_new_submits_while_draining(self, tmp_path):
+        from pinot_tpu.engine.datatable import ServerShuttingDown, decode
+        from pinot_tpu.transport.grpc_transport import make_instance_request
+
+        registry = ClusterRegistry()
+        server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                                device_executor=None)
+        server._shutting_down = True
+        payload = make_instance_request("SELECT COUNT(*) FROM t", ["x"], 1,
+                                        "b")
+        with pytest.raises(ServerShuttingDown):
+            decode(server._handle_submit(payload))
+
+    def test_drain_waits_for_inflight_then_times_out(self, tmp_path):
+        registry = ClusterRegistry()
+        server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                                device_executor=None)
+        server.transport.start()
+        server.registry.register_instance  # no sync loop started
+        server._inflight_queries = 1  # simulate a stuck in-flight query
+        t0 = time.perf_counter()
+        server.stop(drain_timeout_s=0.3)
+        elapsed = time.perf_counter() - t0
+        assert 0.25 <= elapsed < 2.0  # waited the window, then proceeded
+
+    def test_drain_window_configurable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PINOT_TPU_PINOT_SERVER_SHUTDOWN_DRAIN_TIMEOUT_MS",
+                           "1234")
+        registry = ClusterRegistry()
+        server = ServerInstance("s0", registry, str(tmp_path / "sd"),
+                                device_executor=None)
+        assert server.drain_timeout_s == pytest.approx(1.234)
+
+
+# ---------------------------------------------------------------------------
+# device-error recovery + quarantine breaker
+# ---------------------------------------------------------------------------
+
+
+ROWS = 4000
+
+
+@pytest.fixture(scope="module")
+def device_table(tmp_path_factory):
+    rng = np.random.default_rng(7)
+    schema = Schema.build(
+        name="t",
+        dimensions=[("tag", DataType.STRING)],
+        metrics=[("m", DataType.INT), ("v", DataType.INT)],
+    )
+    cfg = TableConfig(table_name="t")
+    base = tmp_path_factory.mktemp("faultseg")
+    segs = []
+    for i in range(2):
+        cols = {
+            "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, ROWS)],
+            "m": rng.integers(0, 1000, ROWS).astype(np.int32),
+            "v": rng.integers(0, 1000, ROWS).astype(np.int32),
+        }
+        build_segment(schema, cols, str(base / f"s{i}"), cfg, f"s{i}")
+        segs.append(ImmutableSegment(str(base / f"s{i}")))
+    return segs
+
+
+def _engines(segs):
+    eng = QueryEngine()
+    host = QueryEngine(device_executor=None)
+    for s in segs:
+        eng.add_segment("t", s)
+        host.add_segment("t", s)
+    return eng, host
+
+
+class TestDeviceErrorRecovery:
+    def test_launch_failure_retries_once_then_succeeds(self, device_table):
+        eng, host = _engines(device_table)
+        sql = "SELECT SUM(m) FROM t"
+        expected = host.execute(sql)["resultTable"]["rows"]
+        faults.install(faults.Fault(point="device.launch", mode="error",
+                                    times=1))
+        r = eng.execute(sql)
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"] == expected
+        assert eng.device.launch_failures >= 1
+        # one failure is below the quarantine threshold
+        assert eng.device.hbm_stats()["quarantined_pipelines"] == 0
+
+    def test_fetch_failure_falls_back_to_host(self, device_table):
+        eng, host = _engines(device_table)
+        sql = "SELECT tag, COUNT(*), SUM(v) FROM t GROUP BY tag ORDER BY tag"
+        expected = host.execute(sql)["resultTable"]["rows"]
+        faults.install(faults.Fault(point="device.fetch", mode="error",
+                                    times=1))
+        before = eng.device.launch_failures
+        r = eng.execute(sql)
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"] == expected
+        assert eng.device.launch_failures == before + 1
+
+    def test_quarantine_routes_poisoned_template_to_host(self, device_table):
+        eng, host = _engines(device_table)
+        poisoned = "SELECT SUM(m) FROM t"
+        # a different template over the same batch (metadata-only fast
+        # paths don't count: it must actually LAUNCH on device)
+        healthy_sql = "SELECT SUM(v) FROM t WHERE tag <> 'zz'"
+        exp_p = host.execute(poisoned)["resultTable"]["rows"]
+        exp_h = host.execute(healthy_sql)["resultTable"]["rows"]
+        # unlimited failures for the sum(m) template ONLY
+        faults.install(faults.Fault(point="device.launch", target="sum(m)",
+                                    mode="error"))
+        fault = faults.active_faults()[0]
+        # launch + its retry both fail → quarantined → host answers
+        r = eng.execute(poisoned)
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"] == exp_p
+        stats = eng.device.hbm_stats()
+        assert stats["device_failures"] >= 2
+        assert stats["quarantined_pipelines"] == 1
+        fired_after_quarantine = fault.fired
+        # quarantined: the breaker short-circuits BEFORE the injection
+        # seam — no more device attempts for this template
+        r = eng.execute(poisoned)
+        assert r["resultTable"]["rows"] == exp_p
+        assert fault.fired == fired_after_quarantine
+        # a DIFFERENT template keeps running on device (the fault
+        # doesn't match it, and the quarantine is per-template)
+        leaves_before = eng.device.fetch_leaves_total
+        r = eng.execute(healthy_sql)
+        assert r["resultTable"]["rows"] == exp_h
+        assert eng.device.fetch_leaves_total > leaves_before  # device path
+        assert eng.device.hbm_stats()["quarantined_pipelines"] == 1
+        # operational reset forgets the history
+        eng.device.reset_quarantine()
+        assert eng.device.hbm_stats()["quarantined_pipelines"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chunklet-promotion failure (consuming segments stay correct on host tail)
+# ---------------------------------------------------------------------------
+
+
+class TestChunkletPromotionFault:
+    def test_promotion_failure_keeps_ingest_and_queries_correct(self):
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="rt",
+            dimensions=[("zone", DataType.STRING)],
+            metrics=[("fare", DataType.INT)],
+            datetimes=[("ts", DataType.LONG)],
+        )
+        cfg = TableConfig(
+            table_name="rt",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=1024,
+                                     device_min_rows=0))
+        rng = np.random.default_rng(3)
+        rows = [{"zone": f"z{int(rng.integers(0, 20)):02d}",
+                 "fare": int(rng.integers(0, 1000)), "ts": i}
+                for i in range(3000)]
+
+        faults.install(faults.Fault(point="chunklet.promote", mode="error"))
+        seg = MutableSegment(schema, "rt__0", cfg)
+        seg.index_batch(rows)
+        try:
+            seg.chunklet_index.promote()
+            raise AssertionError("fault should have fired")
+        except faults.FaultInjected:
+            pass
+        assert seg.n_docs == 3000
+        assert len(seg.chunklet_index.chunklets) == 0  # nothing promoted
+
+        eng = QueryEngine(device_executor=None)
+        eng.table("rt").add_segment(seg)
+        r = eng.execute("SELECT COUNT(*), SUM(fare) FROM rt")
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"][0] == [
+            3000, sum(x["fare"] for x in rows)]
+
+        # fault cleared: the NEXT promotion catches up the frozen prefix
+        # and answers stay identical
+        faults.clear()
+        assert seg.chunklet_index.promote() > 0
+        r2 = eng.execute("SELECT COUNT(*), SUM(fare) FROM rt")
+        assert r2["resultTable"]["rows"] == r["resultTable"]["rows"]
+
+    def test_consume_helper_swallows_promotion_failure(self):
+        # consume_stream_batches must treat a promote raise as non-fatal
+        from pinot_tpu.realtime.chunklet import consume_stream_batches
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="rt", dimensions=[("zone", DataType.STRING)],
+            metrics=[("fare", DataType.INT)],
+            datetimes=[("ts", DataType.LONG)])
+        cfg = TableConfig(
+            table_name="rt",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=512,
+                                     device_min_rows=0))
+        seg = MutableSegment(schema, "rt__0", cfg)
+
+        class OneBatchConsumer:
+            def __init__(self):
+                self.offset = 0
+
+            def fetch_payload_batch(self, start, max_rows):
+                if start > 0:
+                    return [], start
+                import json as _json
+
+                return [
+                    _json.dumps({"zone": "z1", "fare": i, "ts": i}).encode()
+                    for i in range(1024)
+                ], 1024
+
+        import json as _json
+
+        faults.install(faults.Fault(point="chunklet.promote", mode="error"))
+        indexed, next_off, fetched = consume_stream_batches(
+            seg, OneBatchConsumer(), lambda p: _json.loads(p.decode()), 0)
+        assert indexed == 1024 and next_off == 1024
+        assert seg.n_docs == 1024  # rows survived the failed promotion

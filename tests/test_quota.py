@@ -122,16 +122,25 @@ def test_timeout_ms_query_option(tmp_path):
         real_submit = QueryRouterChannel.submit
 
         def recording(self, payload, timeout):
-            seen.append(timeout)
+            import json as _json
+
+            seen.append((timeout, _json.loads(payload.decode())["timeoutMs"]))
             return real_submit(self, payload, timeout)
 
         QueryRouterChannel.submit = recording
         try:
             ok = broker.execute("SET timeoutMs = 2500; SELECT COUNT(*) FROM t")
             assert not ok.get("exceptions"), ok
-            assert seen and abs(seen[-1] - 2.5) < 1e-9, seen
+            # deadline propagation: the wire carries the REMAINING budget
+            # (<= the SET value; > 0 minus routing overhead) and the RPC
+            # deadline is that budget plus a small grace so the server's
+            # own typed QUERY_TIMEOUT answers first
+            rpc_timeout, budget_ms = seen[-1]
+            assert 2000.0 < budget_ms <= 2500.0, seen
+            assert abs(rpc_timeout - (budget_ms / 1e3 + 0.25)) < 1e-6, seen
             ok = broker.execute("SELECT COUNT(*) FROM t")
-            assert seen[-1] == 10.0  # broker default without the option
+            rpc_timeout, budget_ms = seen[-1]
+            assert 9500.0 < budget_ms <= 10000.0  # broker default budget
         finally:
             QueryRouterChannel.submit = real_submit
     finally:
