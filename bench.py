@@ -1369,6 +1369,172 @@ def bench_faults(n_queries: int = 40):
     return detail, violations
 
 
+def bench_observability(n_queries: int = 24):
+    """detail.observability: the flight-recorder phase (ISSUE 7). A
+    2-server in-process cluster serves a device group-by; the phase runs
+    the SAME query untraced and traced (SET trace=true) and gates on:
+
+    - disabled-trace overhead < 2%: the no-op span cost per query-path
+      span count, measured directly, against the untraced p50 — tracing
+      machinery must be free when off;
+    - phase-sum reconciliation: each server's top-level spans must cover
+      >= 90% of its reported server.total wall (drift > 10% means a
+      phase the ladder doesn't see).
+
+    The per-phase p50 breakdown (queue / compile / gather / kernel /
+    link / reduce) lands in the BENCH json so future rounds can track
+    the ROADMAP-1 link-floor attack against real per-phase numbers.
+    Runnable standalone: ``python -m bench --phase observability``
+    (exit 5 on violation)."""
+    import shutil
+
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import ClusterRegistry
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.common.trace import span, top_level_spans
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.storage.creator import build_segment
+    from pinot_tpu.tools.querylog import phase_breakdown
+
+    base = tempfile.mkdtemp(prefix="pinot_tpu_obs_")
+    detail: dict = {}
+    violations: list = []
+    registry = ClusterRegistry()
+    controller = Controller(registry, os.path.join(base, "ds"))
+    servers = [
+        ServerInstance(f"osrv_{i}", registry, os.path.join(base, f"s{i}"))
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=30.0)
+    try:
+        schema = Schema.build(
+            name="obs",
+            dimensions=[("region", DataType.STRING)],
+            metrics=[("amount", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="obs", replication=1)
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(11)
+        n_seg, rows_per = 4, 200_000
+        for i in range(n_seg):
+            cols = {
+                "region": np.array(["na", "eu", "apac", "latam"])[
+                    rng.integers(0, 4, rows_per)],
+                "amount": rng.integers(1, 500, rows_per).astype(np.int32),
+            }
+            d = os.path.join(base, f"up_s{i}")
+            build_segment(schema, cols, d, cfg, f"obs_s{i}")
+            controller.upload_segment("obs", d)
+        t_end = time.time() + 30
+        while time.time() < t_end:
+            if len(registry.external_view("obs_OFFLINE")) == n_seg:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("observability phase: segments never loaded")
+
+        plain = ("SELECT region, COUNT(*), SUM(amount) FROM obs "
+                 "GROUP BY region ORDER BY region")
+        traced = "SET trace = true; " + plain
+
+        def run(sql, n):
+            lats = []
+            last = None
+            for _ in range(n):
+                t0 = time.perf_counter()
+                last = broker.execute(sql)
+                lats.append((time.perf_counter() - t0) * 1e3)
+                if last.get("exceptions"):
+                    raise RuntimeError(f"query failed: {last['exceptions']}")
+            return lats, last
+
+        run(plain, 2)   # warm: jit-compile both servers' templates
+        run(traced, 1)  # warm the traced form (block_until_ready path)
+        lats_off, _ = run(plain, n_queries)
+        p50_off = float(np.percentile(lats_off, 50))
+
+        lats_on, _ = run(traced, n_queries)
+        p50_on = float(np.percentile(lats_on, 50))
+
+        # per-server coverage + phase waterfall from a fresh traced set
+        coverages = []
+        phase_samples: dict = {}
+        for _ in range(n_queries):
+            r = broker.execute(traced)
+            info = r.get("traceInfo") or {}
+            for inst, spans in info.items():
+                if inst == "broker":
+                    continue
+                total = next((s["durationMs"] for s in spans
+                              if s["phase"].endswith(".total")), None)
+                if not total:
+                    continue
+                cov = sum(s["durationMs"]
+                          for s in top_level_spans(spans)) / total
+                coverages.append(cov)
+            for k, v in phase_breakdown({"traceInfo": info}).items():
+                phase_samples.setdefault(k, []).append(v)
+
+        # disabled-span micro cost: the whole query path records ~40
+        # spans across broker + 2 servers; tracing off must cost no more
+        # than SPAN_COUNT no-op spans per query
+        SPAN_COUNT = 40
+        reps = 100_000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            with span("bench.noop"):
+                pass
+        per_span_ms = (time.perf_counter() - t0) / reps * 1e3
+        overhead_pct = SPAN_COUNT * per_span_ms / p50_off * 100.0
+
+        min_cov = min(coverages) if coverages else 0.0
+        med_cov = float(np.percentile(coverages, 50)) if coverages else 0.0
+        detail.update({
+            "untraced_p50_ms": round(p50_off, 2),
+            "traced_p50_ms": round(p50_on, 2),
+            "disabled_span_cost_us": round(per_span_ms * 1e3, 3),
+            "disabled_overhead_pct": round(overhead_pct, 4),
+            "phase_coverage_min": round(min_cov, 4),
+            "phase_coverage_p50": round(med_cov, 4),
+            "phase_coverage_mean": round(
+                float(np.mean(coverages)) if coverages else 0.0, 4),
+            "phase_p50_ms": {
+                k: round(float(np.percentile(v, 50)), 3)
+                for k, v in sorted(phase_samples.items())
+            },
+            "note": (
+                "coverage = sum of a server's top-level phase spans / its "
+                "server.total wall; phase_p50_ms sums each phase across "
+                "both servers per query (queue/compile/gather/kernel/"
+                "link/reduce — the ROADMAP-1 link-floor waterfall)"),
+        })
+        if overhead_pct > 2.0:
+            violations.append(
+                f"disabled-trace overhead {overhead_pct:.3f}% > 2% of the "
+                f"untraced p50 ({p50_off:.2f}ms)")
+        # gate on the MEDIAN: a single sample preempted between spans on
+        # an oversubscribed dev box is scheduler noise, not a phase the
+        # ladder fails to see; min still rides in the detail
+        if med_cov < 0.90:
+            violations.append(
+                f"phase-sum reconciliation drift: median per-server span "
+                f"coverage {med_cov:.3f} < 0.90 of server.total")
+    finally:
+        broker.close()
+        for s in servers:
+            try:
+                s.stop(drain_timeout_s=0.2)
+            except Exception:
+                pass
+        shutil.rmtree(base, ignore_errors=True)
+    return detail, violations
+
+
 def _load_micro_reference():
     """BENCH_r05 micro mrows_per_s per kernel: prefer the recorded
     BENCH_r05.json (driver wrapper: parsed.detail.micro, falling back to
@@ -1452,9 +1618,10 @@ def main():
 
     ap = argparse.ArgumentParser(description="pinot-tpu bench")
     ap.add_argument(
-        "--phase", choices=("full", "faults"), default="full",
-        help="'faults' runs ONLY the failure-domain phase (no dataset "
-             "build) so CI can gate on it standalone")
+        "--phase", choices=("full", "faults", "observability"),
+        default="full",
+        help="'faults' / 'observability' run ONLY that phase (no dataset "
+             "build) so CI can gate on each standalone")
     args = ap.parse_args()
     if args.phase == "faults":
         detail, violations = bench_faults()
@@ -1464,6 +1631,15 @@ def main():
             print(f"faults gate FAILED: {json.dumps(violations)}",
                   file=sys.stderr)
             sys.exit(4)
+        return
+    if args.phase == "observability":
+        detail, violations = bench_observability()
+        print(json.dumps({"metric": "observability-phase standalone",
+                          "detail": {"observability": detail}}))
+        if violations:
+            print(f"observability gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(5)
         return
     os.makedirs(CACHE, exist_ok=True)
     smoke_gate()
@@ -1511,6 +1687,7 @@ def main():
     realtime_detail = bench_realtime()
     chunklet_detail = bench_chunklet()
     faults_detail, faults_violations = bench_faults()
+    observability_detail, observability_violations = bench_observability()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -1567,6 +1744,7 @@ def main():
                     "realtime": realtime_detail,
                     "chunklet": chunklet_detail,
                     "faults": faults_detail,
+                    "observability": observability_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -1628,6 +1806,10 @@ def main():
         print(f"faults gate FAILED: {json.dumps(faults_violations)}",
               file=sys.stderr)
         sys.exit(4)
+    if observability_violations:
+        print(f"observability gate FAILED: "
+              f"{json.dumps(observability_violations)}", file=sys.stderr)
+        sys.exit(5)
 
 
 if __name__ == "__main__":

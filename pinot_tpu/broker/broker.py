@@ -160,32 +160,79 @@ class FailureDetector:
 
 
 class LatencyTracker:
-    """Rolling per-server latency window → the hedging trigger delay
-    (AdaptiveServerSelector's latency EWMA role, simplified to an exact
-    small-window percentile). A server with no history hedges after the
-    default — better to hedge a touch early than never."""
+    """Per-server latency view → the hedging trigger delay
+    (AdaptiveServerSelector's latency EWMA role). Since ISSUE 7 this
+    rides the SHARED metrics histogram machinery
+    (``broker.serverLatencyMs.<instance>`` — common/metrics.py
+    Histogram): every sample feeds the registry histogram (the
+    /metrics p50/p90/p99 exposition and the query log read that one
+    lifetime distribution), and the hedge trigger reads the SAME
+    log-bucketed histogram over a two-generation rotating window —
+    recency matters for hedging: a lifetime distribution with 100k fast
+    samples would hold the trigger at the old p90 for tens of thousands
+    of queries after a server degrades, hedging every request mid-
+    incident. A server with no history hedges after the default —
+    better to hedge a touch early than never."""
 
-    WINDOW = 64
+    METRIC = "serverLatencyMs"
+    WINDOW_S = 30.0        # rotate generations at least this often...
+    WINDOW_SAMPLES = 512   # ...or after this many samples, whichever first
 
-    def __init__(self, default_s: float = 0.05):
+    def __init__(self, default_s: float = 0.05, registry=None):
         self.default_s = default_s
-        self._samples: dict[str, list] = {}  # id -> ring of seconds
+        if registry is None:
+            from pinot_tpu.common.metrics import get_metrics
+
+            registry = get_metrics("broker")
+        self.metrics = registry
+        # instance -> [current Histogram, previous Histogram, rotated_at]
+        self._windows: dict = {}
         self._lock = threading.Lock()
 
     def record(self, instance_id: str, seconds: float) -> None:
+        from pinot_tpu.common.metrics import Histogram
+
+        ms = seconds * 1e3
+        self.metrics.time_ms(self.METRIC, ms, tag=instance_id)
+        now = time.monotonic()
         with self._lock:
-            ring = self._samples.setdefault(instance_id, [])
-            ring.append(seconds)
-            if len(ring) > self.WINDOW:
-                del ring[: len(ring) - self.WINDOW]
+            w = self._windows.get(instance_id)
+            if w is None:
+                w = self._windows[instance_id] = [Histogram(), None, now]
+            cur = w[0]
+            if (cur.count >= self.WINDOW_SAMPLES
+                    or now - w[2] >= self.WINDOW_S):
+                w[1], w[0], w[2] = cur, Histogram(), now
+                cur = w[0]
+            cur.update(ms)
 
     def p90_s(self, instance_id: str) -> float:
+        from pinot_tpu.common.metrics import Histogram
+
         with self._lock:
-            ring = self._samples.get(instance_id)
-            if not ring:
-                return self.default_s
-            s = sorted(ring)
-            return s[min(len(s) - 1, int(len(s) * 0.9))]
+            w = self._windows.get(instance_id)
+            if w is None:
+                p90_ms = None
+            else:
+                # merge current + previous generations (shared global
+                # bucket bounds make the merge a count add) so a fresh
+                # rotation never empties the view
+                merged = Histogram()
+                for h in (w[0], w[1]):
+                    if h is None:
+                        continue
+                    for i, c in enumerate(h.counts):
+                        merged.counts[i] += c
+                    merged.count += h.count
+                    merged.min_ms = min(merged.min_ms, h.min_ms)
+                    merged.max_ms = max(merged.max_ms, h.max_ms)
+                p90_ms = merged.quantile(0.9) if merged.count else None
+        if p90_ms is None:
+            # no windowed samples yet (e.g. restarted tracker): fall back
+            # to the lifetime histogram, then the default
+            p90_ms = self.metrics.quantile(self.METRIC, 0.9,
+                                           tag=instance_id)
+        return self.default_s if p90_ms is None else p90_ms / 1e3
 
 
 class RoutingManager:
@@ -275,7 +322,14 @@ class Broker:
         self.quota = QueryQuotaManager(registry)
         self.failures = FailureDetector()
         self.routing = RoutingManager(registry, self.failures)
-        self.latency = LatencyTracker()
+        # hedge-delay percentiles come from the SHARED metrics histogram
+        # (one latency truth — ISSUE 7)
+        self.latency = LatencyTracker(registry=self.metrics)
+        # structured slow/error query log (broker/querylog.py): JSONL +
+        # the /debug/queries ring
+        from pinot_tpu.broker.querylog import QueryLogger
+
+        self.querylog = QueryLogger.from_config()
         # failure-handling knobs (reference: pinot.broker.* config keys):
         # retry re-sends a failed instance's segments to a replica before
         # declaring partialResult; hedging duplicates a slow request to a
@@ -371,6 +425,7 @@ class Broker:
                 "timeUsedMs": round((time.time() - t0) * 1000, 3),
             }
         tracer = None
+        q = None
         try:
             q = optimize_query(compile_query(sql))
             q = self._resolve_table_case(q)
@@ -389,26 +444,51 @@ class Broker:
                 # quota rejection before any fan-out
                 # (BaseBrokerRequestHandler's quota check placement)
                 self.metrics.count("queriesQuotaExceeded")
-                return {"exceptions": [{
+                return self._log_query(sql, q, {"exceptions": [{
                     "errorCode": 429,
                     "message": f"query quota exceeded for table "
                                f"{q.table_name!r}"}],
                     # pacing hint for clients (Retry-After analog): the
                     # token bucket refills within about a second
-                    "retryAfterSeconds": 0.5}
+                    "retryAfterSeconds": 0.5}, t0)
             if q.options_ci().get("trace"):
                 tracer = trace.start_trace()
             resp = self._scatter_gather(q, sql)
             if tracer is not None:
                 resp.setdefault("traceInfo", {})["broker"] = tracer.to_json()
+                if tracer.trace_id:
+                    resp["traceId"] = tracer.trace_id
         except Exception as e:  # noqa: BLE001 — in-band errors like the reference
             self.metrics.count("queryErrors")
-            return {"exceptions": [{"errorCode": 450, "message": f"{type(e).__name__}: {e}"}]}
+            return self._log_query(sql, q, {"exceptions": [{
+                "errorCode": 450,
+                "message": f"{type(e).__name__}: {e}"}]}, t0)
         finally:
             if tracer is not None:
                 trace.end_trace()
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
         self.metrics.time_ms("query", resp["timeUsedMs"])
+        return self._log_query(sql, q, resp, t0)
+
+    def _log_query(self, sql: str, q, resp: dict, t0: float) -> dict:
+        """Feed the structured query log on EVERY terminal broker path
+        (success, partial, error, quota) and pass the response through.
+        Logging must never fail a query."""
+        time_used = resp.get("timeUsedMs")
+        if time_used is None:
+            time_used = round((time.time() - t0) * 1000, 3)
+        try:
+            from pinot_tpu.broker.querylog import template_key
+
+            self.querylog.record(
+                sql, resp, time_used,
+                table=q.table_name if q is not None else None,
+                # deferred: the keep policy drops most healthy fast
+                # queries before the template tree walk would run
+                template=(lambda _q=q: template_key(_q))
+                if q is not None else None)
+        except Exception:  # noqa: BLE001
+            log.exception("query log record failed")
         return resp
 
     def _resolve_table_case(self, q: QueryContext) -> QueryContext:
@@ -501,10 +581,21 @@ class Broker:
         return out
 
     def _scatter_gather(self, q: QueryContext, sql: str) -> dict:
-        from pinot_tpu.common.trace import span
+        from pinot_tpu.common.trace import active, span
 
         q = self._expand_star(q)
         request_id = next(self._request_id)
+        # trace id: minted per request, stamped into EVERY scatter
+        # request (primary + retries + hedges, each tagged with its
+        # attempt kind) so per-server spans join back to one query
+        tracer = active()
+        trace_id = f"{self.broker_id}-{request_id}"
+        if tracer is not None:
+            tracer.trace_id = trace_id
+        trace_on = tracer is not None
+        # per-query failure-handling counters (the query log's view; the
+        # registry counters aggregate the same events process-wide)
+        attempt_counts = {"retries": 0, "hedges": 0}
         # per-query timeout override (SET timeoutMs = N — the reference's
         # timeoutMs query option). The Deadline is THE budget: every
         # scatter request ships the remaining window, every gather wait is
@@ -530,25 +621,27 @@ class Broker:
         num_pruned = 0
         num_pruned_value = 0  # excluded by per-column min/max stats alone
         fully_pruned = []  # fallback: keep one segment so reduce sees a shape
-        for physical, time_filter in self._physical_tables(q.table_name):
-            routing, reps = self.routing.routing_with_replicas(physical)
-            if not routing:
-                continue
-            for seg, insts in reps.items():
-                replicas[(physical, seg)] = insts
-            records = self.registry.segments(physical)
-            cfg = self.registry.table_config(physical)
-            time_col = cfg.time_column if cfg is not None else None
-            for inst, segs in routing.items():
-                kept, pruned, by_value = prune_segments(
-                    q, records, segs, time_col, time_filter)
-                num_pruned += pruned
-                num_pruned_value += by_value
-                if kept:
-                    scatter.append((inst, physical, kept, time_filter))
-                    n_servers.add(inst)
-                else:
-                    fully_pruned.append((inst, physical, segs[:1], time_filter))
+        with span("broker.route"):
+            for physical, time_filter in self._physical_tables(q.table_name):
+                routing, reps = self.routing.routing_with_replicas(physical)
+                if not routing:
+                    continue
+                for seg, insts in reps.items():
+                    replicas[(physical, seg)] = insts
+                records = self.registry.segments(physical)
+                cfg = self.registry.table_config(physical)
+                time_col = cfg.time_column if cfg is not None else None
+                for inst, segs in routing.items():
+                    kept, pruned, by_value = prune_segments(
+                        q, records, segs, time_col, time_filter)
+                    num_pruned += pruned
+                    num_pruned_value += by_value
+                    if kept:
+                        scatter.append((inst, physical, kept, time_filter))
+                        n_servers.add(inst)
+                    else:
+                        fully_pruned.append(
+                            (inst, physical, segs[:1], time_filter))
         if not scatter and fully_pruned:
             # every segment pruned: query one anyway — the server's min/max
             # pruner short-circuits it, and the reduce gets a typed empty
@@ -580,7 +673,8 @@ class Broker:
         rows_seen = [0]
         rows_lock = threading.Lock()
 
-        def call(instance_id: str, physical: str, segments: list, time_filter):
+        def call(instance_id: str, physical: str, segments: list, time_filter,
+                 attempt: str = "primary"):
             if faults.ACTIVE:
                 # chaos seam: drop / delay / blackhole this replica's RPC
                 # (a blackhole sleeps at most the remaining budget — the
@@ -598,6 +692,9 @@ class Broker:
                 sql, segments, request_id, self.broker_id,
                 table=physical, time_filter=time_filter,
                 timeout_ms=budget_ms,
+                # every attempt ships the trace flag + id, tagged with its
+                # kind, so a retried/hedged query still traces end to end
+                trace=trace_on, trace_id=trace_id, attempt=attempt,
             )
             # small grace past the shipped budget: the server's own
             # deadline fires first; the RPC deadline is the backstop
@@ -652,11 +749,12 @@ class Broker:
         entries_lock = threading.Lock()
         entries = []
 
-        def submit_attempt(e, inst, segs=None):
+        def submit_attempt(e, inst, segs=None, kind="primary"):
             segs = e["segs"] if segs is None else segs
-            fut = self._pool.submit(call, inst, e["phys"], segs, e["tf"])
+            fut = self._pool.submit(call, inst, e["phys"], segs, e["tf"],
+                                    kind)
             with entries_lock:
-                e["futs"].append((fut, inst, frozenset(segs)))
+                e["futs"].append((fut, inst, frozenset(segs), kind))
             fut.add_done_callback(lambda _f, _ev=e["ev"]: _ev.set())
             return fut
 
@@ -715,7 +813,7 @@ class Broker:
             if deadline.expired():
                 return
             with entries_lock:
-                if any(f.done() for f, _i, _s in e["futs"]):
+                if any(f.done() for f, _i, _s, _k in e["futs"]):
                     return
                 alt = alternate_for(e)
                 # no single replica covers the list: hedge the split form
@@ -727,8 +825,9 @@ class Broker:
                     return
                 e["attempted"].update(groups)
             self.metrics.count("hedgedRequests")
+            attempt_counts["hedges"] += 1
             for inst2, segs2 in groups.items():
-                submit_attempt(e, inst2, segs2)
+                submit_attempt(e, inst2, segs2, kind="hedge")
 
         timers = []
         if hedging:
@@ -793,10 +892,11 @@ class Broker:
                     return
                 retried = True
                 self.metrics.count("retriedRequests")
+                attempt_counts["retries"] += 1
                 with entries_lock:
                     e["attempted"].update(groups)
                 for inst2, segs2 in groups.items():
-                    submit_attempt(e, inst2, segs2)
+                    submit_attempt(e, inst2, segs2, kind="retry")
 
             def finish(done):
                 """Cancel/ignore still-pending attempts, settle errors.
@@ -807,7 +907,7 @@ class Broker:
                 because a hedge always wins first."""
                 with entries_lock:
                     futs = list(e["futs"])
-                for f, i, _s in futs:
+                for f, i, _s, _k in futs:
                     if id(f) in e["consumed"]:
                         continue
                     if f.cancel():
@@ -822,8 +922,8 @@ class Broker:
                         # a replica answered after a failure: recovered —
                         # the result is complete, no partialResult
                         self.metrics.count("recoveredRequests")
-                    return [(s[1], s[2]) for s in done], []
-                return [(s[1], s[2]) for s in best_partial()], errors
+                    return [(s[1], s[2], s[3]) for s in done], []
+                return [(s[1], s[2], s[3]) for s in best_partial()], errors
 
             while True:
                 with entries_lock:
@@ -847,12 +947,12 @@ class Broker:
                             (250, f"QUERY_TIMEOUT: {i} did not respond "
                                   f"within the {timeout_s * 1e3:.0f}ms "
                                   f"query budget")
-                            for _f, i, _s in live)
+                            for _f, i, _s, _k in live)
                         return finish(None)
                     e["ev"].wait(min(left, 0.25))
                     e["ev"].clear()
                     continue
-                for fut, inst, segs_of in ready:
+                for fut, inst, segs_of, kind in ready:
                     e["consumed"].add(id(fut))
                     if fut.cancelled():
                         continue
@@ -863,7 +963,7 @@ class Broker:
                         # the external-view read and the RPC; not a
                         # failure — the attempt's share counts covered
                         self.failures.mark_success(inst)
-                        successes.append((segs_of, [], inst))
+                        successes.append((segs_of, [], inst, kind))
                         continue
                     except QueryTimeoutError as exc:
                         # server-side typed timeout: the server is healthy,
@@ -895,22 +995,27 @@ class Broker:
                         try_retry()
                         continue
                     self.failures.mark_success(inst)
-                    successes.append((segs_of, parts, inst))
+                    successes.append((segs_of, parts, inst, kind))
                 done = resolved()
                 if done is not None:
                     return finish(done)
 
-        with span("broker.scatter_gather"):
+        with span("broker.scatter_gather"), self.metrics.timed("scatterMs"):
             for e in entries:
                 served, errs = harvest(e)
                 attempted_all |= e["attempted"]
                 exceptions.extend(
                     {"errorCode": code, "message": msg}
                     for code, msg in errs)
-                for parts, inst in served:
+                for parts, inst, kind in served:
+                    # traceInfo keyed by instance, retry/hedge attempts
+                    # tagged; a server answering several entries (hybrid
+                    # split, split retries) MERGES its span lists — no
+                    # duplicate and no dropped server spans
+                    tkey = inst if kind == "primary" else f"{inst} ({kind})"
                     for r in parts:
                         if r.trace is not None:
-                            server_traces[inst] = r.trace
+                            server_traces.setdefault(tkey, []).extend(r.trace)
                         results.append(r)
                     if parts:
                         responded.add(inst)
@@ -931,6 +1036,8 @@ class Broker:
                     "partialResult": True,
                     "numServersQueried": len(n_servers | attempted_all),
                     "numServersResponded": len(responded),
+                    "numRetries": attempt_counts["retries"],
+                    "numHedges": attempt_counts["hedges"],
                     "requestId": request_id,
                 }
             raise ConnectionError(f"all servers failed: {exceptions}")
@@ -951,6 +1058,8 @@ class Broker:
                 # the instances whose answers the reduce actually used
                 "numServersQueried": len(n_servers | attempted_all),
                 "numServersResponded": len(responded),
+                "numRetries": attempt_counts["retries"],
+                "numHedges": attempt_counts["hedges"],
                 "numDocsScanned": stats.num_docs_scanned,
                 "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,
                 "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,

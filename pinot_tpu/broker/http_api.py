@@ -83,6 +83,19 @@ class BrokerHttpServer:
                     from pinot_tpu.common.metrics import all_snapshots
 
                     self._send(200, all_snapshots())
+                elif self.path.startswith("/debug/queries"):
+                    # flight-recorder ring: the last N logged queries
+                    # (slow/errored/sampled — broker/querylog.py policy),
+                    # newest first, each with its merged trace attached
+                    try:
+                        from urllib.parse import parse_qs, urlparse
+
+                        qs = parse_qs(urlparse(self.path).query)
+                        limit = int(qs.get("limit", ["0"])[0])
+                    except (ValueError, IndexError):
+                        limit = 0
+                    self._send(200, {
+                        "queries": outer.broker.querylog.recent(limit)})
                 elif self.path == "/metrics/prometheus":
                     from pinot_tpu.common.metrics import all_prometheus_text
 

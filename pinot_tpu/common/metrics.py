@@ -1,4 +1,4 @@
-"""Metrics registry: counters, gauges, timers per component.
+"""Metrics registry: counters, gauges, timers + latency histograms.
 
 Equivalent of the reference's metrics SPI
 (pinot-common/.../metrics/AbstractMetrics.java + BrokerMetrics /
@@ -7,13 +7,95 @@ meters/gauges/timers keyed ``component.name[.tag]``, aggregated
 in-process and exported as a snapshot dict or Prometheus text. The
 yammer backend is replaced by lock-cheap python primitives — emission to
 an external system is a reporter's job (register one with
-``add_reporter``), matching the SPI split."""
+``add_reporter``), matching the SPI split.
+
+Every timer key ALSO maintains a log-bucketed :class:`Histogram` (the
+yammer ``Histogram``/``Timer`` percentile role): p50/p90/p99/p999 ride
+the snapshot and the Prometheus exposition emits a real ``histogram``
+family (``_bucket{le=...}``/``_sum``/``_count`` + ``# HELP``/``# TYPE``)
+per key. One update feeds both — there is ONE latency truth; consumers
+that need a quantile (the broker's adaptive hedge delay, dashboards)
+read it from here instead of keeping private sample windows.
+"""
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Callable, Optional
+
+# ---------------------------------------------------------------------------
+# histogram buckets: geometric (log-spaced) bounds shared by every
+# Histogram instance — factor 2**0.25 (~19% bucket width) from 10 µs to
+# ~2.8 hours, so quantile interpolation error is bounded by one bucket
+# (<~19% relative) across the whole range a query path can produce.
+# ---------------------------------------------------------------------------
+_HIST_FACTOR = 2.0 ** 0.25
+_HIST_MIN_MS = 1e-2
+_HIST_NBUCKETS = 120  # upper bound of last finite bucket ≈ 1e7 ms
+HIST_BOUNDS_MS = tuple(_HIST_MIN_MS * _HIST_FACTOR ** i
+                       for i in range(_HIST_NBUCKETS))
+
+
+class Histogram:
+    """Log-bucketed latency histogram (ms). Fixed global bounds keep
+    updates O(log B) and merging trivial; quantiles interpolate linearly
+    inside the containing bucket and clamp to the observed min/max."""
+
+    __slots__ = ("counts", "count", "total_ms", "min_ms", "max_ms")
+
+    def __init__(self):
+        # counts[i] observes (bounds[i-1], bounds[i]]; the last slot is
+        # the overflow bucket above the final finite bound
+        self.counts = [0] * (_HIST_NBUCKETS + 1)
+        self.count = 0
+        self.total_ms = 0.0
+        self.min_ms = float("inf")
+        self.max_ms = 0.0
+
+    def update(self, ms: float) -> None:
+        self.counts[bisect.bisect_left(HIST_BOUNDS_MS, ms)] += 1
+        self.count += 1
+        self.total_ms += ms
+        if ms < self.min_ms:
+            self.min_ms = ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile with in-bucket linear interpolation;
+        0.0 when empty (callers that need a default should check
+        ``count`` first)."""
+        if self.count == 0:
+            return 0.0
+        import math
+
+        target = max(1, min(self.count, math.ceil(q * self.count)))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = 0.0 if i == 0 else HIST_BOUNDS_MS[i - 1]
+                hi = HIST_BOUNDS_MS[i] if i < _HIST_NBUCKETS else self.max_ms
+                frac = (target - cum) / c
+                val = lo + frac * (hi - lo)
+                return float(min(max(val, self.min_ms), self.max_ms))
+            cum += c
+        return float(self.max_ms)
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "p50Ms": 0.0, "p90Ms": 0.0, "p99Ms": 0.0,
+                    "p999Ms": 0.0}
+        return {
+            "count": self.count,
+            "p50Ms": round(self.quantile(0.50), 3),
+            "p90Ms": round(self.quantile(0.90), 3),
+            "p99Ms": round(self.quantile(0.99), 3),
+            "p999Ms": round(self.quantile(0.999), 3),
+        }
 
 
 class Timer:
@@ -50,6 +132,7 @@ class MetricsRegistry:
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, Callable | float] = {}
         self._timers: dict[str, Timer] = {}
+        self._hists: dict[str, Histogram] = {}
         self._reporters: list[Callable] = []
 
     def _key(self, name: str, tag: Optional[str]) -> str:
@@ -76,14 +159,40 @@ class MetricsRegistry:
         with self._lock:
             self._gauges.pop(self._key(name, tag), None)
 
-    # ---- timers (addTimedTableValue analog) -----------------------------
+    def gauge_keys(self, tag: str) -> list:
+        """Registered gauge keys carrying ``tag`` as their last segment —
+        the leak audit surface: after a component's stop(), this must be
+        empty for its instance id."""
+        suffix = "." + tag
+        with self._lock:
+            return [k for k in self._gauges if k.endswith(suffix)]
+
+    # ---- timers + histograms (addTimedTableValue analog) ----------------
     def time_ms(self, name: str, ms: float, tag: Optional[str] = None) -> None:
+        """One observation feeds BOTH the legacy count/avg/min/max timer
+        and the log-bucketed histogram under the same key."""
         key = self._key(name, tag)
         with self._lock:
             t = self._timers.get(key)
             if t is None:
                 t = self._timers[key] = Timer()
+                self._hists[key] = Histogram()
             t.update(ms)
+            self._hists[key].update(ms)
+
+    # observe() is the histogram-forward alias: same storage, same key —
+    # call sites that think in distributions rather than timers read better
+    observe = time_ms
+
+    def quantile(self, name: str, q: float,
+                 tag: Optional[str] = None) -> Optional[float]:
+        """Histogram quantile in ms for ``name[.tag]``; None when no
+        sample was ever recorded (callers supply their own default)."""
+        with self._lock:
+            h = self._hists.get(self._key(name, tag))
+            if h is None or h.count == 0:
+                return None
+            return h.quantile(q)
 
     class _Span:
         __slots__ = ("reg", "name", "tag", "t0")
@@ -102,6 +211,17 @@ class MetricsRegistry:
 
     def timed(self, name: str, tag: Optional[str] = None) -> "_Span":
         return self._Span(self, name, tag)
+
+    # ---- lifecycle ------------------------------------------------------
+    def reset(self) -> None:
+        """Drop every counter/gauge/timer/histogram (reporters stay).
+        Component teardown in tests calls this so a RESTARTED instance
+        can't double-count against the process-global registry."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timers.clear()
+            self._hists.clear()
 
     # ---- export ---------------------------------------------------------
     def add_reporter(self, fn: Callable[[dict], None]) -> None:
@@ -124,26 +244,56 @@ class MetricsRegistry:
                 "counters": dict(self._counters),
                 "gauges": gauges,
                 "timers": {k: t.snapshot() for k, t in self._timers.items()},
+                "histograms": {k: h.snapshot()
+                               for k, h in self._hists.items()},
             }
 
     def prometheus_text(self) -> str:
-        """Prometheus exposition format (the common reporter target)."""
+        """Prometheus exposition format (the common reporter target).
+        Timers export as real ``histogram`` families: cumulative
+        ``_bucket{le=...}`` lines (only buckets where the cumulative
+        count advances, plus ``+Inf`` — a sparse but valid exposition),
+        ``_sum``/``_count``, and a separate untyped ``_max`` sample."""
 
         def sanitize(k: str) -> str:
             return "pinot_tpu_" + k.replace(".", "_").replace("-", "_")
 
         lines = []
-        snap = self.snapshot()
-        for k, v in sorted(snap["counters"].items()):
-            lines.append(f"{sanitize(k)}_total {v}")
-        for k, v in sorted(snap["gauges"].items()):
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = []
+            for k, v in sorted(self._gauges.items()):
+                try:
+                    gauges.append((k, v() if callable(v) else v))
+                except Exception:  # noqa: BLE001 — sampling must not throw
+                    gauges.append((k, None))
+            hists = [(k, h.counts[:], h.count, h.total_ms, h.max_ms)
+                     for k, h in sorted(self._hists.items())]
+        for k, v in counters:
+            base = sanitize(k) + "_total"
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{base} {v}")
+        for k, v in gauges:
             if v is not None:
-                lines.append(f"{sanitize(k)} {v}")
-        for k, t in sorted(snap["timers"].items()):
-            base = sanitize(k)
-            lines.append(f"{base}_ms_count {t['count']}")
-            lines.append(f"{base}_ms_sum {t['totalMs']}")
-            lines.append(f"{base}_ms_max {t['maxMs']}")
+                base = sanitize(k)
+                lines.append(f"# TYPE {base} gauge")
+                lines.append(f"{base} {v}")
+        for k, counts, count, total_ms, max_ms in hists:
+            base = sanitize(k) + "_ms"
+            lines.append(f"# HELP {base} latency distribution of {k} "
+                         f"in milliseconds")
+            lines.append(f"# TYPE {base} histogram")
+            cum = 0
+            for i, c in enumerate(counts):
+                if c == 0 or i >= _HIST_NBUCKETS:
+                    continue
+                cum += c
+                lines.append(
+                    f'{base}_bucket{{le="{HIST_BOUNDS_MS[i]:.6g}"}} {cum}')
+            lines.append(f'{base}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{base}_sum {round(total_ms, 3)}")
+            lines.append(f"{base}_count {count}")
+            lines.append(f"{base}_max {round(max_ms, 3)}")
         return "\n".join(lines) + "\n"
 
 
@@ -158,6 +308,19 @@ def get_metrics(component: str) -> MetricsRegistry:
         if reg is None:
             reg = _registries[component] = MetricsRegistry(component)
         return reg
+
+
+def reset_metrics(component: Optional[str] = None) -> None:
+    """Reset one component's registry (or ALL when None). Registry
+    OBJECTS survive — components hold references to them — only their
+    contents clear. The test-isolation / restart story: process-global
+    registries otherwise accumulate across ServerInstance lifecycles."""
+    with _reg_lock:
+        regs = ([_registries[component]] if component in _registries
+                else [] if component is not None
+                else list(_registries.values()))
+    for reg in regs:
+        reg.reset()
 
 
 def all_snapshots() -> dict:

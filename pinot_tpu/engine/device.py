@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 
 from pinot_tpu.common import faults
+from pinot_tpu.common.metrics import get_metrics
+from pinot_tpu.common.trace import span as trace_span
 from pinot_tpu.engine import aggspec
 from pinot_tpu.engine.inflight import InflightLaunch, LaunchCoalescer
 from pinot_tpu.engine.params import (
@@ -999,6 +1001,9 @@ class DeviceExecutor:
         self.profile_enabled = False
         self._last_launch = None
         self.last_get_wait_s = None
+        # device launch/fetch latency histograms ride the server registry
+        # (ISSUE 7: the hot timers share ONE histogram-backed truth)
+        self.metrics = get_metrics("server")
         # stateless launch-time stats pruner (engine.SegmentPruner), built
         # lazily to keep the engine module import one-directional
         self._stats_pruner = None
@@ -1283,17 +1288,31 @@ class DeviceExecutor:
         bits.extend(g.name for g in (q.group_by or ()) if g.is_identifier)
         return ":".join(bits)
 
-    def _make_resolve(self, bufs_dev, layout):
+    def _make_resolve(self, bufs_dev, layout, tracer=None):
         """fetch-phase closure shared by solo and cohort launches: ONE
         blocking device_get of the dispatched packed buffer, observability
-        accounting under the lock, unpack by the precomputed layout."""
+        accounting under the lock, unpack by the precomputed layout.
+
+        ``tracer``: the dispatching query's Tracer (cohorts: the
+        LEADER's). When tracing, the blocking wait splits into a KERNEL
+        span (block_until_ready — remaining device compute since
+        dispatch) and a LINK span (device_get — the host transfer), the
+        waterfall's kernel-ms vs link-ms separation; untraced fetches
+        keep the single-call fast path so tracing-off overhead is one
+        ``None`` check."""
         def resolve():
             import time as _time
 
             if faults.ACTIVE:
                 faults.inject("device.fetch")
             _t_get = _time.perf_counter()
-            bufs = jax.device_get(bufs_dev)
+            if tracer is not None:
+                with trace_span("kernel", tracer):
+                    jax.block_until_ready(bufs_dev)
+                with trace_span("link", tracer):
+                    bufs = jax.device_get(bufs_dev)
+            else:
+                bufs = jax.device_get(bufs_dev)
             # blocking wait = link round trip + kernel; bench subtracts it
             # from wall time for a MEASURED host_ms (floor-subtraction
             # overstated host work by the link's RTT variance)
@@ -1304,6 +1323,7 @@ class DeviceExecutor:
                 # observability: what actually crossed the host link
                 self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())
                 self.fetch_leaves_total += len(bufs)
+            self.metrics.time_ms("deviceFetchMs", wait * 1e3)
             return _unpack_outs(bufs, layout)
 
         return resolve
@@ -1365,7 +1385,8 @@ class DeviceExecutor:
         return (name, argt, rpb)
 
     def launch(self, q: QueryContext, segments,
-               final: bool = False, alive=None) -> InflightLaunch:
+               final: bool = False, alive=None,
+               tracer=None) -> InflightLaunch:
         """LAUNCH phase: template build + column gather + NON-BLOCKING XLA
         dispatch (JAX dispatch is async; only device_get blocks). Returns
         an InflightLaunch whose ``fetch()`` resolves the packed output
@@ -1377,7 +1398,13 @@ class DeviceExecutor:
 
         ``alive``: optional per-segment bool sequence from a caller that
         already ran the stats pruner (engine.execute_segments_async) —
-        skips re-deriving Level-1 verdicts here. None = derive them."""
+        skips re-deriving Level-1 verdicts here. None = derive them.
+
+        ``tracer``: the query's explicit Tracer (common/trace.py) —
+        carried by reference through the handle and the fetch closure so
+        spans recorded on OTHER threads (deferred fetch, cohort leader)
+        land on THIS query's trace, not a thread-local's."""
+        t_launch = time.perf_counter()
         aggs = q.aggregations()
         if q.distinct:
             # DISTINCT == group-by over the select columns with no aggs:
@@ -1405,8 +1432,14 @@ class DeviceExecutor:
             ctx = self.batch_for(segments, retain=True)
             tpl_box: list = []
             try:
-                return self._launch_pinned(q, ctx, batch_key, segments,
-                                           aggs, final, alive, tpl_box)
+                handle = self._launch_pinned(q, ctx, batch_key, segments,
+                                             aggs, final, alive, tpl_box,
+                                             tracer)
+                handle.tracer = tracer
+                self.metrics.time_ms(
+                    "deviceLaunchMs",
+                    (time.perf_counter() - t_launch) * 1e3)
+                return handle
             except BaseException as e:
                 self._release_launch(batch_key)
                 if not _is_device_runtime_error(e):
@@ -1436,7 +1469,8 @@ class DeviceExecutor:
         ) from last_err
 
     def _launch_pinned(self, q, ctx, batch_key, segments, aggs,
-                       final, alive_hint=None, tpl_box=None) -> InflightLaunch:
+                       final, alive_hint=None, tpl_box=None,
+                       tracer=None) -> InflightLaunch:
         params: dict = {}
         counter = [0]
 
@@ -1596,25 +1630,26 @@ class DeviceExecutor:
         entry = self._pipeline_entry(template, agg_tpls, final, use_bs,
                                      widths, wsig)
         cols = {}
-        for c in sorted(needed):
-            if c.startswith(bs_ops.ZLO):
-                cols[c] = ctx.zone_map(c[len(bs_ops.ZLO):])[0]
-            elif c.startswith(bs_ops.ZHI):
-                cols[c] = ctx.zone_map(c[len(bs_ops.ZHI):])[1]
-            elif c.startswith("dv::"):
-                cols[c] = ctx.decoded_column(c[4:])
-            elif c.startswith("sk::"):
-                _, colname, l2m = c.split("::")
-                cols[c] = ctx.sorted_hll_keys(
-                    group_cols, group_cards, colname, int(l2m))
-            elif c.startswith("hh::"):
-                cols[c] = ctx.prehashed_column(c[4:])
-            elif c.startswith("bp::"):
-                cols[c] = ctx.bytes_plane_column(c[4:])
-            elif c.startswith("mv::"):
-                cols[c] = ctx.mv_column(c[4:])
-            else:
-                cols[c] = ctx.column(c)
+        with trace_span("gather", tracer):
+            for c in sorted(needed):
+                if c.startswith(bs_ops.ZLO):
+                    cols[c] = ctx.zone_map(c[len(bs_ops.ZLO):])[0]
+                elif c.startswith(bs_ops.ZHI):
+                    cols[c] = ctx.zone_map(c[len(bs_ops.ZHI):])[1]
+                elif c.startswith("dv::"):
+                    cols[c] = ctx.decoded_column(c[4:])
+                elif c.startswith("sk::"):
+                    _, colname, l2m = c.split("::")
+                    cols[c] = ctx.sorted_hll_keys(
+                        group_cols, group_cards, colname, int(l2m))
+                elif c.startswith("hh::"):
+                    cols[c] = ctx.prehashed_column(c[4:])
+                elif c.startswith("bp::"):
+                    cols[c] = ctx.bytes_plane_column(c[4:])
+                elif c.startswith("mv::"):
+                    cols[c] = ctx.mv_column(c[4:])
+                else:
+                    cols[c] = ctx.column(c)
         if os.environ.get("PINOT_TPU_WIDTH_AUDIT", "") not in ("", "0"):
             _width_audit(ctx, cols, widths)
 
@@ -1648,8 +1683,9 @@ class DeviceExecutor:
             synth = _neutral_outs(layout)
             return InflightLaunch(self, q, ctx, template, aggs, batch_key,
                                   lambda: synth)
-        resolve = self._dispatch(
-            entry, batch_key, cols, n_docs, params, lkey, layout)
+        with trace_span("dispatch", tracer):
+            resolve = self._dispatch(
+                entry, batch_key, cols, n_docs, params, lkey, layout, tracer)
         return InflightLaunch(self, q, ctx, template, aggs, batch_key, resolve)
 
     # ---- dispatch: solo vs coalesced -------------------------------------
@@ -1708,11 +1744,18 @@ class DeviceExecutor:
             self._pipelines[(template, self.mm_mode, blockskip, wsig)] = entry
             return entry
 
-    def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout):
+    def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout,
+                  tracer=None):
         """Dispatch one query: through the coalescer when concurrency makes
         a cohort partner likely, else solo. Returns the resolve() closure
         the InflightLaunch fetch phase blocks on. Coalescing is disabled
-        under profile capture (the bench must see per-query launches)."""
+        under profile capture (the bench must see per-query launches).
+
+        ``tracer`` rides into the resolve closure: a solo launch's fetch
+        spans land on the launching query's trace; a COHORT's shared
+        fetch spans land on the leader's (whoever opened the window
+        supplies the launch_fn, hence the tracer) — member queries still
+        get their own fetch-phase span from InflightLaunch.fetch."""
         co = self.coalescer
         if (co is not None and not self.profile_enabled
                 and co.should_window(self.inflight)):
@@ -1725,11 +1768,11 @@ class DeviceExecutor:
             cohort, idx = co.join(
                 ckey, params,
                 lambda members: self._cohort_launch(
-                    entry, cols, n_docs, members, lkey))
+                    entry, cols, n_docs, members, lkey, tracer))
             return lambda: cohort.resolve_member(idx)
-        return self._solo_launch(entry, cols, n_docs, params, layout)
+        return self._solo_launch(entry, cols, n_docs, params, layout, tracer)
 
-    def _solo_launch(self, entry, cols, n_docs, params, layout):
+    def _solo_launch(self, entry, cols, n_docs, params, layout, tracer=None):
         pipeline = entry["pipeline"]
         if self.profile_enabled:
             with self._lock:
@@ -1739,9 +1782,9 @@ class DeviceExecutor:
                         * v.dtype.itemsize for v in cols.values()),
                 )
         bufs_dev = pipeline(cols, n_docs, params)  # async dispatch
-        return self._make_resolve(bufs_dev, layout)
+        return self._make_resolve(bufs_dev, layout, tracer)
 
-    def _cohort_launch(self, entry, cols, n_docs, members, lkey):
+    def _cohort_launch(self, entry, cols, n_docs, members, lkey, tracer=None):
         """Leader side of a coalesced cohort: stack every member's params
         along a leading axis and dispatch ONE vmapped launch; the shared
         resolve() fetches ONE packed buffer for the whole cohort (each
@@ -1751,7 +1794,8 @@ class DeviceExecutor:
             # pipeline serves it — a size-1 vmapped variant would be a
             # whole extra compile of the template for nothing
             layout = entry["layouts"][lkey]
-            base = self._solo_launch(entry, cols, n_docs, members[0], layout)
+            base = self._solo_launch(entry, cols, n_docs, members[0], layout,
+                                     tracer)
             return lambda: {k: v[None] for k, v in base().items()}
         pipeline_v, inner_v = self._cohort_pipeline(entry)
         # pad the cohort to the next power of two (repeating the last
@@ -1777,7 +1821,7 @@ class DeviceExecutor:
             with self._lock:
                 entry["cohort_layouts"][ck] = layout
         bufs_dev = pipeline_v(cols, n_docs, pstack)  # async dispatch
-        return self._make_resolve(bufs_dev, layout)
+        return self._make_resolve(bufs_dev, layout, tracer)
 
     def _cohort_pipeline(self, entry):
         """(jitted packed pipeline, inner fn) over params carrying a

@@ -240,8 +240,15 @@ class QueryEngine:
 
     def execute_segments_async(self, q: QueryContext, segments,
                                terminal: bool = False, fallback_gate=None,
-                               deadline=None):
+                               deadline=None, tracer=None):
         """LAUNCH phase of execute_segments → zero-arg fetch() closure.
+
+        ``tracer`` (common/trace.py Tracer, optional): the query's
+        explicit trace object, carried BY REFERENCE through the device
+        launch handles and into the returned fetch closure — spans
+        recorded during the deferred fetch (possibly another thread) or
+        inside a coalesced cohort land on this query's trace, never on
+        whatever tracer the executing thread happens to hold.
 
         ``deadline`` (common/deadline.py Deadline, optional): the query's
         propagated end-to-end budget. Checked before each host segment
@@ -265,6 +272,7 @@ class QueryEngine:
         the gate a fallback storm would escape the concurrency cap."""
         q = self._expand_star(q, segments[0])
 
+        from pinot_tpu.common.trace import span
         from pinot_tpu.engine.device import DeviceUnsupported, \
             segment_device_eligible
 
@@ -372,7 +380,8 @@ class QueryEngine:
                         hint = [id(s) not in scan_pruned for s in g] \
                             if g is device_sealed else None
                         handle = self.device.launch(q, g, final=final,
-                                                    alive=hint)
+                                                    alive=hint,
+                                                    tracer=tracer)
                         handle.deadline = deadline
                         device_handles.append((handle, g))
                 except DeviceUnsupported:
@@ -393,10 +402,11 @@ class QueryEngine:
             # must release the in-flight handles or their batch pins leak
             try:
                 host_results = []
-                for s in host_segs:
-                    if deadline is not None:
-                        deadline.check("host scan")
-                    host_results.append(self.host.execute_segment(q, s))
+                with span("host_scan", tracer):
+                    for s in host_segs:
+                        if deadline is not None:
+                            deadline.check("host scan")
+                        host_results.append(self.host.execute_segment(q, s))
             except BaseException:
                 for h, _ in device_handles:
                     h.release()
@@ -440,11 +450,13 @@ class QueryEngine:
 
                             def _host_rerun(_segs=live):
                                 out = []
-                                for s in _segs:
-                                    if deadline is not None:
-                                        deadline.check("host fallback scan")
-                                    out.append(
-                                        self.host.execute_segment(q, s))
+                                with span("host_fallback", tracer):
+                                    for s in _segs:
+                                        if deadline is not None:
+                                            deadline.check(
+                                                "host fallback scan")
+                                        out.append(
+                                            self.host.execute_segment(q, s))
                                 return out
 
                             res.extend(
@@ -464,7 +476,8 @@ class QueryEngine:
                 res.append(self.host.execute_segment(
                     _impossible(q), segments[0]))
 
-            merged = merge_intermediates(q, res)
+            with span("merge", tracer):
+                merged = merge_intermediates(q, res)
             # device partials carry their own launch-level pruned counts
             # (alive-masked batch members); add the segments dropped here
             merged.stats.num_segments_pruned += pruned + len(fallback_pruned)

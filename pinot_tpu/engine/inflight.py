@@ -51,6 +51,12 @@ class InflightLaunch:
         # aborts BEFORE the blocking device_get (which itself cannot be
         # interrupted) with a typed QueryTimeout
         self.deadline = None
+        # optional explicit Tracer (common/trace.py), set by the executor
+        # when the query is traced: the fetch phase may run on a different
+        # thread than the launch (PR-2 split) or ride a cohort whose
+        # shared buffer another member resolves — spans recorded against
+        # the handle's tracer land on THIS query's trace regardless
+        self.tracer = None
 
     def fetch(self):
         """Blocking phase: resolve the packed buffer → IntermediateResult.
@@ -66,7 +72,16 @@ class InflightLaunch:
             if self.deadline is not None:
                 self.deadline.check("device fetch")
             try:
-                outs = self._resolve()
+                if self.tracer is not None:
+                    # the member-side fetch wait: covers the cohort-shared
+                    # resolve (whose own kernel/link sub-spans land on the
+                    # LEADER's trace) as well as the solo path
+                    from pinot_tpu.common.trace import span
+
+                    with span("device_fetch", self.tracer):
+                        outs = self._resolve()
+                else:
+                    outs = self._resolve()
             except Exception as e:  # noqa: BLE001 — may convert to fallback
                 # device-runtime failures (XlaRuntimeError /
                 # RESOURCE_EXHAUSTED, real or injected) convert to the

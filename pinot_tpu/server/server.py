@@ -147,12 +147,16 @@ class ServerInstance:
         from pinot_tpu.common.metrics import get_metrics
 
         self.metrics = get_metrics("server")
-        self.metrics.gauge("segmentsLoaded", lambda: sum(
-            len(t.segments) for t in self.engine.tables.values()),
-            tag=instance_id)
-        self.metrics.gauge("schedulerRejected",
-                           lambda: self.scheduler.num_rejected,
-                           tag=instance_id)
+        # every callable gauge this instance registers is TRACKED so
+        # stop() can unregister the lot — get_metrics registries are
+        # process-global, and a forgotten gauge closure pins the stopped
+        # instance (and its segments) forever while reporting stale
+        # values for a restarted one (ISSUE 7 lifecycle audit)
+        self._registered_gauges: list = []
+        self._register_gauge("segmentsLoaded", lambda: sum(
+            len(t.segments) for t in self.engine.tables.values()))
+        self._register_gauge("schedulerRejected",
+                             lambda: self.scheduler.num_rejected)
         # HBM / batch-LRU accounting (DeviceExecutor.hbm_stats): resident
         # bytes, cache traffic, and bytes the width planning saved — the
         # operational view of ISSUE 5's narrowing (a shrinking
@@ -166,26 +170,31 @@ class ServerInstance:
                                 ("deviceBatchMisses", "batch_misses"),
                                 ("deviceBatchEvictions", "batch_evictions"),
                                 ("deviceLaunchFailures", "launch_failures")):
-                self.metrics.gauge(
-                    gname, (lambda _a=attr, _d=dev: getattr(_d, _a)),
-                    tag=instance_id)
-            self.metrics.gauge(
+                self._register_gauge(
+                    gname, (lambda _a=attr, _d=dev: getattr(_d, _a)))
+            self._register_gauge(
                 "deviceResidentBytes",
-                (lambda _d=dev: _d.resident_bytes()), tag=instance_id)
-            self.metrics.gauge(
+                (lambda _d=dev: _d.resident_bytes()))
+            self._register_gauge(
                 "deviceNarrowSavedBytes",
-                (lambda _d=dev: _d.narrow_saved_bytes()), tag=instance_id)
+                (lambda _d=dev: _d.narrow_saved_bytes()))
             # quarantine breaker visibility: pipelines the device-error
             # recovery has routed to host (a non-zero value alongside
             # rising deviceLaunchFailures = a poisoned template/batch)
-            self.metrics.gauge(
+            self._register_gauge(
                 "deviceQuarantinedPipelines",
-                (lambda _d=dev: len(_d._quarantined)), tag=instance_id)
+                (lambda _d=dev: len(_d._quarantined)))
         self._stop = threading.Event()
         self._sync_thread: Optional[threading.Thread] = None
         self._realtime_managers: dict = {}  # table -> RealtimeTableDataManager
         self.queries_served = 0
         self.tags = tuple(tags)  # tier placement tags (Helix tag analog)
+
+    def _register_gauge(self, name: str, fn) -> None:
+        """Callable gauge tagged with this instance id, recorded for
+        symmetric teardown in stop() (removeGauge-on-shutdown audit)."""
+        self.metrics.gauge(name, fn, tag=self.instance_id)
+        self._registered_gauges.append(name)
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -228,15 +237,13 @@ class ServerInstance:
                     break
                 self._inflight_cond.wait(min(left, 0.1))
         self._stop.set()
-        # drop the callable gauges: their closures would otherwise pin this
-        # instance (and its loaded segments) in the process-global registry
-        self.metrics.remove_gauge("segmentsLoaded", tag=self.instance_id)
-        self.metrics.remove_gauge("schedulerRejected", tag=self.instance_id)
-        for gname in ("deviceResidentBytes", "deviceNarrowSavedBytes",
-                      "deviceBatchHits", "deviceBatchMisses",
-                      "deviceBatchEvictions", "deviceLaunchFailures",
-                      "deviceQuarantinedPipelines"):
+        # drop EVERY callable gauge this instance registered (tracked in
+        # _register_gauge): their closures would otherwise pin this
+        # instance (and its loaded segments) in the process-global
+        # registry, and a restarted same-id instance would alias them
+        for gname in self._registered_gauges:
             self.metrics.remove_gauge(gname, tag=self.instance_id)
+        self._registered_gauges = []
         if self._sync_thread is not None:
             self._sync_thread.join(5)
         for mgr in self._realtime_managers.values():
@@ -341,10 +348,20 @@ class ServerInstance:
                 self._inflight_cond.notify_all()
 
     def _submit_inner(self, req: dict) -> bytes:
+        from pinot_tpu.common import trace
+
         deadline = self._request_deadline(req)
+        # broker-stamped tracing (traceEnabled + traceId ride the
+        # instance request, retries/hedges included): the tracer exists
+        # BEFORE compile so the compile phase itself is a span. A direct
+        # submit that only carries SET trace=true in its SQL gets its
+        # tracer after compile (no compile span) in _handle_submit_launch.
+        tracer = trace.Tracer(req.get("traceId")) \
+            if req.get("traceEnabled") else None
         try:
             self.metrics.count("queries")
-            q = self._compile_admitted(req["sql"], deadline)
+            with trace.span("server.compile", tracer):
+                q = self._compile_admitted(req["sql"], deadline)
             if deadline is None:
                 # no broker-shipped budget: fall back to SET timeoutMs
                 # from the now-compiled options (embedded submits)
@@ -354,7 +371,8 @@ class ServerInstance:
             # into server.query and poison latency dashboards under load
             acct: dict = {}
             finish = self.scheduler.run(
-                lambda: self._handle_submit_launch(req, q, acct, deadline),
+                lambda: self._handle_submit_launch(req, q, acct, deadline,
+                                                   tracer),
                 queue_timeout_s=None if deadline is None
                 else max(0.001, deadline.remaining_s()),
                 group=self._scheduler_group(q, req),
@@ -385,12 +403,19 @@ class ServerInstance:
             return encode_error("query_error", f"{type(e).__name__}: {e}")
 
     def _handle_submit_launch(self, req: dict, q, acct: dict = None,
-                              deadline: Deadline = None):
+                              deadline: Deadline = None, tracer=None):
         """LAUNCH phase (runs under the scheduler slot) → zero-arg FETCH
         closure the transport thread invokes after the slot is released.
         Segment refs, the latency timer, and the tracer span BOTH phases;
         cleanup lives in the closure's finally (launch failures clean up
-        here and re-raise into the submit error path)."""
+        here and re-raise into the submit error path).
+
+        The tracer is EXPLICIT (common/trace.py): it was minted in
+        _submit_inner from the broker-stamped traceEnabled/traceId (or
+        here, for direct submits whose SQL says SET trace=true) and rides
+        by reference through the engine, the device launch handles, and
+        the fetch closure — the PR-2 launch/fetch thread split and
+        coalesced cohorts record onto the right query's trace."""
         import time as _time
 
         from pinot_tpu.common import trace
@@ -401,14 +426,17 @@ class ServerInstance:
         # before compile/admission
         timer = self.metrics.timed("query")
         timer.__enter__()
-        tracer = trace.start_trace() if q.options_ci().get("trace") else None
+        if tracer is None and q.options_ci().get("trace"):
+            tracer = trace.Tracer(req.get("traceId"))
+        if tracer is not None and acct:
+            # the scheduler published its admission wait before running
+            # this fn — back-fill it as the queue phase
+            tracer.add_ms("server.queue", acct.get("scheduler_wait_ms", 0.0))
         tdm, acquired = None, []
 
         def cleanup():
             if tdm is not None:
                 tdm.release(acquired)
-            if tracer is not None:
-                trace.end_trace()
             timer.__exit__()
 
         try:
@@ -443,7 +471,7 @@ class ServerInstance:
                 # transport level; cleanup() still runs via the
                 # BaseException path so the process itself stays sound
                 faults.inject("server.crash", target=self.instance_id)
-            with span("server.execute"):
+            with span("server.execute", tracer):
                 # the fetch-time host fallback (sorted-table overflow) is
                 # heavy CPU work on a slot-free thread: re-admit it
                 # through the scheduler so a fallback storm can't escape
@@ -455,7 +483,8 @@ class ServerInstance:
                     else max(0.001, deadline.remaining_s()),
                     group=self._scheduler_group(q, req)))
                 fetch_merged = self.engine.execute_segments_async(
-                    q, segments, fallback_gate=gate, deadline=deadline)
+                    q, segments, fallback_gate=gate, deadline=deadline,
+                    tracer=tracer)
         except BaseException:
             cleanup()
             raise
@@ -463,9 +492,9 @@ class ServerInstance:
         def finish() -> bytes:
             try:
                 # the blocking link wait lives here, OUTSIDE the slot
-                with span("server.fetch"):
+                with span("server.fetch", tracer):
                     merged = fetch_merged()
-                with span("server.trim"):
+                with span("server.trim", tracer):
                     merged = trim_group_by(q, merged, self.group_trim_size)
                 # per-query resource accounting shipped in the partial's
                 # stats (the reference's DataTable V3 threadCpuTimeNs
@@ -479,7 +508,11 @@ class ServerInstance:
                 self.queries_served += 1
                 if tracer is not None:
                     # encode itself can't appear in the trace: the spans
-                    # are serialized INTO the payload encode produces
+                    # are serialized INTO the payload encode produces.
+                    # server.total is the reconciliation denominator —
+                    # tracer birth (request entry) to now; the phase
+                    # ladder's top-level spans must cover >=90% of it
+                    tracer.add_ms("server.total", tracer.elapsed_ms())
                     merged.trace = tracer.to_json()
                 return encode(merged)
             finally:

@@ -1,0 +1,188 @@
+"""Query-log summarizer: ``python -m pinot_tpu.tools.querylog <log.jsonl>``.
+
+Reads the broker's structured JSONL query log (broker/querylog.py) and
+prints the operator's five-minute view: volume + error/timeout/partial
+counts, latency percentiles overall and per table/template, the
+per-phase p50 breakdown reconstructed from the attached traces (queue /
+compile / gather / kernel / link / reduce — the waterfall that tells
+kernel-ms from link-ms from queue-ms), and the top-N slowest queries.
+
+Options:
+    --top N        how many slow queries to list (default 5)
+    --per-template aggregate by literal-free template key too
+    --json         machine-readable output (one summary dict)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# phase buckets for the waterfall, matched on the span name's LAST dotted
+# segment (nesting depth varies: "gather" from an embedded engine,
+# "server.execute.gather" from a cluster server) — full-name buckets
+# first. Matching a raw suffix substring would misbucket e.g.
+# "broker.scatter_gather" as the gather phase.
+PHASE_FULL_NAMES = {
+    "server.queue": "queue",
+    "server.compile": "compile",
+    "server.trim": "reduce",
+    "broker.reduce": "reduce",
+}
+PHASE_LAST_SEGMENTS = {
+    "gather": "gather",
+    "kernel": "kernel",
+    "link": "link",
+    "host_scan": "host_scan",
+    "host_fallback": "host_fallback",
+    "merge": "reduce",
+}
+
+
+def _phase_bucket(name: str):
+    bucket = PHASE_FULL_NAMES.get(name)
+    if bucket is not None:
+        return bucket
+    return PHASE_LAST_SEGMENTS.get(name.rsplit(".", 1)[-1])
+
+
+def _percentile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return float(sorted_vals[idx])
+
+
+def phase_breakdown(entry: dict) -> dict:
+    """Per-phase ms for one log entry, summed across its servers."""
+    out: dict = {}
+    info = entry.get("traceInfo") or {}
+    for spans in info.values():
+        for s in spans or ():
+            bucket = _phase_bucket(s.get("phase", ""))
+            if bucket is not None:
+                out[bucket] = out.get(bucket, 0.0) + s["durationMs"]
+    return out
+
+
+def summarize(entries: list, top: int = 5,
+              per_template: bool = False) -> dict:
+    lats = sorted(e.get("timeUsedMs", 0.0) for e in entries)
+    summary = {
+        "queries": len(entries),
+        "errors": sum(1 for e in entries if e.get("exceptions")),
+        "partials": sum(1 for e in entries if e.get("partialResult")),
+        "timeouts": sum(
+            1 for e in entries
+            if any(x.get("errorCode") == 250
+                   for x in e.get("exceptions") or ())),
+        "latencyMs": {
+            "p50": round(_percentile(lats, 0.50), 2),
+            "p90": round(_percentile(lats, 0.90), 2),
+            "p99": round(_percentile(lats, 0.99), 2),
+        },
+    }
+    phases: dict = {}
+    for e in entries:
+        for k, v in phase_breakdown(e).items():
+            phases.setdefault(k, []).append(v)
+    summary["phaseP50Ms"] = {
+        k: round(_percentile(sorted(v), 0.5), 3)
+        for k, v in sorted(phases.items())
+    }
+    by_table: dict = {}
+    for e in entries:
+        by_table.setdefault(e.get("table") or "?", []).append(
+            e.get("timeUsedMs", 0.0))
+    summary["tables"] = {
+        t: {"queries": len(v),
+            "p50Ms": round(_percentile(sorted(v), 0.5), 2),
+            "p90Ms": round(_percentile(sorted(v), 0.9), 2)}
+        for t, v in sorted(by_table.items())
+    }
+    if per_template:
+        by_tpl: dict = {}
+        for e in entries:
+            by_tpl.setdefault(e.get("template") or "?", []).append(
+                e.get("timeUsedMs", 0.0))
+        summary["templates"] = {
+            t: {"queries": len(v),
+                "p50Ms": round(_percentile(sorted(v), 0.5), 2)}
+            for t, v in sorted(by_tpl.items())
+        }
+    slowest = sorted(entries, key=lambda e: e.get("timeUsedMs", 0.0),
+                     reverse=True)[:top]
+    summary["slowest"] = [
+        {"timeUsedMs": e.get("timeUsedMs"), "table": e.get("table"),
+         "requestId": e.get("requestId"), "traceId": e.get("traceId"),
+         "sql": (e.get("sql") or "")[:120],
+         "phases": {k: round(v, 2)
+                    for k, v in sorted(phase_breakdown(e).items())}}
+        for e in slowest
+    ]
+    return summary
+
+
+def load(path: str) -> list:
+    entries = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                continue  # torn tail line from rotation/crash
+    return entries
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.tools.querylog",
+        description="summarize a pinot-tpu broker query log (JSONL)")
+    ap.add_argument("path", help="query log file")
+    ap.add_argument("--top", type=int, default=5)
+    ap.add_argument("--per-template", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    try:
+        entries = load(args.path)
+    except OSError as e:
+        print(f"cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    if not entries:
+        print("no entries", file=sys.stderr)
+        return 1
+    summary = summarize(entries, top=args.top,
+                        per_template=args.per_template)
+    if args.as_json:
+        print(json.dumps(summary, indent=2))
+        return 0
+    lat = summary["latencyMs"]
+    print(f"{summary['queries']} logged queries | "
+          f"{summary['errors']} errors ({summary['timeouts']} timeouts), "
+          f"{summary['partials']} partial")
+    print(f"latency p50/p90/p99: {lat['p50']} / {lat['p90']} / "
+          f"{lat['p99']} ms")
+    if summary["phaseP50Ms"]:
+        print("phase p50s (ms): " + ", ".join(
+            f"{k}={v}" for k, v in summary["phaseP50Ms"].items()))
+    for t, row in summary["tables"].items():
+        print(f"  table {t}: n={row['queries']} p50={row['p50Ms']}ms "
+              f"p90={row['p90Ms']}ms")
+    if "templates" in summary:
+        for t, row in summary["templates"].items():
+            print(f"  template {t}: n={row['queries']} p50={row['p50Ms']}ms")
+    print(f"top {len(summary['slowest'])} slowest:")
+    for e in summary["slowest"]:
+        phases = " ".join(f"{k}={v}" for k, v in (e["phases"] or {}).items())
+        print(f"  {e['timeUsedMs']}ms [{e.get('table')}] "
+              f"req={e.get('requestId')} {e['sql']!r} {phases}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

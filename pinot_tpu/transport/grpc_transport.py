@@ -33,14 +33,21 @@ FETCH_SEGMENT_METHOD = "/pinot.PinotQueryServer/FetchSegment"
 def make_instance_request(sql: str, segments: list, request_id: int,
                           broker_id: str = "", trace: bool = False,
                           table: str = None, time_filter: dict = None,
-                          timeout_ms: float = None) -> bytes:
+                          timeout_ms: float = None, trace_id: str = None,
+                          attempt: str = "primary") -> bytes:
     """``table``: physical table override (hybrid split sends the same SQL to
     X_OFFLINE and X_REALTIME); ``time_filter``: {column, op le|gt, value}
     AND-ed server-side (the time-boundary predicate); ``timeout_ms``: the
     query's REMAINING deadline budget at send time — the server bounds
     every downstream wait by it and answers QUERY_TIMEOUT instead of
     executing work the broker already abandoned (the reference ships
-    timeoutMs in the InstanceRequest the same way)."""
+    timeoutMs in the InstanceRequest the same way).
+
+    ``trace``/``trace_id``/``attempt``: the distributed-tracing stamp
+    (the reference's InstanceRequest ``enableTrace`` + requestId): when
+    the query runs with SET trace=true the broker sets traceEnabled on
+    EVERY attempt — primary, retry, or hedge, ``attempt`` naming which —
+    so the per-server span ladders all join one trace id."""
     return json.dumps(
         {
             "sql": sql,
@@ -48,6 +55,8 @@ def make_instance_request(sql: str, segments: list, request_id: int,
             "requestId": request_id,
             "brokerId": broker_id,
             "traceEnabled": trace,
+            "traceId": trace_id,
+            "attempt": attempt,
             "table": table,
             "timeFilter": time_filter,
             "timeoutMs": timeout_ms,

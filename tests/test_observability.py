@@ -1,13 +1,17 @@
 """Metrics registry, request tracing, FS SPI, plugin loader.
 
-Reference analogs: AbstractMetrics + yammer reporters, Tracing.java /
-trace query option surfaced in BrokerResponse, PinotFS + LocalPinotFS,
-PluginManager + ServiceLoader-style registration.
+Reference analogs: AbstractMetrics + yammer reporters (histogram
+percentiles included), Tracing.java / trace query option surfaced in
+BrokerResponse (cross-process since ISSUE 7: trace id + per-server span
+ladders merged into per-instance traceInfo, retries/hedges tagged),
+PinotFS + LocalPinotFS, PluginManager + ServiceLoader-style
+registration, and the broker QueryLogger (structured JSONL query log).
 """
 
 import json
 import os
 import sys
+import threading
 import time
 import urllib.request
 
@@ -225,3 +229,541 @@ class TestClusterObservability:
         snap = m.snapshot()["counters"]
         assert snap.get("server.queryErrors", 0) == e0 + 1
         assert snap.get("server.queries", 0) == q0 + 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: histogram metrics
+# ---------------------------------------------------------------------------
+
+
+class TestHistogram:
+    def test_quantiles_vs_numpy_across_bucket_boundaries(self):
+        """Log-bucket interpolation must track exact percentiles within
+        one bucket width (~19% worst case; far tighter in practice)
+        across distributions that straddle many bucket boundaries."""
+        from pinot_tpu.common.metrics import Histogram
+
+        rng = np.random.default_rng(7)
+        for dist in (
+            rng.uniform(0.5, 200.0, 4000),          # flat across buckets
+            rng.lognormal(2.0, 1.5, 4000),          # heavy tail
+            np.arange(1, 301, dtype=np.float64),    # exact ladder
+            np.repeat([0.9, 1.1, 99.0, 101.0], 50), # boundary-straddling
+        ):
+            h = Histogram()
+            for v in dist:
+                h.update(float(v))
+            s = np.sort(dist)
+            for q in (0.5, 0.9, 0.99):
+                # nearest-rank oracle (the histogram's own definition —
+                # numpy's default interpolates ACROSS distribution gaps,
+                # which no bucketed histogram can reproduce)
+                exact = float(s[max(0, int(np.ceil(q * len(s))) - 1)])
+                est = h.quantile(q)
+                assert abs(est - exact) <= max(0.20 * exact, 1e-3), \
+                    (q, est, exact)
+
+    def test_quantiles_clamped_to_observed_range(self):
+        from pinot_tpu.common.metrics import Histogram
+
+        h = Histogram()
+        for v in (5.0, 5.0, 5.0):
+            h.update(v)
+        assert h.quantile(0.5) == 5.0
+        assert h.quantile(0.999) == 5.0
+        snap = h.snapshot()
+        assert snap["count"] == 3 and snap["p99Ms"] == 5.0
+
+    def test_registry_one_update_feeds_timer_and_histogram(self):
+        from pinot_tpu.common.metrics import MetricsRegistry
+
+        reg = MetricsRegistry("h")
+        for v in range(1, 101):
+            reg.time_ms("lat", float(v))
+        snap = reg.snapshot()
+        assert snap["timers"]["h.lat"]["count"] == 100
+        hist = snap["histograms"]["h.lat"]
+        assert hist["count"] == 100
+        assert 40 <= hist["p50Ms"] <= 60
+        assert 85 <= hist["p90Ms"] <= 100
+        # quantile() is the shared-read surface (hedge delay et al.)
+        assert reg.quantile("lat", 0.9) == pytest.approx(
+            hist["p90Ms"], abs=1e-3)  # snapshot rounds to 3 decimals
+        assert reg.quantile("nothing", 0.9) is None
+        # observe() is the histogram-forward alias of time_ms
+        reg.observe("lat2", 5.0)
+        assert reg.snapshot()["histograms"]["h.lat2"]["count"] == 1
+
+    def test_prometheus_histogram_exposition_parses(self):
+        """The exposition must hold up under prometheus_client's
+        text-format parser: histogram family with monotone cumulative
+        buckets, +Inf, _sum/_count consistency."""
+        from pinot_tpu.common.metrics import MetricsRegistry
+
+        prom_parser = pytest.importorskip("prometheus_client.parser")
+        reg = MetricsRegistry("p")
+        reg.count("queries")
+        reg.gauge("depth", 3)
+        for v in (0.5, 5.0, 50.0, 500.0, 5000.0):
+            reg.time_ms("query", v)
+        text = reg.prometheus_text()
+        fams = {f.name: f for f in
+                prom_parser.text_string_to_metric_families(text)}
+        assert fams["pinot_tpu_p_queries"].type == "counter"
+        hist = fams["pinot_tpu_p_query_ms"]
+        assert hist.type == "histogram"
+        buckets = [(s.labels["le"], s.value) for s in hist.samples
+                   if s.name.endswith("_bucket")]
+        assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 5
+        values = [v for _le, v in buckets]
+        assert values == sorted(values), "cumulative buckets must be monotone"
+        count = next(s.value for s in hist.samples
+                     if s.name.endswith("_count"))
+        total = next(s.value for s in hist.samples
+                     if s.name.endswith("_sum"))
+        assert count == 5 and total == pytest.approx(5555.5)
+
+
+class TestMetricsLifecycle:
+    def test_reset_clears_registry(self):
+        from pinot_tpu.common.metrics import MetricsRegistry
+
+        reg = MetricsRegistry("x")
+        reg.count("a")
+        reg.gauge("g", lambda: 1)
+        reg.time_ms("t", 1.0)
+        reg.reset()
+        snap = reg.snapshot()
+        assert not snap["counters"] and not snap["gauges"]
+        assert not snap["timers"] and not snap["histograms"]
+
+    def test_reset_metrics_by_component(self):
+        from pinot_tpu.common.metrics import get_metrics, reset_metrics
+
+        get_metrics("resettest").count("a")
+        reset_metrics("resettest")
+        assert not get_metrics("resettest").snapshot()["counters"]
+        get_metrics("resettest").count("a")
+        reset_metrics()  # all registries
+        assert not get_metrics("resettest").snapshot()["counters"]
+
+    def test_server_stop_unregisters_every_gauge(self, tmp_path):
+        """Leak guard (ISSUE 7 satellite): get_metrics registries are
+        process-global and survive ServerInstance.stop() — every
+        callable gauge the instance registered (segments, scheduler,
+        device HBM/quarantine family) must unregister on stop, or the
+        closure pins the dead instance and a restarted same-id server
+        double-reports."""
+        from pinot_tpu.cluster.registry import ClusterRegistry
+        from pinot_tpu.common.metrics import get_metrics
+        from pinot_tpu.server.server import ServerInstance
+
+        m = get_metrics("server")
+        for round_i in range(2):  # restart with the SAME instance id
+            server = ServerInstance(
+                "leakguard_0", ClusterRegistry(),
+                str(tmp_path / f"lg{round_i}"))
+            server.start()
+            keys = m.gauge_keys("leakguard_0")
+            assert "server.segmentsLoaded.leakguard_0" in keys
+            # the device gauge family (PR-5/PR-6) registers too
+            assert any("deviceResidentBytes" in k for k in keys)
+            assert any("deviceQuarantinedPipelines" in k for k in keys)
+            server.stop(drain_timeout_s=0.2)
+            assert m.gauge_keys("leakguard_0") == [], \
+                "stop() leaked callable gauges into the global registry"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: explicit tracer across the async launch/fetch split + cohorts
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_engine(tmp_path_factory):
+    """Small two-segment device-eligible table for tracer plumbing."""
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.creator import build_segment
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    base = tmp_path_factory.mktemp("traced")
+    schema = Schema.build(
+        name="t",
+        dimensions=[("tag", DataType.STRING)],
+        metrics=[("v", DataType.INT)],
+    )
+    cfg = TableConfig(table_name="t")
+    rng = np.random.default_rng(3)
+    segs = []
+    for i in range(2):
+        cols = {
+            "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, 20_000)],
+            "v": rng.integers(0, 100, 20_000).astype(np.int32),
+        }
+        d = str(base / f"s{i}")
+        build_segment(schema, cols, d, cfg, f"s{i}")
+        segs.append(ImmutableSegment(d))
+    eng = QueryEngine()
+    for s in segs:
+        eng.add_segment("t", s)
+    return eng, segs
+
+
+class TestTracerAcrossAsyncSplit:
+    def _compile(self, sql):
+        from pinot_tpu.query.optimizer import optimize_query
+        from pinot_tpu.sql.compiler import compile_query
+
+        return optimize_query(compile_query(sql))
+
+    def test_async_query_reports_launch_and_fetch_spans(self, traced_engine):
+        """Regression for the PR-2 thread-split span loss: the tracer is
+        carried EXPLICITLY through execute_segments_async and the device
+        handle, so a traced async query reports both launch-phase spans
+        (gather/dispatch) and fetch-phase spans (device_fetch, merge) —
+        even when fetch() runs on a different thread than launch."""
+        from pinot_tpu.common.trace import Tracer
+
+        eng, _segs = traced_engine
+        q = self._compile("SELECT tag, SUM(v) FROM t GROUP BY tag")
+        tracer = Tracer("test-trace-1")
+        tdm = eng.tables["t"]
+        segs = tdm.acquire()
+        try:
+            fetch = eng.execute_segments_async(q, segs, tracer=tracer)
+            result_box = []
+            th = threading.Thread(  # the deferred fetch on ANOTHER thread
+                target=lambda: result_box.append(fetch()))
+            th.start()
+            th.join(60)
+        finally:
+            tdm.release(segs)
+        assert result_box, "fetch thread died"
+        phases = {s["phase"] for s in tracer.to_json()}
+        assert "gather" in phases, phases          # launch: column gather
+        assert "dispatch" in phases, phases        # launch: XLA dispatch
+        assert "device_fetch" in phases, phases    # fetch: link wait
+        assert "merge" in phases, phases           # fetch: partial merge
+        # kernel/link split recorded under the fetch wait
+        assert any(p.endswith("kernel") for p in phases), phases
+        assert any(p.endswith("link") for p in phases), phases
+
+    def test_cohort_members_each_get_fetch_spans(self, traced_engine):
+        """Coalesced cohort launches: every MEMBER's tracer records its
+        own fetch-phase span (the shared kernel/link spans land on the
+        leader's trace) — previously cohort spans landed on whichever
+        thread's thread-local happened to be installed, or nowhere."""
+        from pinot_tpu.common.trace import Tracer
+
+        eng, _segs = traced_engine
+        dev = eng.device
+        co = dev.coalescer
+        co.force = True
+        co.window_s = 0.25
+        n = 3
+        tracers = [Tracer(f"cohort-{i}") for i in range(n)]
+        results = [None] * n
+        errors = []
+        barrier = threading.Barrier(n)
+        c0 = co.queries_coalesced
+        tdm = eng.tables["t"]
+
+        def worker(i):
+            q = self._compile(
+                f"SELECT tag, SUM(v) FROM t WHERE v < {90 + i} GROUP BY tag")
+            segs = tdm.acquire()
+            try:
+                barrier.wait(10)
+                fetch = eng.execute_segments_async(q, segs,
+                                                   tracer=tracers[i])
+                results[i] = fetch()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+            finally:
+                tdm.release(segs)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+        finally:
+            co.force = False
+            co.window_s = 0.003
+        assert not errors, errors
+        assert all(r is not None for r in results)
+        assert co.queries_coalesced > c0, "queries never coalesced"
+        for i, tr in enumerate(tracers):
+            phases = {s["phase"] for s in tr.to_json()}
+            assert "gather" in phases, (i, phases)
+            assert "device_fetch" in phases, (i, phases)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7: traceInfo merge under retry/hedge + structured query log
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def replicated_cluster(tmp_path):
+    """2 servers x replication 2 (every segment on both) — the retry and
+    hedge paths always have a covering replica."""
+    from pinot_tpu.common import faults
+
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "ds"))
+    servers = [
+        ServerInstance(f"rsrv_{i}", registry, str(tmp_path / f"r{i}"),
+                       device_executor=None)
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    schema = Schema.build(
+        name="rt",
+        dimensions=[("k", DataType.STRING)],
+        metrics=[("v", DataType.LONG)],
+    )
+    cfg = TableConfig(table_name="rt", replication=2)
+    controller.add_table(cfg, schema)
+    rng = np.random.default_rng(1)
+    for i in range(2):
+        d = str(tmp_path / f"up{i}")
+        build_segment(
+            schema,
+            {"k": np.array(["a", "b", "c"])[rng.integers(0, 3, 3000)],
+             "v": rng.integers(0, 50, 3000).astype(np.int64)},
+            d, cfg, f"rt_s{i}")
+        controller.upload_segment("rt", d)
+    ev_ok = wait_until(lambda: (
+        len(registry.external_view("rt_OFFLINE")) == 2
+        and all(len(v) == 2
+                for v in registry.external_view("rt_OFFLINE").values())))
+    assert ev_ok, "segments never fully replicated"
+    yield registry, servers
+    faults.clear()
+    for s in servers:
+        try:
+            s.stop(drain_timeout_s=0.2)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+TRACED_SQL = "SET trace = true; SELECT k, SUM(v) FROM rt GROUP BY k ORDER BY k"
+
+
+class TestTraceMergeRetryHedge:
+    def _server_keys(self, info):
+        return {k for k in info if k != "broker"}
+
+    def test_retry_attempt_traces_tagged_and_merged(self, replicated_cluster):
+        """A replica that hard-fails forces a retry; the retry attempt's
+        server spans must arrive in traceInfo TAGGED as a retry, with no
+        duplicate and no dropped span lists, and the recovered result
+        must be complete (no partialResult)."""
+        from pinot_tpu.common import faults
+
+        registry, _servers = replicated_cluster
+        reference = None
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            reference = broker.execute(
+                "SELECT k, SUM(v) FROM rt GROUP BY k ORDER BY k")
+            assert not reference.get("exceptions")
+        finally:
+            broker.close()
+
+        faults.install(faults.Fault(
+            point="transport.submit", target="rsrv_0", mode="error"))
+        broker = Broker(registry, timeout_s=10.0)
+        try:
+            saw_retry = False
+            for _ in range(3):  # round-robin: one of these routes rsrv_0
+                r = broker.execute(TRACED_SQL)
+                assert not r.get("exceptions"), r
+                assert not r.get("partialResult")
+                assert r["resultTable"]["rows"] == \
+                    reference["resultTable"]["rows"]
+                info = r["traceInfo"]
+                keys = self._server_keys(info)
+                assert keys, "no server spans at all"
+                for k in keys:
+                    spans = info[k]
+                    assert spans, f"empty span list under {k!r}"
+                    # merged-by-extend, not overwritten: exactly one
+                    # server.total per answering attempt part
+                    totals = [s for s in spans
+                              if s["phase"] == "server.total"]
+                    assert len(totals) >= 1
+                    assert all(s["durationMs"] >= 0 for s in spans)
+                if any("(retry)" in k for k in keys):
+                    saw_retry = True
+                    assert r.get("numRetries", 0) >= 1
+                    # the failed primary contributed NO span list of its
+                    # own (its RPC died before the server ran)
+                    assert not any(k.startswith("rsrv_0")
+                                   and "(retry)" not in k for k in keys)
+            assert saw_retry, "no query exercised the retry path"
+        finally:
+            faults.clear()
+            broker.close()
+
+    def test_hedge_attempt_traces_tagged(self, replicated_cluster):
+        """A slow replica triggers a hedge; the winning hedge attempt's
+        spans arrive tagged '(hedge)' and the response counts it."""
+        from pinot_tpu.common import faults
+
+        registry, _servers = replicated_cluster
+        faults.install(faults.Fault(
+            point="transport.submit", target="rsrv_0", mode="delay",
+            delay_ms=400))
+        broker = Broker(registry, timeout_s=10.0)
+        broker.hedging_enabled = True
+        broker.hedge_delay_s = 0.02
+        try:
+            saw_hedge = False
+            for _ in range(3):
+                r = broker.execute(TRACED_SQL)
+                assert not r.get("exceptions"), r
+                keys = self._server_keys(r["traceInfo"])
+                if any("(hedge)" in k for k in keys):
+                    saw_hedge = True
+                    assert r.get("numHedges", 0) >= 1
+            assert saw_hedge, "no query exercised the hedge path"
+        finally:
+            faults.clear()
+            broker.close()
+
+    def test_hedge_delay_driven_by_shared_histogram(self):
+        """The acceptance wire: LatencyTracker.p90_s reads the SHARED
+        metrics histogram — a recorded latency profile shows up both in
+        the hedge delay and in the registry's histogram snapshot."""
+        from pinot_tpu.broker.broker import LatencyTracker
+
+        reg = MetricsRegistry("hb")
+        lt = LatencyTracker(default_s=0.07, registry=reg)
+        assert lt.p90_s("sX") == 0.07  # no samples: default
+        for v in range(100):
+            lt.record("sX", v / 1000.0)  # 0..99 ms
+        p90 = lt.p90_s("sX")
+        assert 0.075 <= p90 <= 0.11, p90
+        hist = reg.snapshot()["histograms"]["hb.serverLatencyMs.sX"]
+        assert hist["count"] == 100
+        assert abs(hist["p90Ms"] / 1e3 - p90) < 1e-6
+
+
+class TestQueryLog:
+    def _resp(self, used_ms, exceptions=(), partial=False):
+        return {"timeUsedMs": used_ms, "exceptions": list(exceptions),
+                "partialResult": partial, "requestId": 1}
+
+    def test_policy_always_on_for_abnormal(self, tmp_path):
+        from pinot_tpu.broker.querylog import QueryLogger
+
+        ql = QueryLogger(slow_threshold_ms=500.0, sample_rate=0.0)
+        # fast + healthy: dropped
+        assert ql.record("SELECT 1", self._resp(3.0), 3.0) is None
+        # slow: kept
+        assert ql.record("SELECT 2", self._resp(900.0), 900.0) is not None
+        # fast but errored: kept
+        assert ql.record(
+            "SELECT 3",
+            self._resp(3.0, [{"errorCode": 250, "message": "t"}]),
+            3.0) is not None
+        # fast but partial: kept
+        assert ql.record(
+            "SELECT 4", self._resp(3.0, partial=True), 3.0) is not None
+        entries = ql.recent()
+        assert len(entries) == 3
+        assert entries[0]["sql"] == "SELECT 4"  # newest first
+
+    def test_jsonl_write_and_rotation(self, tmp_path):
+        from pinot_tpu.broker.querylog import QueryLogger
+
+        path = str(tmp_path / "q.jsonl")
+        ql = QueryLogger(path=path, slow_threshold_ms=0.0, max_bytes=2000)
+        for i in range(40):
+            ql.record(f"SELECT {i}", self._resp(10.0 + i), 10.0 + i)
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1"), "rotation never triggered"
+        assert os.path.getsize(path) <= 2000 + 1024
+        with open(path) as f:
+            lines = [json.loads(line) for line in f if line.strip()]
+        assert lines and all("timeUsedMs" in e for e in lines)
+
+    def test_broker_logs_slow_query_with_trace_and_template(
+            self, replicated_cluster, tmp_path):
+        from pinot_tpu.broker.querylog import QueryLogger
+
+        registry, _servers = replicated_cluster
+        broker = Broker(registry, timeout_s=10.0)
+        path = str(tmp_path / "bq.jsonl")
+        # threshold 0: every query is "slow" — deterministic capture
+        broker.querylog = QueryLogger(path=path, slow_threshold_ms=0.0)
+        try:
+            r = broker.execute(TRACED_SQL)
+            assert not r.get("exceptions"), r
+            entries = broker.querylog.recent()
+            assert entries
+            e = entries[0]
+            assert e["table"] == "rt"
+            assert e["traceId"] == r["traceId"]
+            assert e["template"].startswith("rt|group_by|sum|k")
+            assert "traceInfo" in e
+            assert e["counters"]["numServersQueried"] >= 1
+            # error queries log too, with their exception in place
+            broker.execute("SELECT nope(v) FROM rt")
+            bad = broker.querylog.recent()[0]
+            assert bad["exceptions"]
+        finally:
+            broker.close()
+
+    def test_debug_queries_endpoint(self, replicated_cluster, tmp_path):
+        from pinot_tpu.broker.querylog import QueryLogger
+
+        registry, _servers = replicated_cluster
+        broker = Broker(registry, timeout_s=10.0)
+        broker.querylog = QueryLogger(slow_threshold_ms=0.0, ring_size=8)
+        http = BrokerHttpServer(broker)
+        http.start()
+        try:
+            for _ in range(3):
+                broker.execute("SELECT COUNT(*) FROM rt")
+            with urllib.request.urlopen(
+                    http.url + "/debug/queries?limit=2", timeout=5) as resp:
+                doc = json.loads(resp.read())
+            assert len(doc["queries"]) == 2
+            assert all("timeUsedMs" in e for e in doc["queries"])
+        finally:
+            http.stop()
+            broker.close()
+
+    def test_summarizer_tool(self, tmp_path, capsys):
+        from pinot_tpu.broker.querylog import QueryLogger
+        from pinot_tpu.tools import querylog as qtool
+
+        path = str(tmp_path / "sum.jsonl")
+        ql = QueryLogger(path=path, slow_threshold_ms=0.0)
+        for i in range(10):
+            resp = self._resp(10.0 * (i + 1))
+            resp["traceInfo"] = {"s0": [
+                {"phase": "server.queue", "startMs": 0, "durationMs": 0.1},
+                {"phase": "server.fetch.kernel", "startMs": 1,
+                 "durationMs": 5.0},
+                {"phase": "server.fetch.link", "startMs": 6,
+                 "durationMs": 2.0},
+            ]}
+            ql.record(f"SELECT {i} FROM t", resp, 10.0 * (i + 1), table="t")
+        rc = qtool.main([path, "--top", "2", "--json"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["queries"] == 10
+        assert out["latencyMs"]["p50"] > 0
+        assert out["phaseP50Ms"]["kernel"] == 5.0
+        assert out["phaseP50Ms"]["link"] == 2.0
+        assert len(out["slowest"]) == 2
