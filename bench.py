@@ -1151,6 +1151,181 @@ _MICRO_R05_REFERENCE = {
 }
 
 
+def bench_join(n_fact: int = 300_000, iters: int = 5):
+    """detail.join: the multi-stage engine phase (ISSUE 8). An SSB-style
+    star — fact table joined against two dimension tables — versus the
+    PRE-DENORMALIZED equivalent single table (the only shape the
+    single-stage engine could express), with parity asserted between the
+    two on every query and across BROADCAST / SHUFFLE strategies and
+    device / host backends.
+
+    Returns (detail, violations); violations non-empty fails the gate
+    (standalone: ``python -m bench --phase join`` exits 6). Reports the
+    star-join p50 per strategy (the strategy breakdown) next to the
+    denormalized single-stage p50 the join replaces."""
+    import shutil
+    import tempfile
+
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.creator import build_segment
+
+    rng = np.random.default_rng(31)
+    n_parts, n_custs = 2000, 500
+    part_cat = np.array([f"cat_{i % 25}" for i in range(n_parts)])
+    cust_region = np.array([f"region_{i % 5}" for i in range(n_custs)])
+    fact_part = rng.integers(0, n_parts, n_fact).astype(np.int64)
+    fact_cust = rng.integers(0, n_custs, n_fact).astype(np.int64)
+    fact = {
+        "partkey": fact_part,
+        "custkey": fact_cust,
+        "revenue": rng.integers(1, 10_000, n_fact).astype(np.int64),
+        "quantity": rng.integers(1, 50, n_fact).astype(np.int32),
+    }
+    denorm = {
+        "category": part_cat[fact_part],
+        "region": cust_region[fact_cust],
+        "revenue": fact["revenue"],
+        "quantity": fact["quantity"],
+    }
+
+    fact_schema = Schema.build(
+        name="lineorder_j",
+        dimensions=[("partkey", DataType.LONG), ("custkey", DataType.LONG)],
+        metrics=[("revenue", DataType.LONG), ("quantity", DataType.INT)])
+    part_schema = Schema.build(
+        name="part_j",
+        dimensions=[("pkey", DataType.LONG), ("category", DataType.STRING)],
+        primary_key_columns=["pkey"])
+    cust_schema = Schema.build(
+        name="cust_j",
+        dimensions=[("ckey", DataType.LONG), ("region", DataType.STRING)],
+        primary_key_columns=["ckey"])
+    denorm_schema = Schema.build(
+        name="denorm_j",
+        dimensions=[("category", DataType.STRING),
+                    ("region", DataType.STRING)],
+        metrics=[("revenue", DataType.LONG), ("quantity", DataType.INT)])
+
+    base = tempfile.mkdtemp(prefix="bench_join_")
+    detail: dict = {}
+    violations: list = []
+    try:
+        engines = {}
+        for name, dev in (("device", "auto"), ("host", None)):
+            eng = QueryEngine() if dev else QueryEngine(device_executor=None)
+            half = n_fact // 2
+            for i, sl in enumerate([slice(0, half), slice(half, n_fact)]):
+                eng.add_segment("lineorder_j", build_segment(
+                    fact_schema, {k: v[sl] for k, v in fact.items()},
+                    os.path.join(base, f"f{name}{i}"),
+                    TableConfig(table_name="lineorder_j"), f"f{i}"))
+                eng.add_segment("denorm_j", build_segment(
+                    denorm_schema, {k: v[sl] for k, v in denorm.items()},
+                    os.path.join(base, f"d{name}{i}"),
+                    TableConfig(table_name="denorm_j"), f"d{i}"))
+            eng.add_segment("part_j", build_segment(
+                part_schema,
+                {"pkey": np.arange(n_parts, dtype=np.int64),
+                 "category": part_cat},
+                os.path.join(base, f"p{name}"),
+                TableConfig(table_name="part_j", is_dim_table=True), "p0"))
+            eng.add_segment("cust_j", build_segment(
+                cust_schema,
+                {"ckey": np.arange(n_custs, dtype=np.int64),
+                 "region": cust_region},
+                os.path.join(base, f"c{name}"),
+                TableConfig(table_name="cust_j", is_dim_table=True), "c0"))
+            eng.table("part_j").is_dim_table = True
+            eng.table("cust_j").is_dim_table = True
+            engines[name] = eng
+
+        star_1dim = (
+            "SELECT p.category, SUM(o.revenue) FROM lineorder_j o "
+            "JOIN part_j p ON o.partkey = p.pkey "
+            "GROUP BY p.category ORDER BY p.category LIMIT 30")
+        denorm_1dim = (
+            "SELECT category, SUM(revenue) FROM denorm_j "
+            "GROUP BY category ORDER BY category LIMIT 30")
+        star_2dim = (
+            "SELECT p.category, c.region, SUM(o.revenue), "
+            "COUNT(*) FROM lineorder_j o "
+            "JOIN part_j p ON o.partkey = p.pkey "
+            "JOIN cust_j c ON o.custkey = c.ckey "
+            "GROUP BY p.category, c.region "
+            "ORDER BY p.category, c.region LIMIT 150")
+        denorm_2dim = (
+            "SELECT category, region, SUM(revenue), COUNT(*) "
+            "FROM denorm_j GROUP BY category, region "
+            "ORDER BY category, region LIMIT 150")
+
+        def rows_of(resp):
+            if resp.get("exceptions"):
+                raise RuntimeError(f"join phase query failed: "
+                                   f"{resp['exceptions'][0]}")
+            return resp["resultTable"]["rows"]
+
+        def p50_of(eng, sql):
+            lat = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                rows_of(eng.execute(sql))
+                lat.append((time.perf_counter() - t0) * 1e3)
+            return float(np.percentile(lat, 50))
+
+        dev = engines["device"]
+        # parity: star join == pre-denormalized, every strategy + backend
+        denorm_ref = {"1dim": rows_of(dev.execute(denorm_1dim)),
+                      "2dim": rows_of(dev.execute(denorm_2dim))}
+        for name, eng in engines.items():
+            for strat in ("broadcast", "shuffle"):
+                for tag, star_sql in (("1dim", star_1dim),
+                                      ("2dim", star_2dim)):
+                    got = rows_of(eng.execute(
+                        f"SET joinStrategy='{strat}'; {star_sql}"))
+                    if got != denorm_ref[tag]:
+                        violations.append({
+                            "check": f"star-vs-denorm parity "
+                                     f"({name}/{strat}/{tag})",
+                            "got": got[:3], "expected": denorm_ref[tag][:3],
+                        })
+        # device == host on a LEFT join (no denorm equivalent for misses)
+        left_sql = (
+            "SELECT p.category, COUNT(*) FROM lineorder_j o "
+            "LEFT JOIN part_j p ON o.partkey = p.pkey "
+            "GROUP BY p.category ORDER BY p.category LIMIT 30")
+        if rows_of(dev.execute(left_sql)) != \
+                rows_of(engines["host"].execute(left_sql)):
+            violations.append({"check": "left-join device==host parity"})
+
+        strategy_p50 = {}
+        for strat in ("broadcast", "shuffle"):
+            strategy_p50[strat.upper()] = {
+                "star_1dim_p50_ms": round(p50_of(
+                    dev, f"SET joinStrategy='{strat}'; {star_1dim}"), 2),
+                "star_2dim_p50_ms": round(p50_of(
+                    dev, f"SET joinStrategy='{strat}'; {star_2dim}"), 2),
+            }
+        join_p50 = min(s["star_2dim_p50_ms"] for s in strategy_p50.values())
+        detail = {
+            "n_fact_rows": n_fact,
+            "n_dim_rows": {"part_j": n_parts, "cust_j": n_custs},
+            "join_p50_ms": join_p50,
+            "strategy_breakdown": strategy_p50,
+            "denorm_p50_ms": {
+                "1dim": round(p50_of(dev, denorm_1dim), 2),
+                "2dim": round(p50_of(dev, denorm_2dim), 2),
+            },
+            "parity": "asserted (star==denorm, broadcast+shuffle, "
+                      "device+host; left-join device==host)",
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return detail, violations
+
+
 def bench_faults(n_queries: int = 40):
     """detail.faults: the failure-domain phase (ISSUE 6). A 3-server /
     replication-3 cluster over real gRPC serves a group-by while the
@@ -1618,11 +1793,20 @@ def main():
 
     ap = argparse.ArgumentParser(description="pinot-tpu bench")
     ap.add_argument(
-        "--phase", choices=("full", "faults", "observability"),
+        "--phase", choices=("full", "faults", "observability", "join"),
         default="full",
-        help="'faults' / 'observability' run ONLY that phase (no dataset "
-             "build) so CI can gate on each standalone")
+        help="'faults' / 'observability' / 'join' run ONLY that phase "
+             "(no dataset build) so CI can gate on each standalone")
     args = ap.parse_args()
+    if args.phase == "join":
+        detail, violations = bench_join()
+        print(json.dumps({"metric": "join-phase standalone",
+                          "detail": {"join": detail}}))
+        if violations:
+            print(f"join gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(6)
+        return
     if args.phase == "faults":
         detail, violations = bench_faults()
         print(json.dumps({"metric": "faults-phase standalone",
@@ -1688,6 +1872,7 @@ def main():
     chunklet_detail = bench_chunklet()
     faults_detail, faults_violations = bench_faults()
     observability_detail, observability_violations = bench_observability()
+    join_detail, join_violations = bench_join()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -1745,6 +1930,7 @@ def main():
                     "chunklet": chunklet_detail,
                     "faults": faults_detail,
                     "observability": observability_detail,
+                    "join": join_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -1810,6 +1996,10 @@ def main():
         print(f"observability gate FAILED: "
               f"{json.dumps(observability_violations)}", file=sys.stderr)
         sys.exit(5)
+    if join_violations:
+        print(f"join gate FAILED: {json.dumps(join_violations)}",
+              file=sys.stderr)
+        sys.exit(6)
 
 
 if __name__ == "__main__":

@@ -28,7 +28,6 @@ from pinot_tpu.engine.reduce import finalize, merge_intermediates
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
 from pinot_tpu.query.context import QueryContext
 from pinot_tpu.query.optimizer import optimize_query
-from pinot_tpu.sql.compiler import compile_query
 from pinot_tpu.transport.grpc_transport import QueryRouterChannel, make_instance_request
 
 log = logging.getLogger("pinot_tpu.broker")
@@ -427,7 +426,16 @@ class Broker:
         tracer = None
         q = None
         try:
-            q = optimize_query(compile_query(sql))
+            from pinot_tpu.sql.compiler import compile_select, is_multistage
+            from pinot_tpu.sql.parser import parse_sql
+
+            stmt = parse_sql(sql)
+            if is_multistage(stmt):
+                # join / window query: two-stage execution — stage-1 leaf
+                # scans ride the ordinary scatter-gather below (recursive
+                # single-stage queries), stage 2 runs broker-local
+                return self._execute_multistage(stmt, sql, t0)
+            q = optimize_query(compile_select(stmt))
             q = self._resolve_table_case(q)
             if q.explain:
                 from pinot_tpu.engine.explain import explain_plan
@@ -469,6 +477,191 @@ class Broker:
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
         self.metrics.time_ms("query", resp["timeUsedMs"])
         return self._log_query(sql, q, resp, t0)
+
+    def _execute_multistage(self, stmt, sql: str, t0: float) -> dict:
+        """Two-stage (join / window) execution at the broker. Stage-1 leaf
+        scans are plain single-stage SELECT queries issued through
+        ``self.execute`` — so routing, replica retry, hedging, the failure
+        detector and per-table quotas all apply to them unchanged — and
+        the join + window + stage-2 reduce run broker-local through the
+        SAME query2 runner the embedded engine uses. The build side must
+        be a broker-routable table (dimension tables replicated across
+        servers: the star-schema shape this engine targets)."""
+        import numpy as np
+
+        from pinot_tpu.query2.logical import _sql_ident, compile_plan, to_sql
+        from pinot_tpu.query2.runner import (
+            MAX_STAGE1_ROWS,
+            needed_columns,
+            run_plan,
+        )
+
+        def _table_keys(table: str):
+            """Exact registry keys first, then the same case-insensitive
+            fold _resolve_table_case applies to single-stage queries."""
+            keys = [table, f"{table}_OFFLINE", f"{table}_REALTIME"]
+            names = set(self.registry.tables())
+            if not (set(keys) & names):
+                low = table.lower()
+                for n in names:
+                    if n.lower() in (low, f"{low}_offline",
+                                     f"{low}_realtime"):
+                        keys.append(n)
+            return keys
+
+        def _schema_for(table: str):
+            for key in _table_keys(table):
+                schema = self.registry.table_schema(key)
+                if schema is not None:
+                    return schema
+            return None
+
+        def catalog(table: str):
+            schema = _schema_for(table)
+            if schema is None:
+                raise KeyError(table)
+            cfg = None
+            for key in _table_keys(table):
+                cfg = self.registry.table_config(key)
+                if cfg is not None:
+                    break
+            is_dim = bool(cfg is not None
+                          and getattr(cfg, "is_dim_table", False))
+            return tuple(schema.column_names()), is_dim
+
+        plan = compile_plan(stmt, catalog)
+        if plan.explain:
+            from pinot_tpu.engine.explain import explain_multistage
+
+            return explain_multistage(None, plan)
+
+        # the user's SET options (trace, numGroupsLimit, ...) ride every
+        # leaf scan — the scatter-gather below is where the PR-6 deadline
+        # and tracing contracts live. joinStrategy is stage-2-only, and
+        # timeoutMs is rewritten per leaf to the REMAINING budget (leaves
+        # run sequentially; each full-budget leaf would let a 2-join query
+        # take 3x its deadline). Quota is debited by each leaf's own
+        # execute (once per referenced table); a second probe-table
+        # acquire here would double-charge joins. Note each leaf ALSO
+        # counts as its own broker query in metrics and may log its own
+        # querylog entry — deliberate: leaves are first-class queries and
+        # hiding them would understate broker load.
+        base_opts = []
+        budget_ms = None
+        for k, v in plan.stage2.options:
+            kl = str(k).lower()
+            if kl == "joinstrategy":
+                continue
+            if kl == "timeoutms":
+                budget_ms = float(v)
+                continue
+            base_opts.append((str(k), v))
+
+        def _set_prefix():
+            opts = list(base_opts)
+            if budget_ms is not None:
+                remaining = budget_ms - (time.time() - t0) * 1000
+                if remaining <= 0:
+                    return None  # expired
+                opts.append(("timeoutMs", int(max(1, remaining))))
+            prefix = ""
+            for k, v in opts:
+                if isinstance(v, bool):
+                    lit = "TRUE" if v else "FALSE"
+                elif isinstance(v, str):
+                    lit = "'" + v.replace("'", "''") + "'"
+                else:
+                    lit = str(v)
+                prefix += f"SET {_sql_ident(k)} = {lit}; "
+            return prefix
+
+        def _timeout_resp():
+            self.metrics.count("queryTimeouts")
+            return self._log_query(sql, plan, {"exceptions": [{
+                "errorCode": 250,
+                "message": f"query timeout: multi-stage budget "
+                           f"({budget_ms:.0f} ms) exhausted"}]}, t0)
+
+        counters = {"numDocsScanned": 0, "numSegmentsQueried": 0,
+                    "numServersQueried": 0, "numServersResponded": 0,
+                    "numRetries": 0, "numHedges": 0, "totalDocs": 0}
+        trace_info: dict = {}
+        table_rows = {}
+        need = needed_columns(plan)
+        for src in plan.sources:
+            cols = need[src.alias]
+            push = plan.pushdown.get(src.alias)
+            set_prefix = _set_prefix()
+            if set_prefix is None:
+                return _timeout_resp()
+            leaf = (f"{set_prefix}SELECT "
+                    f"{', '.join(_sql_ident(c) for c in cols)} "
+                    f"FROM {_sql_ident(src.table)}")
+            if push is not None:
+                leaf += f" WHERE {to_sql(push)}"
+            # cap + 1 so an exact-cap row set is distinguishable from a
+            # truncated one (the embedded path's strict > check)
+            leaf += f" LIMIT {MAX_STAGE1_ROWS + 1}"
+            r = self.execute(leaf)
+            if r.get("traceInfo"):
+                trace_info[f"leaf:{src.alias}"] = r["traceInfo"]
+            if r.get("exceptions"):
+                # surface the leaf's typed error verbatim (429 keeps its
+                # retryAfterSeconds pacing hint, 250 stays a timeout)
+                # with the stage-1 context prepended
+                excs = [dict(e) for e in r["exceptions"]]
+                for e in excs:
+                    e["message"] = (f"stage-1 scan of table "
+                                    f"{src.table!r}: "
+                                    f"{e.get('message', 'unknown')}")
+                resp = {"exceptions": excs}
+                if r.get("retryAfterSeconds") is not None:
+                    resp["retryAfterSeconds"] = r["retryAfterSeconds"]
+                if r.get("partialResult"):
+                    resp["partialResult"] = True
+                return self._log_query(sql, plan, resp, t0)
+            for k in counters:
+                counters[k] += int(r.get(k) or 0)
+            rows = r["resultTable"]["rows"]
+            if len(rows) > MAX_STAGE1_ROWS:
+                raise RuntimeError(
+                    f"stage-1 row set for table {src.table!r} hit the "
+                    f"{MAX_STAGE1_ROWS}-row cap; add a more selective "
+                    f"filter")
+            arrays: dict = {}
+            if rows:
+                for c, vals in zip(cols, zip(*rows)):
+                    arrays[c] = np.asarray(vals)
+            else:
+                schema = _schema_for(src.table)
+                for c in cols:
+                    spec = getattr(schema, "fields", {}).get(c)
+                    dt = spec.data_type.np_dtype if spec is not None \
+                        else np.float64
+                    arrays[c] = np.empty(0, dtype=dt)
+            table_rows[src.alias] = arrays
+
+        if budget_ms is not None and \
+                (time.time() - t0) * 1000 >= budget_ms:
+            # leaves consumed the whole budget: a late broker-local join
+            # would return a success AFTER the client's deadline
+            return _timeout_resp()
+        result, meta = run_plan(plan, table_rows, device=None)
+        resp = result.to_json()
+        resp.update(counters)
+        resp.update({
+            "exceptions": [],
+            "requestId": f"{self.broker_id}_{next(self._request_id)}",
+            "numStages": meta["numStages"],
+            "numJoinedRows": meta["numJoinedRows"],
+            "timeUsedMs": round((time.time() - t0) * 1000, 3),
+        })
+        if trace_info:
+            resp["traceInfo"] = trace_info
+        if meta["joinStrategy"]:
+            resp["joinStrategy"] = meta["joinStrategy"]
+        self.metrics.time_ms("query", resp["timeUsedMs"])
+        return self._log_query(sql, plan, resp, t0)
 
     def _log_query(self, sql: str, q, resp: dict, t0: float) -> dict:
         """Feed the structured query log on EVERY terminal broker path

@@ -193,13 +193,18 @@ class BrokerHttpServer:
         if self._access is None or not self._access.restricts_tables:
             return None  # pure-auth setup: skip the extra SQL compile
         try:
-            from pinot_tpu.sql.compiler import compile_query
+            from pinot_tpu.sql.parser import parse_sql
 
-            table = compile_query(sql).table_name
+            stmt = parse_sql(sql)
+            # a multi-stage (join) query touches EVERY referenced table —
+            # each one must pass the principal's ACL, or a restricted
+            # principal could read a denied table through a join
+            tables = [stmt.table] + [j.table for j in stmt.joins]
         except Exception:  # noqa: BLE001 — broker reports the parse error
             return None
-        if table and not self._access.allows(principal, table):
-            return table
+        for table in tables:
+            if table and not self._access.allows(principal, table):
+                return table
         return None
 
     def start(self) -> None:

@@ -43,8 +43,28 @@ def template_key(q) -> str:
     under changing filter literals (PR 4): table + result shape + agg
     names + group-by columns + filter STRUCTURE (ops and columns, no
     values). Two dashboard queries differing only in literals share a
-    key, so the summarizer can aggregate latency per template."""
+    key, so the summarizer can aggregate latency per template.
+
+    Multi-stage plans (query2/ joins + windows) key on the stage-2 shape
+    PLUS the join chain (kind, strategy, build alias, key columns — no
+    literals) and the window function/partition signature, so two-stage
+    dashboard queries group per template exactly like single-stage ones."""
     try:
+        if hasattr(q, "stage2") and hasattr(q, "joins"):
+            inner = template_key(q.stage2)
+            joins = ";".join(
+                f"{j.kind}:{q.strategy}:{j.build.alias}"
+                f"({','.join(str(k) for k in j.left_keys)})"
+                for j in q.joins)
+            wins = ",".join(
+                f"{w.fn}[{','.join(str(p) for p in w.partition_by)}]"
+                for w in q.windows)
+            parts = [inner]
+            if joins:
+                parts.append(f"joins[{joins}]")
+            if wins:
+                parts.append(f"windows[{wins}]")
+            return "|".join(parts)
         aggs = ",".join(a.name for a in q.aggregations())
         group = ",".join(g.name if g.is_identifier else "expr"
                          for g in (q.group_by or ()))

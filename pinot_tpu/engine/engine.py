@@ -29,7 +29,7 @@ from pinot_tpu.query.context import (
     QueryContext,
 )
 from pinot_tpu.query.optimizer import optimize_query
-from pinot_tpu.sql.compiler import compile_query
+from pinot_tpu.sql.compiler import compile_select
 from pinot_tpu.storage.segment import ImmutableSegment
 
 log = logging.getLogger("pinot_tpu.engine")
@@ -184,10 +184,20 @@ class QueryEngine:
 
     # ---- query -----------------------------------------------------------
     def execute(self, sql: str) -> dict:
-        """Full path: SQL string → broker-response dict."""
+        """Full path: SQL string → broker-response dict. Join / window
+        queries route to the multi-stage engine (query2/); plain
+        single-table queries take the single-stage path untouched."""
         t0 = time.time()
         try:
-            q = optimize_query(compile_query(sql))
+            from pinot_tpu.sql.compiler import is_multistage
+            from pinot_tpu.sql.parser import parse_sql
+
+            stmt = parse_sql(sql)
+            if is_multistage(stmt):
+                from pinot_tpu.query2.runner import execute_multistage
+
+                return execute_multistage(self, stmt, t0)
+            q = optimize_query(compile_select(stmt))
             if q.explain:
                 return self._explain(q)
             result, stats = self.execute_query(q)

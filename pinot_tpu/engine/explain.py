@@ -62,6 +62,89 @@ def _filter_lines(f: FilterNode, depth: int, out: list, seg=None) -> None:
         _filter_lines(c, depth + 1, out, seg)
 
 
+def _rows_response(lines: list) -> dict:
+    rows = [[ln, i, i - 1] for i, ln in enumerate(lines)]
+    return {
+        "resultTable": {
+            "dataSchema": {
+                "columnNames": ["Operator", "Operator_Id", "Parent_Id"],
+                "columnDataTypes": ["STRING", "INT", "INT"],
+            },
+            "rows": rows,
+        },
+        "exceptions": [],
+    }
+
+
+def explain_multistage(engine, plan) -> dict:
+    """EXPLAIN for a two-stage (join / window) plan: the stage boundary,
+    the join strategy with build/probe sides, window spec lines, and the
+    per-table stage-1 scans with their pushed-down filters."""
+    from pinot_tpu.query2.logical import to_sql
+    from pinot_tpu.sql.compiler import _to_filter
+
+    q = plan.stage2
+    aggs = q.aggregations()
+    if q.distinct:
+        shape = "DISTINCT"
+    elif aggs and q.group_by:
+        shape = "AGGREGATE_GROUPBY_ORDERBY"
+    elif aggs:
+        shape = "AGGREGATE"
+    elif plan.windows:
+        shape = "SELECT_WINDOW"
+    else:
+        shape = "SELECT_ORDERBY" if q.order_by else "SELECT"
+
+    device = getattr(engine, "device", None) if engine is not None else None
+    backend = "DEVICE(jax/xla)" if device is not None else "HOST(numpy)"
+    mesh = getattr(device, "mesh", None) if device is not None else None
+
+    lines: list[str] = []
+    lines.append(f"BROKER_REDUCE(limit:{q.limit})")
+    lines.append(f"  STAGE_2_{shape}"
+                 f"({', '.join(str(e) for e in q.select_expressions)})"
+                 f" [{backend}]")
+    if q.group_by:
+        lines.append(
+            f"    GROUP_BY({', '.join(str(g) for g in q.group_by)})")
+    if q.having is not None:
+        lines.append(f"    HAVING({q.having})")
+    for w in plan.windows:
+        lines.append(f"    WINDOW({w.describe()})")
+    if plan.post_filter is not None:
+        lines.append(f"    POST_JOIN_FILTER({to_sql(plan.post_filter)})")
+    exchange = "mesh-collective" if mesh is not None else "local"
+    if plan.joins:
+        lines.append(f"  STAGE_BOUNDARY(exchange:{plan.strategy} "
+                     f"[{exchange}])")
+    else:
+        lines.append("  STAGE_BOUNDARY(exchange:SORT [window])")
+    probe_desc = f"{plan.probe.alias}={plan.probe.table}"
+    for j in plan.joins:
+        dim = " dim" if j.build.is_dim else ""
+        lines.append(
+            f"  JOIN_{j.kind}(strategy={plan.strategy}, "
+            f"build={j.build.alias}={j.build.table}{dim}, "
+            f"probe={probe_desc})")
+        keys = ", ".join(f"{lk} = {rk}"
+                         for lk, rk in zip(j.left_keys, j.right_keys))
+        lines.append(f"      KEYS({keys})")
+        if j.residual is not None:
+            lines.append(f"      RESIDUAL({to_sql(j.residual)})")
+    for src in plan.sources:
+        role = "probe" if src is plan.probe else \
+            ("build/broadcast" if plan.strategy == "BROADCAST"
+             else "build/shuffle")
+        lines.append(f"  SCAN({src.alias}={src.table} [{role}])")
+        push = plan.pushdown.get(src.alias)
+        if push is not None:
+            _filter_lines(_to_filter(push), 2, lines)
+        else:
+            lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
+    return _rows_response(lines)
+
+
 def explain_plan(engine, q: QueryContext) -> dict:
     lines: list[str] = []
     aggs = q.aggregations()

@@ -1,0 +1,158 @@
+"""Device window-function kernel: one sort, segmented scans, scatter-back.
+
+The window half of the multi-stage engine (query2/): ROW_NUMBER / RANK /
+DENSE_RANK and the running aggregates SUM / AVG / COUNT / MIN / MAX over
+``OVER (PARTITION BY ... ORDER BY ...)`` specs. The reference snapshot
+predates Pinot's multi-stage engine entirely (PAPER.md: no
+pinot-query-runtime ``WindowAggregateOperator``), so this is a leapfrog —
+designed TPU-first on the sorted regime the radix group-by already relies
+on (ops/radix_groupby.py):
+
+1. ONE ``lax.sort`` orders rows by (partition key, order key, original row
+   id) — the row id both breaks ties deterministically and is the
+   scatter-back permutation, so no second sort is ever needed.
+2. Partition and peer (tie) boundaries come from neighbor diffs of the
+   sorted keys, exactly like ``_boundaries`` in the radix module.
+3. Every function is a segmented scan over those boundaries
+   (``seg_sum``/``seg_min``/``seg_max`` + a carry-first scan for RANK).
+   SQL's default frame with ORDER BY is RANGE UNBOUNDED PRECEDING ..
+   CURRENT ROW — peers share the frame value — which is the running scan
+   value at each peer-run END, broadcast back over the run by a reversed
+   carry-first scan (``_run_end_broadcast``). Without ORDER BY the frame
+   is the whole partition: the same code path with a constant order key
+   (one peer run per partition).
+4. Results scatter back to original row order through the sorted row ids.
+
+Shapes are static per (padded n, spec signature): callers pad rows to the
+next power of two with the partition sentinel so jit caches stay small.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from pinot_tpu.ops.join import next_pow2  # noqa: F401 — one shared helper
+from pinot_tpu.ops.radix_groupby import _seg_scan, seg_max, seg_min, seg_sum
+
+# partition sentinel for padded rows: sorts after every real partition and
+# never merges with one (real partition codes are non-negative)
+PART_SENTINEL = (1 << 62)
+
+# window function -> needs a value operand?
+WINDOW_FUNCTIONS = {
+    "row_number": False,
+    "rank": False,
+    "dense_rank": False,
+    "count": True,   # COUNT(x) — callers pass ones for COUNT(*)
+    "sum": True,
+    "avg": True,
+    "min": True,
+    "max": True,
+}
+
+RANK_FUNCTIONS = ("row_number", "rank", "dense_rank", "count")
+
+
+def _carry_first(values, is_start, axis=0):
+    """Segmented carry: every element takes its run's FIRST value (the
+    RANK broadcast). op keeps the left operand, which is associative."""
+    return _seg_scan(values, is_start, lambda a, b: a, axis)
+
+
+def _run_end_broadcast(x, run_start):
+    """Every element takes its run's LAST value — the peer-inclusive frame
+    read. Reversal turns run ends into run starts, so the carry-first scan
+    applies; reversing back restores row order."""
+    lead = jnp.ones((1,), dtype=bool)
+    run_end = jnp.concatenate([run_start[1:], lead])
+    y = _carry_first(x[::-1], run_end[::-1])
+    return y[::-1]
+
+
+@partial(jax.jit, static_argnames=("specs",))
+def window_eval(part, order, rowid, values, specs):
+    """Evaluate window specs sharing one (PARTITION BY, ORDER BY) sort.
+
+    part:   (n,) int64 partition codes; padded rows carry PART_SENTINEL.
+    order:  (n,) int64 order codes (descending handled by the caller's
+            code construction); constant when the spec has no ORDER BY.
+    rowid:  (n,) int64 original positions (pads continue past n_real).
+    values: tuple of (n,) float64 operand columns.
+    specs:  static tuple of (fn_name, value_index) — value_index -1 for
+            the rank family, else an index into ``values``.
+
+    Returns a tuple of (n,) arrays aligned with the ORIGINAL row order,
+    int64 for the rank family / COUNT, float64 otherwise.
+    """
+    ops = jax.lax.sort([part, order, rowid, *values], num_keys=3)
+    p, o, r = ops[0], ops[1], ops[2]
+    vs = ops[3:]
+    n = p.shape[0]
+    lead = jnp.ones((1,), dtype=bool)
+    part_start = jnp.concatenate([lead, p[1:] != p[:-1]])
+    peer_start = jnp.concatenate(
+        [lead, (p[1:] != p[:-1]) | (o[1:] != o[:-1])])
+    ones = jnp.ones(n, dtype=jnp.int64)
+    row_number = seg_sum(ones, part_start, axis=0)
+
+    # memoized per-operand running scans (several specs often share one)
+    run_sums: dict = {}
+
+    def running_sum(vi):
+        if vi not in run_sums:
+            run_sums[vi] = seg_sum(vs[vi], part_start, axis=0)
+        return run_sums[vi]
+
+    outs = []
+    for fn, vi in specs:
+        if fn == "row_number":
+            res = row_number
+        elif fn == "rank":
+            res = _carry_first(row_number, peer_start)
+        elif fn == "dense_rank":
+            res = seg_sum(peer_start.astype(jnp.int64), part_start, axis=0)
+        elif fn == "count":
+            res = _run_end_broadcast(row_number, peer_start)
+        elif fn == "sum":
+            res = _run_end_broadcast(running_sum(vi), peer_start)
+        elif fn == "avg":
+            res = _run_end_broadcast(running_sum(vi), peer_start) \
+                / _run_end_broadcast(row_number, peer_start).astype(
+                    jnp.float64)
+        elif fn == "min":
+            res = _run_end_broadcast(
+                seg_min(vs[vi], part_start, axis=0), peer_start)
+        elif fn == "max":
+            res = _run_end_broadcast(
+                seg_max(vs[vi], part_start, axis=0), peer_start)
+        else:  # pragma: no cover - validated upstream
+            raise ValueError(f"unknown window function {fn}")
+        # scatter back to original order through the sorted row ids
+        outs.append(jnp.zeros(n, res.dtype).at[r].set(res))
+    return tuple(outs)
+
+
+def pad_inputs(part, order, rowid, values):
+    """Pad to the next power of two with the partition sentinel so padded
+    rows form their own trailing partition (host-side numpy helper)."""
+    import numpy as np
+
+    n = len(part)
+    m = next_pow2(max(n, 1))
+    if m == n:
+        return part, order, rowid, values
+    pad = m - n
+
+    def ext(a, fill):
+        return np.concatenate([np.asarray(a), np.full(pad, fill, a.dtype)])
+
+    part = ext(np.asarray(part, dtype=np.int64), PART_SENTINEL)
+    order = ext(np.asarray(order, dtype=np.int64), 0)
+    rowid = np.concatenate(
+        [np.asarray(rowid, dtype=np.int64),
+         np.arange(n, m, dtype=np.int64)])
+    values = tuple(ext(np.asarray(v, dtype=np.float64), 0.0) for v in values)
+    return part, order, rowid, values

@@ -151,8 +151,10 @@ def is_aggregation(expr: Expression) -> bool:
 
 
 def find_aggregations(expr: Expression) -> list[Expression]:
-    """All aggregation sub-expressions, depth-first (dedup preserved later)."""
-    if not expr.is_function:
+    """All aggregation sub-expressions, depth-first (dedup preserved later).
+    ``__window__`` nodes are opaque: SUM(x) OVER (...) is a window function
+    owned by the multi-stage runner, not a mergeable aggregation."""
+    if not expr.is_function or expr.name == "__window__":
         return []
     if is_aggregation(expr):
         return [expr]

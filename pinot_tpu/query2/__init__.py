@@ -1,0 +1,20 @@
+"""Multi-stage query engine (engine v2): logical planner + stage runner.
+
+The reference snapshot predates Pinot's multi-stage engine — PAPER.md is
+explicit that it carries "no pinot-query-planner/pinot-query-runtime; the
+only query engine is single-stage scatter-gather". This package leapfrogs
+that gap (ROADMAP item 2): ``logical.py`` compiles JOIN / window queries
+into a two-stage plan, ``runner.py`` executes it — stage 1 leaf scans ride
+the existing single-stage machinery, the join runs on device hash-join
+kernels (ops/join.py, radix key packing + static-bound pair expansion,
+broadcast or shuffle across the mesh), window functions ride one sorted
+pass (ops/window.py), and stage 2 reuses engine/reduce.py's merge /
+HAVING / ORDER BY / finalize wholesale. Plain single-table queries never
+enter this package.
+"""
+
+from pinot_tpu.query2.logical import (  # noqa: F401
+    MultiStagePlan,
+    compile_plan,
+    is_multistage,
+)

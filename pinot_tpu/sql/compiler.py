@@ -8,6 +8,7 @@ rewriter chain (sql/parsers/rewriter/: alias + ordinal resolution).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 from pinot_tpu.query.context import (
@@ -29,7 +30,66 @@ def compile_query(sql: str) -> QueryContext:
     return compile_select(parse_sql(sql))
 
 
+def contains_window(e: Expression) -> bool:
+    """True when a ``__window__`` marker (OVER clause) appears anywhere in
+    the expression tree."""
+    if not e.is_function:
+        return False
+    if e.name == "__window__":
+        return True
+    return any(contains_window(a) for a in e.args)
+
+
+def is_multistage(stmt: SqlSelect) -> bool:
+    """Joins or window functions route through the multi-stage engine
+    (query2/); everything else stays on the single-stage path untouched."""
+    if stmt.joins:
+        return True
+    exprs = [e for e, _ in stmt.select]
+    exprs.extend(e for e, _ in stmt.order_by)
+    if stmt.having is not None:
+        exprs.append(stmt.having)
+    if stmt.where is not None:
+        exprs.append(stmt.where)
+    exprs.extend(stmt.group_by)
+    return any(contains_window(e) for e in exprs)
+
+
+def _strip_alias(e: Expression, alias: str) -> Expression:
+    """``alias.col`` → ``col`` for a single-table query's own alias, so
+    FROM t x / SELECT x.c rides the single-stage path unchanged."""
+    if e.is_identifier and e.name.startswith(alias + "."):
+        return Expression.identifier(e.name[len(alias) + 1:])
+    if e.is_function:
+        return Expression(
+            ExpressionType.FUNCTION, name=e.name,
+            args=tuple(_strip_alias(a, alias) for a in e.args))
+    return e
+
+
 def compile_select(stmt: SqlSelect) -> QueryContext:
+    if is_multistage(stmt):
+        # the planner (query2/logical.py) owns joins and windows; reaching
+        # this single-stage entry with one is a routing bug or a direct
+        # server submit of a query only the broker/engine can decompose
+        raise SqlParseError(
+            "join/window queries compile through the multi-stage engine "
+            "(query2), not the single-stage compiler")
+    # de-qualify single-table references: the explicit alias when one was
+    # written (SELECT x.c FROM t x), else the table name itself
+    # (SELECT t.c FROM t)
+    a = stmt.table_alias or stmt.table
+    if a:
+        stmt = dataclasses.replace(
+            stmt,
+            select=[(_strip_alias(e, a), al) for e, al in stmt.select],
+            where=None if stmt.where is None else _strip_alias(stmt.where, a),
+            group_by=[_strip_alias(e, a) for e in stmt.group_by],
+            having=None if stmt.having is None
+            else _strip_alias(stmt.having, a),
+            order_by=[(_strip_alias(e, a), asc)
+                      for e, asc in stmt.order_by],
+        )
     select_exprs = tuple(e for e, _ in stmt.select)
     aliases = tuple(a for _, a in stmt.select)
     alias_map = {a: e for e, a in stmt.select if a}

@@ -45,6 +45,19 @@ class SqlParseError(Exception):
     pass
 
 
+class SqlAnalysisError(SqlParseError):
+    """Typed semantic-analysis error (the multi-stage planner's analog of
+    Calcite's validator errors): unknown / ambiguous column references
+    resolve to this, naming the table alias and the candidate columns,
+    instead of surfacing a raw KeyError from the compiler."""
+
+    def __init__(self, message: str, column: Optional[str] = None,
+                 candidates: tuple = ()):
+        super().__init__(message)
+        self.column = column
+        self.candidates = tuple(candidates)
+
+
 @dataclasses.dataclass
 class Token:
     kind: str  # number | string | ident | qident | op | eof
@@ -79,9 +92,22 @@ def tokenize(sql: str) -> list[Token]:
 
 
 @dataclasses.dataclass
+class JoinClause:
+    """One ``[INNER|LEFT [OUTER]] JOIN table [AS] alias ON expr`` clause
+    (multi-stage grammar; the reference snapshot has no join surface)."""
+
+    kind: str  # "INNER" | "LEFT"
+    table: str
+    alias: Optional[str]
+    on: Expression
+
+
+@dataclasses.dataclass
 class SqlSelect:
     table: str
     select: list  # list[tuple[Expression, Optional[str]]] (expr, alias)
+    table_alias: Optional[str] = None
+    joins: list = dataclasses.field(default_factory=list)
     distinct: bool = False
     where: Optional[Expression] = None
     group_by: list = dataclasses.field(default_factory=list)
@@ -98,6 +124,9 @@ _RESERVED_STOP = {
     "AND", "OR", "NOT", "IN", "BETWEEN", "LIKE", "IS", "ASC", "DESC",
     "SELECT", "DISTINCT", "BY", "NULL", "TRUE", "FALSE", "CASE", "WHEN",
     "THEN", "ELSE", "END", "CAST",
+    # multi-stage grammar (joins + windows)
+    "JOIN", "ON", "INNER", "LEFT", "RIGHT", "FULL", "CROSS", "OUTER",
+    "OVER", "PARTITION",
 }
 
 _COMPARISON = {
@@ -202,7 +231,30 @@ class Parser:
             select.append(self.parse_select_item())
 
         self.expect_kw("FROM")
-        table = self.parse_table_name()
+        table, table_alias = self.parse_table_ref()
+        joins: list = []
+        while True:
+            if self.accept_kw("JOIN"):
+                kind = "INNER"
+            elif self.at_kw("INNER"):
+                self.next()
+                self.expect_kw("JOIN")
+                kind = "INNER"
+            elif self.at_kw("LEFT"):
+                self.next()
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+                kind = "LEFT"
+            elif self.at_kw("RIGHT", "FULL", "CROSS"):
+                t = self.peek()
+                raise SqlParseError(
+                    f"{t.upper} JOIN is not supported (INNER and LEFT "
+                    f"joins only) at {t.pos}")
+            else:
+                break
+            jtable, jalias = self.parse_table_ref()
+            self.expect_kw("ON")
+            joins.append(JoinClause(kind, jtable, jalias, self.parse_expr()))
 
         where = None
         if self.accept_kw("WHERE"):
@@ -239,7 +291,8 @@ class Parser:
                     offset = self.parse_int()
 
         return SqlSelect(
-            table=table, select=select, distinct=distinct, where=where,
+            table=table, select=select, table_alias=table_alias,
+            joins=joins, distinct=distinct, where=where,
             group_by=group_by, having=having, order_by=order_by,
             limit=limit, offset=offset,
         )
@@ -264,6 +317,17 @@ class Parser:
         if self.accept_kw("NULLS"):
             self.next()
         return (expr, asc)
+
+    def parse_table_ref(self):
+        """``table [AS] alias`` → (name, alias or None)."""
+        name = self.parse_table_name()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = _unquote(self.next())
+        elif self.peek().kind in ("ident", "qident") \
+                and not self.at_kw(*_RESERVED_STOP):
+            alias = _unquote(self.next())
+        return name, alias
 
     def parse_table_name(self) -> str:
         t = self.next()
@@ -393,7 +457,7 @@ class Parser:
         if t.kind == "op" and t.text == "*":
             return Expression.identifier("*")
         if t.kind == "qident":
-            return Expression.identifier(_unquote(t))
+            return self.parse_maybe_qualified(_unquote(t))
         if t.kind == "ident":
             up = t.upper
             if up == "NULL":
@@ -407,9 +471,59 @@ class Parser:
             if up == "CAST":
                 return self.parse_cast()
             if self.accept_op("("):
-                return self.parse_function_call(t.text)
-            return Expression.identifier(t.text)
+                e = self.parse_function_call(t.text)
+                if self.at_kw("OVER"):
+                    e = self.parse_over(e)
+                return e
+            return self.parse_maybe_qualified(t.text)
         raise SqlParseError(f"unexpected token {t.text!r} at {t.pos}")
+
+    def parse_maybe_qualified(self, first: str) -> Expression:
+        """``alias.col`` → one identifier named ``alias.col`` (the
+        multi-stage planner resolves the qualification; single-table
+        queries strip a matching table alias in the compiler)."""
+        if not self.accept_op("."):
+            return Expression.identifier(first)
+        t = self.next()
+        if t.kind not in ("ident", "qident"):
+            raise SqlParseError(
+                f"expected column after {first!r}. at {t.pos}")
+        return Expression.identifier(f"{first}.{_unquote(t)}")
+
+    def parse_over(self, fn_expr: Expression) -> Expression:
+        """``OVER (PARTITION BY ... ORDER BY ...)`` →
+        function('__window__', fn, '__partition__'(keys...),
+        '__order__'('__asc__'|'__desc__'(key)...)). The dunder names are
+        reserved markers the multi-stage planner unpacks; they can never
+        collide with transform registry names."""
+        self.expect_kw("OVER")
+        self.expect_op("(")
+        partition: list[Expression] = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        order: list[Expression] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            e, asc = self.parse_order_item()
+            order.append(Expression.function(
+                "__asc__" if asc else "__desc__", e))
+            while self.accept_op(","):
+                e, asc = self.parse_order_item()
+                order.append(Expression.function(
+                    "__asc__" if asc else "__desc__", e))
+        if self.at_kw("ROWS", "RANGE", "GROUPS"):
+            t = self.peek()
+            raise SqlParseError(
+                f"explicit window frames ({t.upper} ...) are not "
+                f"supported at {t.pos}; the default frame applies")
+        self.expect_op(")")
+        return Expression.function(
+            "__window__", fn_expr,
+            Expression.function("__partition__", *partition),
+            Expression.function("__order__", *order))
 
     def parse_function_call(self, name: str) -> Expression:
         # COUNT(*) / COUNT(DISTINCT x) special forms

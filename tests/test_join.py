@@ -1,0 +1,675 @@
+"""Multi-stage engine v2: device hash joins — differential suite.
+
+Inner/left equi-joins agree across the device kernels (ops/join.py), the
+host (numpy) mirror, and a sqlite3 oracle, on sealed + consuming segments,
+solo + 8-virtual-device mesh, with both BROADCAST and SHUFFLE strategies
+forced via SET joinStrategy. Also pins:
+
+- LOOKUP(...) transform results bit-identical to the equivalent LEFT JOIN
+  (the broadcast-join path is a strict superset of the dim-table lookup),
+- typed parser/analysis diagnostics (unknown/ambiguous columns name the
+  alias and candidates),
+- EXPLAIN rendering of the two-stage plan,
+- literal-free query-log template keys for join shapes,
+- broker-side two-stage execution over a 2-server cluster.
+"""
+
+import math
+import sqlite3
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.device import DeviceExecutor
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.parallel.mesh import make_mesh
+from pinot_tpu.sql.parser import SqlAnalysisError, parse_sql
+from pinot_tpu.storage.creator import build_segment
+
+N_FACT = 4000
+N_PARTS = 60
+N_CUSTS = 25
+
+
+def _schemas():
+    fact = Schema.build(
+        name="orders",
+        dimensions=[("partkey", DataType.INT), ("custkey", DataType.INT),
+                    ("status", DataType.STRING)],
+        metrics=[("qty", DataType.INT), ("price", DataType.DOUBLE)],
+    )
+    parts = Schema.build(
+        name="parts",
+        dimensions=[("pkey", DataType.INT), ("category", DataType.STRING),
+                    ("brand", DataType.STRING)],
+        primary_key_columns=["pkey"],
+    )
+    custs = Schema.build(
+        name="custs",
+        dimensions=[("ckey", DataType.INT), ("region", DataType.STRING)],
+        primary_key_columns=["ckey"],
+    )
+    return fact, parts, custs
+
+
+def _data(rng):
+    # partkey range deliberately exceeds the dim table (misses for LEFT);
+    # every key appears on many fact rows (duplicate probe keys)
+    fact = {
+        "partkey": rng.integers(0, N_PARTS + 8, N_FACT).astype(np.int32),
+        "custkey": rng.integers(0, N_CUSTS, N_FACT).astype(np.int32),
+        "status": np.array(["open", "paid", "void"])[
+            rng.integers(0, 3, N_FACT)],
+        "qty": rng.integers(1, 50, N_FACT).astype(np.int32),
+        "price": np.round(rng.uniform(1.0, 500.0, N_FACT), 2),
+    }
+    parts = {
+        "pkey": np.arange(N_PARTS, dtype=np.int32),
+        "category": np.array([f"cat_{i % 7}" for i in range(N_PARTS)]),
+        "brand": np.array([f"brand_{i % 11}" for i in range(N_PARTS)]),
+    }
+    custs = {
+        "ckey": np.arange(N_CUSTS, dtype=np.int32),
+        "region": np.array([f"region_{i % 5}" for i in range(N_CUSTS)]),
+    }
+    return fact, parts, custs
+
+
+def _load_engine(engine, base, fact, parts, custs, tag):
+    fact_schema, parts_schema, custs_schema = _schemas()
+    half = N_FACT // 2
+    for i, sl in enumerate([slice(0, half), slice(half, N_FACT)]):
+        seg = build_segment(
+            fact_schema, {k: v[sl] for k, v in fact.items()},
+            str(base / f"f{tag}{i}"), TableConfig(table_name="orders"),
+            f"f{i}")
+        engine.add_segment("orders", seg)
+    engine.add_segment("parts", build_segment(
+        parts_schema, parts, str(base / f"p{tag}"),
+        TableConfig(table_name="parts", is_dim_table=True), "p0"))
+    engine.add_segment("custs", build_segment(
+        custs_schema, custs, str(base / f"c{tag}"),
+        TableConfig(table_name="custs", is_dim_table=True), "c0"))
+    engine.table("parts").is_dim_table = True
+    engine.table("custs").is_dim_table = True
+    return engine
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    rng = np.random.default_rng(11)
+    fact, parts, custs = _data(rng)
+    base = tmp_path_factory.mktemp("joinseg")
+    engines = {
+        "host": _load_engine(QueryEngine(device_executor=None), base,
+                             fact, parts, custs, "h"),
+        "device": _load_engine(QueryEngine(), base, fact, parts, custs,
+                               "d"),
+        "mesh": _load_engine(
+            QueryEngine(device_executor=DeviceExecutor(mesh=make_mesh(8))),
+            base, fact, parts, custs, "m"),
+    }
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE orders (partkey INT, custkey INT, "
+                "status TEXT, qty INT, price REAL)")
+    con.executemany(
+        "INSERT INTO orders VALUES (?,?,?,?,?)",
+        list(zip(*(fact[c].tolist() for c in
+                   ("partkey", "custkey", "status", "qty", "price")))))
+    con.execute("CREATE TABLE parts (pkey INT, category TEXT, brand TEXT)")
+    con.executemany("INSERT INTO parts VALUES (?,?,?)",
+                    list(zip(*(parts[c].tolist() for c in
+                               ("pkey", "category", "brand")))))
+    con.execute("CREATE TABLE custs (ckey INT, region TEXT)")
+    con.executemany("INSERT INTO custs VALUES (?,?)",
+                    list(zip(*(custs[c].tolist() for c in
+                               ("ckey", "region")))))
+    return engines, con
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return v
+
+
+def _rows(resp):
+    assert not resp.get("exceptions"), resp.get("exceptions")
+    return [[_norm(v) for v in r] for r in resp["resultTable"]["rows"]]
+
+
+def check(setup, sql, oracle_sql, engines=("host", "device", "mesh"),
+          strategies=("broadcast", "shuffle")):
+    eng_map, con = setup
+    expected = [[_norm(v) for v in r]
+                for r in con.execute(oracle_sql).fetchall()]
+    for name in engines:
+        for strat in strategies:
+            full = f"SET joinStrategy='{strat}'; {sql}"
+            got = _rows(eng_map[name].execute(full))
+            assert got == expected, (
+                f"{name}/{strat} mismatch for {sql!r}:\n"
+                f"got      {got[:5]}\nexpected {expected[:5]}")
+
+
+class TestJoinParity:
+    def test_inner_group_by(self, setup):
+        check(
+            setup,
+            "SELECT p.category, SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY p.category ORDER BY p.category LIMIT 20",
+            "SELECT p.category, SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY p.category ORDER BY p.category LIMIT 20")
+
+    def test_left_join_group_by(self, setup):
+        # LEFT misses fill with the column TYPE default ('' for strings) —
+        # the LOOKUP convention; COALESCE makes the oracle agree
+        check(
+            setup,
+            "SELECT p.category, COUNT(*) FROM orders o "
+            "LEFT JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY p.category ORDER BY p.category LIMIT 20",
+            "SELECT COALESCE(p.category, ''), COUNT(*) FROM orders o "
+            "LEFT JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY COALESCE(p.category, '') "
+            "ORDER BY COALESCE(p.category, '') LIMIT 20")
+
+    def test_inner_selection_order_by(self, setup):
+        check(
+            setup,
+            "SELECT o.partkey, p.brand, o.qty FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "WHERE o.qty > 47 AND p.category = 'cat_3' "
+            "ORDER BY o.partkey, o.qty LIMIT 15",
+            "SELECT o.partkey, p.brand, o.qty FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "WHERE o.qty > 47 AND p.category = 'cat_3' "
+            "ORDER BY o.partkey, o.qty LIMIT 15")
+
+    def test_where_pushdown_both_sides(self, setup):
+        check(
+            setup,
+            "SELECT p.category, COUNT(*), AVG(o.price) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "WHERE o.status = 'paid' AND p.brand = 'brand_2' "
+            "GROUP BY p.category ORDER BY p.category",
+            "SELECT p.category, COUNT(*), AVG(o.price) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "WHERE o.status = 'paid' AND p.brand = 'brand_2' "
+            "GROUP BY p.category ORDER BY p.category")
+
+    def test_residual_on_conjunct(self, setup):
+        # non-equi ON conjunct evaluated on matched pairs (LEFT keeps
+        # disqualified probe rows with default fill)
+        check(
+            setup,
+            "SELECT p.category, COUNT(*) FROM orders o "
+            "LEFT JOIN parts p ON o.partkey = p.pkey AND o.qty < 10 "
+            "GROUP BY p.category ORDER BY p.category",
+            "SELECT COALESCE(p.category, ''), COUNT(*) FROM orders o "
+            "LEFT JOIN parts p ON o.partkey = p.pkey AND o.qty < 10 "
+            "GROUP BY COALESCE(p.category, '') "
+            "ORDER BY COALESCE(p.category, '')")
+
+    def test_star_two_dim_chain(self, setup):
+        check(
+            setup,
+            "SELECT p.category, c.region, SUM(o.price) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "JOIN custs c ON o.custkey = c.ckey "
+            "WHERE o.status <> 'void' "
+            "GROUP BY p.category, c.region "
+            "ORDER BY p.category, c.region LIMIT 50",
+            "SELECT p.category, c.region, SUM(o.price) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "JOIN custs c ON o.custkey = c.ckey "
+            "WHERE o.status <> 'void' "
+            "GROUP BY p.category, c.region "
+            "ORDER BY p.category, c.region LIMIT 50")
+
+    def test_multi_column_key(self, setup):
+        # two-column equi-key (category+brand joined back on itself via a
+        # derived fact column pair is overkill; use pkey twice to prove
+        # multi-key packing)
+        check(
+            setup,
+            "SELECT COUNT(*) FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey AND o.partkey = p.pkey",
+            "SELECT COUNT(*) FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey")
+
+    def test_having_on_join(self, setup):
+        check(
+            setup,
+            "SELECT p.category, SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY p.category HAVING SUM(o.qty) > 6000 "
+            "ORDER BY p.category",
+            "SELECT p.category, SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey "
+            "GROUP BY p.category HAVING SUM(o.qty) > 6000 "
+            "ORDER BY p.category")
+
+    def test_inner_join_no_matches(self, setup):
+        check(
+            setup,
+            "SELECT COUNT(*), SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey WHERE p.category = 'nope'",
+            "SELECT COUNT(*), SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey WHERE p.category = 'nope'")
+
+    def test_join_strategy_reported(self, setup):
+        eng_map, _ = setup
+        r = eng_map["device"].execute(
+            "SET joinStrategy='shuffle'; SELECT COUNT(*) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey")
+        assert r["joinStrategy"] == "SHUFFLE"
+        assert r["numStages"] == 2
+        r = eng_map["device"].execute(
+            "SELECT COUNT(*) FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey")
+        # both dims are flagged is_dim_table: default strategy = BROADCAST
+        assert r["joinStrategy"] == "BROADCAST"
+
+
+class TestConsumingJoin:
+    @pytest.fixture(scope="class")
+    def consuming(self, tmp_path_factory):
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        rng = np.random.default_rng(13)
+        fact, parts, custs = _data(rng)
+        base = tmp_path_factory.mktemp("joinrt")
+        engines = {}
+        for name, dev in (("host", None), ("device", "auto")):
+            eng = QueryEngine() if dev else QueryEngine(device_executor=None)
+            fact_schema, parts_schema, _ = _schemas()
+            half = N_FACT // 2
+            seg = build_segment(
+                fact_schema, {k: v[:half] for k, v in fact.items()},
+                str(base / f"f{name}"), TableConfig(table_name="orders"),
+                "f0")
+            eng.add_segment("orders", seg)
+            ms = MutableSegment(fact_schema, "orders__0__0__rt")
+            rows = [{k: fact[k][i].item() for k in fact}
+                    for i in range(half, N_FACT)]
+            ms.index_batch(rows)
+            eng.add_segment("orders", ms)
+            eng.add_segment("parts", build_segment(
+                parts_schema, parts, str(base / f"p{name}"),
+                TableConfig(table_name="parts", is_dim_table=True), "p0"))
+            engines[name] = eng
+        con = sqlite3.connect(":memory:")
+        con.execute("CREATE TABLE orders (partkey INT, custkey INT, "
+                    "status TEXT, qty INT, price REAL)")
+        con.executemany(
+            "INSERT INTO orders VALUES (?,?,?,?,?)",
+            list(zip(*(fact[c].tolist() for c in
+                       ("partkey", "custkey", "status", "qty", "price")))))
+        con.execute("CREATE TABLE parts (pkey INT, category TEXT, "
+                    "brand TEXT)")
+        con.executemany(
+            "INSERT INTO parts VALUES (?,?,?)",
+            list(zip(*(parts[c].tolist() for c in
+                       ("pkey", "category", "brand")))))
+        return engines, con
+
+    @pytest.mark.parametrize("strategy", ["broadcast", "shuffle"])
+    def test_sealed_plus_consuming_parity(self, consuming, strategy):
+        engines, con = consuming
+        sql = ("SELECT p.category, COUNT(*), SUM(o.qty) FROM orders o "
+               "JOIN parts p ON o.partkey = p.pkey "
+               "GROUP BY p.category ORDER BY p.category")
+        expected = [[_norm(v) for v in r]
+                    for r in con.execute(sql).fetchall()]
+        for name, eng in engines.items():
+            got = _rows(eng.execute(f"SET joinStrategy='{strategy}'; {sql}"))
+            assert got == expected, f"{name}/{strategy}"
+
+    def test_left_join_on_consuming(self, consuming):
+        engines, con = consuming
+        sql = ("SELECT o.partkey, p.category FROM orders o "
+               "LEFT JOIN parts p ON o.partkey = p.pkey "
+               "WHERE o.qty = 7 ORDER BY o.partkey, p.category LIMIT 25")
+        expected = [[_norm(v) for v in r] for r in con.execute(
+            "SELECT o.partkey, COALESCE(p.category,'') FROM orders o "
+            "LEFT JOIN parts p ON o.partkey = p.pkey "
+            "WHERE o.qty = 7 ORDER BY o.partkey, COALESCE(p.category,'') "
+            "LIMIT 25").fetchall()]
+        for name, eng in engines.items():
+            assert _rows(eng.execute(sql)) == expected, name
+
+
+class TestLookupSuperset:
+    """The broadcast join subsumes the LOOKUP transform: pin the LEFT JOIN
+    bit-identical to LOOKUP against the same dim table."""
+
+    def test_left_join_matches_lookup_bit_identical(self, setup):
+        eng_map, _ = setup
+        for name in ("host", "device", "mesh"):
+            eng = eng_map[name]
+            via_lookup = eng.execute(
+                "SELECT partkey, LOOKUP('parts', 'category', 'pkey', "
+                "partkey), qty FROM orders ORDER BY partkey, qty, "
+                "LOOKUP('parts', 'category', 'pkey', partkey) LIMIT 200")
+            via_join = eng.execute(
+                "SELECT o.partkey, p.category, o.qty FROM orders o "
+                "LEFT JOIN parts p ON o.partkey = p.pkey "
+                "ORDER BY o.partkey, o.qty, p.category LIMIT 200")
+            assert not via_lookup.get("exceptions")
+            assert not via_join.get("exceptions")
+            # bit-identical: same values, same types, incl. '' miss fills
+            assert via_join["resultTable"]["rows"] == \
+                via_lookup["resultTable"]["rows"], name
+
+    def test_lookup_numeric_default_matches_left_join(self, setup):
+        eng_map, _ = setup
+        eng = eng_map["device"]
+        via_lookup = eng.execute(
+            "SELECT SUM(LOOKUP('parts', 'pkey', 'pkey', partkey)) "
+            "FROM orders")
+        via_join = eng.execute(
+            "SELECT SUM(p.pkey) FROM orders o LEFT JOIN parts p "
+            "ON o.partkey = p.pkey")
+        assert via_join["resultTable"]["rows"] == \
+            via_lookup["resultTable"]["rows"]
+
+
+class TestDiagnostics:
+    def test_unknown_column_names_alias_and_candidates(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT p.nosuch FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey")
+        msg = r["exceptions"][0]["message"]
+        assert "nosuch" in msg and "'p'" in msg and "category" in msg
+
+    def test_unknown_bare_column_lists_tables(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT nosuch FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey")
+        msg = r["exceptions"][0]["message"]
+        assert "nosuch" in msg and "o(" in msg and "p(" in msg
+
+    def test_ambiguous_column_names_candidate_aliases(self, tmp_path):
+        # two tables sharing a column name: the bare reference must error
+        # with both qualification options
+        schema = Schema.build(
+            name="t1", dimensions=[("k", DataType.INT)],
+            metrics=[("v", DataType.INT)])
+        eng = QueryEngine(device_executor=None)
+        data = {"k": np.arange(4, dtype=np.int32),
+                "v": np.arange(4, dtype=np.int32)}
+        eng.add_segment("t1", build_segment(
+            schema, data, str(tmp_path / "a"),
+            TableConfig(table_name="t1"), "a0"))
+        eng.add_segment("t2", build_segment(
+            Schema.build(name="t2", dimensions=[("k", DataType.INT)],
+                         metrics=[("v", DataType.INT)]),
+            data, str(tmp_path / "b"), TableConfig(table_name="t2"), "b0"))
+        r = eng.execute(
+            "SELECT v FROM t1 a JOIN t2 b ON a.k = b.k")
+        msg = r["exceptions"][0]["message"]
+        assert "ambiguous" in msg and "a.v" in msg and "b.v" in msg
+
+    def test_analysis_error_is_typed(self):
+        from pinot_tpu.query2.logical import compile_plan
+
+        stmt = parse_sql("SELECT x.nope FROM f x JOIN d y ON x.a = y.b")
+
+        def catalog(table):
+            return ("a", "b"), False
+
+        with pytest.raises(SqlAnalysisError) as ei:
+            compile_plan(stmt, catalog)
+        assert ei.value.column == "x.nope"
+        assert "a" in ei.value.candidates
+
+    def test_non_equi_join_rejected(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT COUNT(*) FROM orders o JOIN parts p "
+            "ON o.partkey > p.pkey")
+        assert "equality" in r["exceptions"][0]["message"]
+
+    def test_right_join_rejected(self):
+        with pytest.raises(Exception) as ei:
+            parse_sql("SELECT 1 FROM a RIGHT JOIN b ON a.x = b.y")
+        assert "RIGHT" in str(ei.value)
+
+    def test_acl_checks_every_joined_table(self):
+        # a restricted principal must not read a denied table THROUGH a
+        # join: the broker HTTP ACL walks every referenced table
+        from pinot_tpu.broker.http_api import BrokerHttpServer
+        from pinot_tpu.common.auth import BasicAuthAccessControl
+
+        srv = BrokerHttpServer.__new__(BrokerHttpServer)
+        srv._access = BasicAuthAccessControl(
+            {"bob": "pw"}, {"bob": ["orders"]})
+        assert srv._denied_table(
+            "bob", "SELECT COUNT(*) FROM orders") is None
+        assert srv._denied_table(
+            "bob", "SELECT COUNT(*) FROM orders o JOIN secrets s "
+                   "ON o.k = s.k") == "secrets"
+
+    def test_single_table_alias_still_single_stage(self, setup):
+        # plain aliased single-table SQL stays on the v1 path (numStages
+        # absent) and qualified refs resolve
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT o.status, COUNT(*) FROM orders o "
+            "WHERE o.qty > 10 GROUP BY o.status ORDER BY o.status")
+        assert not r.get("exceptions")
+        assert "numStages" not in r
+        assert len(r["resultTable"]["rows"]) == 3
+
+    def test_table_name_qualified_single_table(self, setup):
+        # SELECT t.c FROM t (no alias): the table name itself qualifies
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT orders.status, COUNT(*) FROM orders "
+            "WHERE orders.qty > 10 GROUP BY orders.status "
+            "ORDER BY orders.status")
+        assert not r.get("exceptions"), r.get("exceptions")
+        assert len(r["resultTable"]["rows"]) == 3
+
+    def test_mixed_type_join_keys_never_match(self, setup):
+        # strict typing: int = string equi-keys match nothing (sqlite's
+        # int = text is false), instead of str-casting both sides
+        eng_map, _ = setup
+        for name in ("host", "device"):
+            r = eng_map[name].execute(
+                "SELECT COUNT(*) FROM orders o JOIN parts p "
+                "ON o.partkey = p.category")
+            assert not r.get("exceptions"), r.get("exceptions")
+            assert r["resultTable"]["rows"][0][0] == 0, name
+            # LEFT keeps every probe row, all misses
+            r = eng_map[name].execute(
+                "SELECT COUNT(*) FROM orders o LEFT JOIN parts p "
+                "ON o.partkey = p.category")
+            assert r["resultTable"]["rows"][0][0] == N_FACT, name
+
+    def test_heuristic_broadcast_demotes_on_huge_build(self, setup,
+                                                       monkeypatch):
+        # an unforced BROADCAST must not replicate a build table past the
+        # cap; SET joinStrategy='broadcast' still overrides
+        from pinot_tpu.query2 import runner as runner_mod
+
+        eng_map, _ = setup
+        monkeypatch.setattr(runner_mod, "BROADCAST_MAX_BUILD_ROWS", 10)
+        r = eng_map["host"].execute(
+            "SELECT COUNT(*) FROM orders o JOIN parts p "
+            "ON o.partkey = p.pkey")
+        assert r["joinStrategy"] == "SHUFFLE"
+        r = eng_map["host"].execute(
+            "SET joinStrategy='broadcast'; SELECT COUNT(*) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey")
+        assert r["joinStrategy"] == "BROADCAST"
+
+
+class TestExplainJoin:
+    def test_explain_broadcast_inner(self, setup):
+        eng_map, _ = setup
+        r = eng_map["device"].execute(
+            "SET joinStrategy='broadcast'; EXPLAIN PLAN FOR "
+            "SELECT p.category, SUM(o.qty) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey GROUP BY p.category")
+        lines = [row[0] for row in r["resultTable"]["rows"]]
+        text = "\n".join(lines)
+        assert any("JOIN_INNER(strategy=BROADCAST" in ln for ln in lines)
+        assert any("STAGE_BOUNDARY" in ln for ln in lines)
+        assert "build=p=parts dim" in text and "probe=o=orders" in text
+        assert any("KEYS(o.partkey = p.pkey)" in ln for ln in lines)
+        assert any(ln.strip().startswith("SCAN(o=orders") for ln in lines)
+
+    def test_explain_shuffle_left_with_pushdown(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SET joinStrategy='shuffle'; EXPLAIN PLAN FOR "
+            "SELECT o.partkey FROM orders o LEFT JOIN parts p "
+            "ON o.partkey = p.pkey WHERE o.qty > 5")
+        lines = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("JOIN_LEFT(strategy=SHUFFLE" in ln for ln in lines)
+        # probe-side WHERE pushes into the scan
+        assert any("FILTER" in ln and "qty" in ln for ln in lines)
+
+    def test_explain_mesh_exchange(self, setup):
+        eng_map, _ = setup
+        r = eng_map["mesh"].execute(
+            "EXPLAIN PLAN FOR SELECT COUNT(*) FROM orders o "
+            "JOIN parts p ON o.partkey = p.pkey")
+        lines = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("mesh-collective" in ln for ln in lines)
+
+
+class TestQuerylogTemplates:
+    def test_join_template_literal_free(self, setup):
+        from pinot_tpu.broker.querylog import template_key
+        from pinot_tpu.query2.logical import compile_plan
+
+        eng_map, _ = setup
+
+        def catalog(table):
+            cols = {"orders": ("partkey", "custkey", "status", "qty",
+                               "price"),
+                    "parts": ("pkey", "category", "brand")}[table]
+            return cols, table == "parts"
+
+        def key_for(sql):
+            return template_key(compile_plan(parse_sql(sql), catalog))
+
+        a = key_for("SELECT p.category, SUM(o.qty) FROM orders o "
+                    "JOIN parts p ON o.partkey = p.pkey "
+                    "WHERE o.qty > 5 GROUP BY p.category")
+        b = key_for("SELECT p.category, SUM(o.qty) FROM orders o "
+                    "JOIN parts p ON o.partkey = p.pkey "
+                    "WHERE o.qty > 99 GROUP BY p.category")
+        c = key_for("SELECT p.category, SUM(o.qty) FROM orders o "
+                    "LEFT JOIN parts p ON o.partkey = p.pkey "
+                    "WHERE o.qty > 5 GROUP BY p.category")
+        assert a == b          # literals don't change the template
+        assert a != c          # join kind does
+        assert "joins[" in a and "INNER" in a
+        assert "5" not in a and "99" not in b
+
+    def test_window_template_covers_shape(self):
+        from pinot_tpu.broker.querylog import template_key
+        from pinot_tpu.query2.logical import compile_plan
+
+        def catalog(table):
+            return ("team", "score"), False
+
+        k1 = template_key(compile_plan(parse_sql(
+            "SELECT team, ROW_NUMBER() OVER (PARTITION BY team "
+            "ORDER BY score) FROM games WHERE score > 3"), catalog))
+        k2 = template_key(compile_plan(parse_sql(
+            "SELECT team, ROW_NUMBER() OVER (PARTITION BY team "
+            "ORDER BY score) FROM games WHERE score > 888"), catalog))
+        assert k1 == k2
+        assert "windows[row_number" in k1
+        assert "888" not in k2
+
+
+def _wait_until(cond, timeout=20.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestBrokerMultistage:
+    def test_join_via_broker_cluster(self, tmp_path):
+        from pinot_tpu.broker.broker import Broker
+        from pinot_tpu.cluster.registry import ClusterRegistry
+        from pinot_tpu.controller.controller import Controller
+        from pinot_tpu.server.server import ServerInstance
+
+        rng = np.random.default_rng(17)
+        fact, parts, _ = _data(rng)
+        fact_schema, parts_schema, _ = _schemas()
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "ds"))
+        servers = [
+            ServerInstance(f"server_{i}", registry, str(tmp_path / f"s{i}"),
+                           device_executor=None)
+            for i in range(2)
+        ]
+        for s in servers:
+            s.start()
+        broker = Broker(registry, timeout_s=15.0)
+        try:
+            dim_cfg = TableConfig(table_name="parts", is_dim_table=True)
+            controller.add_table(dim_cfg, parts_schema)
+            build_segment(parts_schema, parts, str(tmp_path / "pup"),
+                          dim_cfg, "p0")
+            controller.upload_segment("parts", str(tmp_path / "pup"))
+            fact_cfg = TableConfig(table_name="orders")
+            controller.add_table(fact_cfg, fact_schema)
+            half = N_FACT // 2
+            for i, sl in enumerate([slice(0, half), slice(half, N_FACT)]):
+                build_segment(fact_schema,
+                              {k: v[sl] for k, v in fact.items()},
+                              str(tmp_path / f"fup{i}"), fact_cfg, f"f{i}")
+                controller.upload_segment("orders",
+                                          str(tmp_path / f"fup{i}"))
+            assert _wait_until(lambda: all(
+                "parts_OFFLINE" in s.engine.tables
+                and s.engine.tables["parts_OFFLINE"].segments
+                for s in servers))
+            assert _wait_until(lambda: len(
+                registry.external_view("orders_OFFLINE")) == 2)
+
+            # oracle: embedded engine over the same data
+            emb = QueryEngine(device_executor=None)
+            emb.add_segment("orders", build_segment(
+                fact_schema, fact, str(tmp_path / "femb"), fact_cfg, "fe"))
+            emb.add_segment("parts", build_segment(
+                parts_schema, parts, str(tmp_path / "pemb"), dim_cfg,
+                "pe"))
+            sql = ("SELECT p.category, COUNT(*), SUM(o.qty) FROM orders o "
+                   "JOIN parts p ON o.partkey = p.pkey "
+                   "WHERE o.status = 'paid' "
+                   "GROUP BY p.category ORDER BY p.category")
+            got = broker.execute(sql)
+            assert not got.get("exceptions"), got
+            assert got["joinStrategy"] == "BROADCAST"
+            assert got["numStages"] == 2
+            assert _rows(got) == _rows(emb.execute(sql))
+        finally:
+            broker.close()
+            for s in servers:
+                s.stop()

@@ -1,0 +1,286 @@
+"""Multi-stage engine v2: window functions — differential suite.
+
+ROW_NUMBER / RANK / DENSE_RANK / SUM / AVG / COUNT / MIN / MAX over
+``OVER (PARTITION BY ... ORDER BY ...)`` agree across the device kernel
+(ops/window.py: one sort + segmented scans), the host numpy mirror, and a
+sqlite3 oracle (sqlite >= 3.25 implements standard window semantics,
+including the RANGE UNBOUNDED PRECEDING .. CURRENT ROW default frame with
+peer rows sharing frame values). Runs on sealed + consuming segments and
+on solo + 8-virtual-device mesh engines.
+"""
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.engine.device import DeviceExecutor
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.parallel.mesh import make_mesh
+from pinot_tpu.storage.creator import build_segment
+
+N = 3000
+
+
+def _schema():
+    return Schema.build(
+        name="trades",
+        dimensions=[("sym", DataType.STRING), ("venue", DataType.STRING),
+                    ("ts", DataType.LONG)],
+        metrics=[("px", DataType.DOUBLE), ("size", DataType.INT)],
+    )
+
+
+def _data(rng):
+    return {
+        "sym": np.array([f"sym_{i}" for i in range(12)])[
+            rng.integers(0, 12, N)],
+        "venue": np.array(["A", "B", "C"])[rng.integers(0, 3, N)],
+        # unique per row: the deterministic ORDER BY tie-break
+        "ts": np.arange(N, dtype=np.int64) * 10 + 5,
+        "px": np.round(rng.uniform(5.0, 250.0, N), 2),
+        "size": rng.integers(1, 500, N).astype(np.int32),
+    }
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    rng = np.random.default_rng(23)
+    data = _data(rng)
+    base = tmp_path_factory.mktemp("winseg")
+    engines = {}
+    for name, dev in (("host", None), ("device", "auto"),
+                      ("mesh", DeviceExecutor(mesh=make_mesh(8)))):
+        eng = QueryEngine(device_executor=dev)
+        half = N // 2
+        for i, sl in enumerate([slice(0, half), slice(half, N)]):
+            eng.add_segment("trades", build_segment(
+                _schema(), {k: v[sl] for k, v in data.items()},
+                str(base / f"t{name}{i}"), TableConfig(table_name="trades"),
+                f"t{i}"))
+        engines[name] = eng
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE trades (sym TEXT, venue TEXT, ts INT, "
+                "px REAL, size INT)")
+    con.executemany(
+        "INSERT INTO trades VALUES (?,?,?,?,?)",
+        list(zip(*(data[c].tolist() for c in
+                   ("sym", "venue", "ts", "px", "size")))))
+    return engines, con
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return v
+
+
+def _rows(resp):
+    assert not resp.get("exceptions"), resp.get("exceptions")
+    return [[_norm(v) for v in r] for r in resp["resultTable"]["rows"]]
+
+
+def check(setup, sql, oracle_sql=None,
+          engines=("host", "device", "mesh")):
+    eng_map, con = setup
+    expected = [[_norm(v) for v in r]
+                for r in con.execute(oracle_sql or sql).fetchall()]
+    for name in engines:
+        got = _rows(eng_map[name].execute(sql))
+        assert got == expected, (
+            f"{name} mismatch for {sql!r}:\n"
+            f"got      {got[:5]}\nexpected {expected[:5]}")
+
+
+class TestWindowParity:
+    def test_row_number(self, setup):
+        check(setup,
+              "SELECT sym, ts, ROW_NUMBER() OVER (PARTITION BY sym "
+              "ORDER BY ts) FROM trades WHERE size > 480 "
+              "ORDER BY sym, ts LIMIT 40")
+
+    def test_rank_dense_rank_with_ties(self, setup):
+        # venue has heavy ties per sym: rank/dense_rank diverge
+        check(setup,
+              "SELECT sym, venue, RANK() OVER (PARTITION BY sym "
+              "ORDER BY venue), DENSE_RANK() OVER (PARTITION BY sym "
+              "ORDER BY venue) FROM trades WHERE size > 470 "
+              "ORDER BY sym, venue, ts LIMIT 50")
+
+    def test_running_sum(self, setup):
+        check(setup,
+              "SELECT sym, ts, SUM(size) OVER (PARTITION BY sym "
+              "ORDER BY ts) FROM trades WHERE size > 450 "
+              "ORDER BY sym, ts LIMIT 60")
+
+    def test_running_sum_peers_share_frame(self, setup):
+        # ORDER BY a tied key: peers must share the frame value (RANGE
+        # default frame) — the classic running-sum-with-ties trap
+        check(setup,
+              "SELECT sym, venue, SUM(size) OVER (PARTITION BY sym "
+              "ORDER BY venue) FROM trades WHERE size > 480 "
+              "ORDER BY sym, venue, ts LIMIT 50")
+
+    def test_avg_count_min_max(self, setup):
+        check(setup,
+              "SELECT sym, ts, AVG(px) OVER (PARTITION BY sym "
+              "ORDER BY ts), COUNT(px) OVER (PARTITION BY sym "
+              "ORDER BY ts), MIN(px) OVER (PARTITION BY sym "
+              "ORDER BY ts), MAX(px) OVER (PARTITION BY sym ORDER BY ts) "
+              "FROM trades WHERE size > 460 ORDER BY sym, ts LIMIT 60")
+
+    def test_partition_total_no_order(self, setup):
+        # no ORDER BY in the window: the frame is the whole partition
+        check(setup,
+              "SELECT sym, ts, SUM(size) OVER (PARTITION BY sym) "
+              "FROM trades WHERE size > 470 ORDER BY sym, ts LIMIT 50")
+
+    def test_no_partition_global_window(self, setup):
+        check(setup,
+              "SELECT ts, ROW_NUMBER() OVER (ORDER BY ts) "
+              "FROM trades WHERE size > 490 ORDER BY ts LIMIT 40")
+
+    def test_descending_order(self, setup):
+        check(setup,
+              "SELECT sym, ts, ROW_NUMBER() OVER (PARTITION BY sym "
+              "ORDER BY ts DESC) FROM trades WHERE size > 480 "
+              "ORDER BY sym, ts LIMIT 40")
+
+    def test_multi_key_partition_and_order(self, setup):
+        check(setup,
+              "SELECT sym, venue, ts, ROW_NUMBER() OVER (PARTITION BY "
+              "sym, venue ORDER BY px DESC, ts) FROM trades "
+              "WHERE size > 475 ORDER BY sym, venue, ts LIMIT 50")
+
+    def test_count_star_window(self, setup):
+        check(setup,
+              "SELECT sym, ts, COUNT(*) OVER (PARTITION BY sym "
+              "ORDER BY ts) FROM trades WHERE size > 480 "
+              "ORDER BY sym, ts LIMIT 40")
+
+    def test_window_in_expression(self, setup):
+        check(setup,
+              "SELECT sym, ts, ROW_NUMBER() OVER (PARTITION BY sym "
+              "ORDER BY ts) + 100 FROM trades WHERE size > 485 "
+              "ORDER BY sym, ts LIMIT 30")
+
+    def test_order_by_window_result(self, setup):
+        check(setup,
+              "SELECT sym, ts, SUM(size) OVER (PARTITION BY sym "
+              "ORDER BY ts) FROM trades WHERE size > 480 "
+              "ORDER BY SUM(size) OVER (PARTITION BY sym ORDER BY ts), "
+              "sym, ts LIMIT 30")
+
+    def test_window_over_join(self, setup, tmp_path_factory):
+        # window over joined rows: rank trades within each category
+        eng_map, con = setup
+        base = tmp_path_factory.mktemp("windim")
+        dim_schema = Schema.build(
+            name="symbols",
+            dimensions=[("symbol", DataType.STRING),
+                        ("sector", DataType.STRING)],
+            primary_key_columns=["symbol"])
+        dim = {
+            "symbol": np.array([f"sym_{i}" for i in range(12)]),
+            "sector": np.array([f"sec_{i % 4}" for i in range(12)]),
+        }
+        for i, (name, eng) in enumerate(eng_map.items()):
+            eng.add_segment("symbols", build_segment(
+                dim_schema, dim, str(base / f"d{i}"),
+                TableConfig(table_name="symbols", is_dim_table=True),
+                "d0"))
+        con.execute("CREATE TABLE IF NOT EXISTS symbols "
+                    "(symbol TEXT, sector TEXT)")
+        con.execute("DELETE FROM symbols")
+        con.executemany("INSERT INTO symbols VALUES (?,?)",
+                        list(zip(dim["symbol"].tolist(),
+                                 dim["sector"].tolist())))
+        check(setup,
+              "SELECT s.sector, t.ts, ROW_NUMBER() OVER (PARTITION BY "
+              "s.sector ORDER BY t.ts) FROM trades t "
+              "JOIN symbols s ON t.sym = s.symbol WHERE t.size > 485 "
+              "ORDER BY s.sector, t.ts LIMIT 40")
+
+
+class TestWindowConsuming:
+    def test_consuming_segment_parity(self, tmp_path):
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        rng = np.random.default_rng(29)
+        data = _data(rng)
+        half = N // 2
+        con = sqlite3.connect(":memory:")
+        con.execute("CREATE TABLE trades (sym TEXT, venue TEXT, ts INT, "
+                    "px REAL, size INT)")
+        con.executemany(
+            "INSERT INTO trades VALUES (?,?,?,?,?)",
+            list(zip(*(data[c].tolist() for c in
+                       ("sym", "venue", "ts", "px", "size")))))
+        sql = ("SELECT sym, ts, ROW_NUMBER() OVER (PARTITION BY sym "
+               "ORDER BY ts), SUM(size) OVER (PARTITION BY sym "
+               "ORDER BY ts) FROM trades WHERE size > 460 "
+               "ORDER BY sym, ts LIMIT 60")
+        expected = [[_norm(v) for v in r]
+                    for r in con.execute(sql).fetchall()]
+        for name, dev in (("host", None), ("device", "auto")):
+            eng = QueryEngine() if dev else QueryEngine(device_executor=None)
+            eng.add_segment("trades", build_segment(
+                _schema(), {k: v[:half] for k, v in data.items()},
+                str(tmp_path / f"w{name}"), TableConfig(table_name="trades"),
+                "t0"))
+            ms = MutableSegment(_schema(), "trades__0__0__rt")
+            ms.index_batch([{k: data[k][i].item() for k in data}
+                            for i in range(half, N)])
+            eng.add_segment("trades", ms)
+            got = _rows(eng.execute(sql))
+            assert got == expected, name
+
+
+class TestWindowErrors:
+    def test_window_with_group_by_rejected(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT sym, SUM(size), ROW_NUMBER() OVER (ORDER BY sym) "
+            "FROM trades GROUP BY sym")
+        assert "GROUP BY" in r["exceptions"][0]["message"]
+
+    def test_window_in_where_rejected(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT sym FROM trades "
+            "WHERE ROW_NUMBER() OVER (ORDER BY ts) < 5")
+        assert r["exceptions"]
+
+    def test_explicit_frame_rejected(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT SUM(size) OVER (ORDER BY ts ROWS BETWEEN 1 "
+            "PRECEDING AND CURRENT ROW) FROM trades")
+        assert "frame" in r["exceptions"][0]["message"]
+
+    def test_unknown_window_function(self, setup):
+        eng_map, _ = setup
+        r = eng_map["host"].execute(
+            "SELECT NTILE(4) OVER (ORDER BY ts) FROM trades")
+        assert "not a window function" in r["exceptions"][0]["message"]
+
+
+class TestExplainWindow:
+    def test_explain_window_lines(self, setup):
+        eng_map, _ = setup
+        r = eng_map["device"].execute(
+            "EXPLAIN PLAN FOR SELECT sym, ROW_NUMBER() OVER "
+            "(PARTITION BY sym ORDER BY ts DESC) FROM trades")
+        lines = [row[0] for row in r["resultTable"]["rows"]]
+        assert any("WINDOW(row_number() OVER (PARTITION BY trades.sym "
+                   "ORDER BY trades.ts DESC))" in ln for ln in lines)
+        assert any("STAGE_2_SELECT_WINDOW" in ln for ln in lines)
