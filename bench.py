@@ -737,6 +737,11 @@ def bench_micro():
         lambda p: jnp.sum(unpack_subbyte(p, 4) == 3, dtype=jnp.int64),
         packed_nu), N // 2)
 
+    # on-device final reduce: sort-based ORDER BY trim over a group table
+    # (ops/device_reduce.py — the kernel that replaced the host
+    # BrokerReduceService walk + full-table fetch)
+    out["device_trim_topk"] = _trim_topk_micro()
+
     # bit-unpack: host C++ forward-index decode (native/packer.cpp)
     try:
         from pinot_tpu import native as native_bitpack
@@ -757,6 +762,52 @@ def bench_micro():
     except Exception as e:  # noqa: BLE001 — optional native path
         out["bit_unpack_cpp"] = {"error": f"{type(e).__name__}: {e}"}
     return out
+
+
+def _trim_topk_micro(G: int = 4_000_000, K: int = 8192):
+    """device_trim_topk micro: the on-device final reduce's core — sort a
+    G-row group table by (present, key desc, slot) and gather the top-K
+    rows (ops/device_reduce.py apply_trim shape). Inputs synthesized on
+    device; rate is table rows per second."""
+    import jax
+    import jax.numpy as jnp
+
+    from pinot_tpu.engine.device import amortized_launch_time
+    from pinot_tpu.ops import hll as hll_ops
+
+    def synth(_):
+        i = jnp.arange(G, dtype=jnp.int32)
+        h = hll_ops.hash32(i)
+        counts = (h & 0xFFFF).astype(jnp.int64)
+        sums = (h >> 3).astype(jnp.float64)
+        return counts, sums
+
+    counts, sums = jax.jit(synth)(0)
+    jax.device_get(jnp.sum(counts[:1]))
+
+    def trim(c, s):
+        ops = (jnp.where(c > 0, jnp.int32(0), jnp.int32(1)),
+               -c, jnp.arange(G, dtype=jnp.int64))
+        srt = jax.lax.sort(ops, num_keys=3)
+        perm = srt[2][:K]
+        return c[perm], s[perm]
+
+    g = jax.jit(trim)
+
+    def timed(k):
+        o = None
+        t0 = time.perf_counter()
+        for _ in range(k):
+            o = g(counts, sums)
+        jax.device_get(jnp.sum(o[0][:1].astype(jnp.float32)))
+        return time.perf_counter() - t0
+
+    secs = max(1e-9, amortized_launch_time(timed, base_iters=3))
+    return {
+        "ms": round(secs * 1e3, 2),
+        "mrows_per_s": round(G / secs / 1e6, 1),
+        "gbps": round(16 * G / secs / 1e9, 1),  # int64 key + f64 payload
+    }
 
 
 def bench_concurrency(engine, sql, levels=(1, 4, 8), iters_per_thread=4):
@@ -802,10 +853,16 @@ def bench_concurrency(engine, sql, levels=(1, 4, 8), iters_per_thread=4):
         return wall, [x for lat in lats for x in lat]
 
     dev = engine.device
+    cache_was = None
     if dev is not None:
         # profile capture pins launches and disables coalescing — the
-        # sweep must measure the production execute path
+        # sweep must measure the production execute path. The partials
+        # cache is disabled for the sweep: this detail measures the
+        # launch/fetch OVERLAP machinery (comparable across rounds);
+        # cache-hot steady-state QPS is detail.subrtt's metric.
         dev.profile_enabled = False
+        cache_was = dev.partials_cache_enabled
+        dev.partials_cache_enabled = False
     run_level(1, 2)  # warm (compile + batch caches)
     out = {}
     qps1 = None
@@ -827,13 +884,17 @@ def bench_concurrency(engine, sql, levels=(1, 4, 8), iters_per_thread=4):
             entry["overlap_efficiency"] = round(n * qps1 / qps, 2)
         out[f"n{n}"] = entry
     co = getattr(dev, "coalescer", None) if dev is not None else None
-    c0 = (co.cohorts_launched, co.queries_coalesced) if co else (0, 0)
+    c0 = (co.cohorts_launched, co.queries_coalesced, co.stream_windows) \
+        if co else (0, 0, 0)
     _, lat = run_level(8, 1)
     out["coalesced_cohort_p50_ms"] = round(
         float(np.percentile(lat, 50)) * 1e3, 2)
     if co is not None:
         out["cohorts_launched"] = co.cohorts_launched - c0[0]
         out["queries_coalesced"] = co.queries_coalesced - c0[1]
+        out["stream_windows"] = co.stream_windows - c0[2]
+    if dev is not None and cache_was is not None:
+        dev.partials_cache_enabled = cache_was
     return out
 
 
@@ -1148,6 +1209,12 @@ _MICRO_R05_REFERENCE = {
     # unpack + EQ mask reads 0.5 bytes/row — conservative embedded floor
     # until a recorded reference takes over
     "narrow_unpack": 800.0,
+    # first recorded round 12 (sub-RTT serving): the on-device final
+    # reduce's sort-based top-K over a 4M-row group table (3 sort
+    # operands + trimmed gather). Conservative embedded floor — a 2-core
+    # CPU box runs ~3x it, a TPU far above — until a recorded reference
+    # takes over
+    "device_trim_topk": 0.5,
 }
 
 
@@ -1324,6 +1391,274 @@ def bench_join(n_fact: int = 300_000, iters: int = 5):
     finally:
         shutil.rmtree(base, ignore_errors=True)
     return detail, violations
+
+
+# r05 had no concurrency detail (the sweep landed in r06): the embedded
+# reference is the serialized-RTT figure its suite implies — one q2-shape
+# query per ~115ms p50 ≈ 8.7 qps — the basis the ROADMAP's "5x the r05
+# bench_concurrency figure at N=8" acceptance measures against. A
+# recorded r05 concurrency.n8.qps value, when parseable, always wins.
+_SUBRTT_QPS8_R05_REF = 8.7
+# served-p50 gate floor: on a PCIe-local/CPU box link_floor is ~0, and
+# 1.25x of ~nothing would gate pure host-side decode work; the absolute
+# term covers compile-cache lookup + trim decode + result encode. On the
+# tunneled bench box (link_floor ~90-100ms) the RTT term dominates.
+_SUBRTT_ABS_FLOOR_MS = 25.0
+
+
+def _load_r05_concurrency_qps8():
+    """r05 concurrency qps at N=8 from BENCH_r05.json (wrapper/stdout
+    tolerance lives in ONE place: tools/benchdiff.load_round), else the
+    embedded reference."""
+    path = os.environ.get(
+        "PINOT_TPU_MICRO_REF",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_r05.json"))
+    try:
+        from pinot_tpu.tools.benchdiff import load_round
+
+        conc = load_round(path).get("concurrency")
+        qps = conc["n8"]["qps"] if isinstance(conc, dict) else None
+        if isinstance(qps, (int, float)) and qps > 0:
+            return float(qps), path
+    except (OSError, ValueError, KeyError, TypeError, AttributeError):
+        pass
+    return _SUBRTT_QPS8_R05_REF, "embedded"
+
+
+def bench_subrtt(n_rows: int = 1_000_000, iters: int = 11):
+    """detail.subrtt: the sub-RTT serving phase (ISSUE 9). Gates
+
+    - served-p50 for a repeat scalar aggregation (device partials cache
+      warm) at or under ~1 RTT: ``served_p50_ms <=
+      max(1.25 * link_floor_ms, 25ms)`` — one link round trip and host
+      decode, no gather/kernel;
+    - steady-state QPS at N=8 >= 5x the r05 concurrency reference;
+    - device-reduce vs host-reduce parity across scalar, group-by
+      (trimmed top-K), sealed + consuming(chunklet), solo + mesh (when
+      >=2 devices), and cache-hit vs cache-miss paths — every violation
+      fails the phase;
+    - the trimmed group-by fetch must move FEWER bytes than the
+      untrimmed form (the tentpole's whole point).
+
+    Standalone: ``python -m bench --phase subrtt`` exits 7 on violation
+    (faults=4 / observability=5 / join=6)."""
+    import shutil
+    import tempfile
+    import threading
+
+    import jax
+
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import ChunkletConfig, TableConfig
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.storage.creator import build_segment
+    from pinot_tpu.storage.mutable import MutableSegment
+
+    rng = np.random.default_rng(41)
+    zones = np.array([f"zone_{i:03d}" for i in range(400)])
+    z_ids = rng.integers(0, 400, n_rows)
+    data = {
+        "zone": zones[z_ids],
+        "hour": rng.integers(0, 24, n_rows).astype(np.int32),
+        "fare": rng.integers(1, 10_000, n_rows).astype(np.int64),
+    }
+    schema = Schema.build(
+        name="subrtt",
+        dimensions=[("zone", DataType.STRING)],
+        metrics=[("hour", DataType.INT), ("fare", DataType.LONG)])
+    cfg = TableConfig(table_name="subrtt")
+
+    SQL_SCALAR = ("SELECT SUM(fare), COUNT(*) FROM subrtt "
+                  "WHERE hour BETWEEN 2 AND 20")
+    SQL_TOPK = ("SELECT zone, COUNT(*), SUM(fare) FROM subrtt "
+                "GROUP BY zone ORDER BY SUM(fare) DESC, zone LIMIT 10")
+    PARITY_SQLS = [
+        SQL_SCALAR,
+        SQL_TOPK,
+        "SELECT zone, AVG(fare) FROM subrtt WHERE hour < 12 "
+        "GROUP BY zone ORDER BY AVG(fare) LIMIT 7",
+        "SELECT zone, COUNT(*) FROM subrtt GROUP BY zone LIMIT 12",
+        "SELECT zone, MINMAXRANGE(fare) FROM subrtt "
+        "GROUP BY zone ORDER BY MINMAXRANGE(fare) DESC, zone LIMIT 5",
+    ]
+
+    def _off(sql):
+        return "SET useDeviceReduce=false; SET usePartialsCache=false; " + sql
+
+    base = tempfile.mkdtemp(prefix="bench_subrtt_")
+    detail: dict = {}
+    violations: list = []
+    try:
+        eng = QueryEngine()
+        host = QueryEngine(device_executor=None)
+        n_segs = 4
+        for i in range(n_segs):
+            sl = slice(i * n_rows // n_segs, (i + 1) * n_rows // n_segs)
+            seg = build_segment(
+                schema, {k: v[sl] for k, v in data.items()},
+                os.path.join(base, f"s{i}"), cfg, f"s{i}")
+            eng.add_segment("subrtt", seg)
+            host.add_segment("subrtt", seg)
+        dev = eng.device
+
+        link_floor_ms = round(measure_link_floor() * 1e3, 2)
+
+        def rows_of(e, sql):
+            r = e.execute(sql)
+            if r.get("exceptions"):
+                raise RuntimeError(f"subrtt query failed: {sql!r}: "
+                                   f"{r['exceptions']}")
+            return r["resultTable"]["rows"]
+
+        # ---- parity matrix: device-reduce vs host-reduce, hit vs miss --
+        for sql in PARITY_SQLS:
+            want = rows_of(host, sql)
+            got_on = rows_of(eng, sql)       # device reduce + cache (miss)
+            got_hit = rows_of(eng, sql)      # cache HIT path
+            got_off = rows_of(eng, _off(sql))  # untrimmed device form
+            for name, got in (("device", got_on), ("cache_hit", got_hit),
+                              ("reduce_off", got_off)):
+                if got != want:
+                    violations.append({
+                        "gate": f"parity:{name}", "sql": sql,
+                        "got": got[:3], "want": want[:3]})
+        if dev.partials_hits < len(PARITY_SQLS):
+            violations.append({"gate": "cache_hits",
+                               "hits": dev.partials_hits,
+                               "expected_at_least": len(PARITY_SQLS)})
+
+        # mesh parity (>=2 devices only; the driver's multichip harness
+        # covers the full mesh sweep)
+        if jax.device_count() >= 2:
+            from pinot_tpu.engine.device import DeviceExecutor
+            from pinot_tpu.parallel.mesh import make_mesh
+            from pinot_tpu.storage.segment import ImmutableSegment
+
+            mesh_eng = QueryEngine(device_executor=DeviceExecutor(
+                mesh=make_mesh(jax.device_count())))
+            for i in range(n_segs):
+                mesh_eng.add_segment(
+                    "subrtt", ImmutableSegment(os.path.join(base, f"s{i}")))
+            for sql in (SQL_TOPK, SQL_SCALAR):
+                if rows_of(mesh_eng, sql) != rows_of(host, sql):
+                    violations.append({"gate": "parity:mesh", "sql": sql})
+            detail["mesh_devices"] = jax.device_count()
+        else:
+            detail["mesh_devices"] = 0
+
+        # consuming (chunklet) parity: sealed-prefix device blocks + host
+        # tail, trimmed vs host engine
+        rt_cfg = TableConfig(
+            table_name="subrtt_rt",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=65_536,
+                                     device_min_rows=0))
+        mseg = MutableSegment(schema, "subrtt_rt__0__0__0", rt_cfg)
+        n_rt = 150_000
+        rt_rows = [{"zone": str(data["zone"][i]),
+                    "hour": int(data["hour"][i]),
+                    "fare": int(data["fare"][i])} for i in range(n_rt)]
+        for off in range(0, n_rt, 8192):
+            mseg.index_batch(rt_rows[off:off + 8192])
+        mseg.chunklet_index.promote()
+        rt_eng = QueryEngine()
+        rt_host = QueryEngine(device_executor=None)
+        rt_eng.table("subrtt_rt").add_segment(mseg)
+        rt_host.table("subrtt_rt").add_segment(mseg)
+        rt_sql = ("SELECT zone, COUNT(*), SUM(fare) FROM subrtt_rt "
+                  "GROUP BY zone ORDER BY SUM(fare) DESC, zone LIMIT 10")
+        if rows_of(rt_eng, rt_sql) != rows_of(rt_host, rt_sql):
+            violations.append({"gate": "parity:consuming", "sql": rt_sql})
+
+        # ---- trimmed fetch bytes: the tentpole's byte shrink -----------
+        b0 = dev.fetch_bytes_total
+        rows_of(eng, "SET usePartialsCache=false; " + SQL_TOPK)
+        trimmed_bytes = dev.fetch_bytes_total - b0
+        b0 = dev.fetch_bytes_total
+        rows_of(eng, _off(SQL_TOPK))
+        untrimmed_bytes = dev.fetch_bytes_total - b0
+        detail["fetch_bytes_trimmed"] = int(trimmed_bytes)
+        detail["fetch_bytes_untrimmed"] = int(untrimmed_bytes)
+        if trimmed_bytes >= untrimmed_bytes:
+            violations.append({"gate": "trimmed_fetch_bytes",
+                               "trimmed": int(trimmed_bytes),
+                               "untrimmed": int(untrimmed_bytes)})
+
+        # ---- served p50: repeat scalar agg, partials cache warm --------
+        rows_of(eng, SQL_SCALAR)  # warm (cache insert)
+        lat = run_samples(eng, SQL_SCALAR, iters)
+        served_p50 = float(np.percentile(lat, 50)) * 1e3
+        gate_ms = max(1.25 * link_floor_ms, _SUBRTT_ABS_FLOOR_MS)
+        detail["served_p50_ms"] = round(served_p50, 2)
+        detail["link_floor_ms"] = link_floor_ms
+        detail["served_p50_gate_ms"] = round(gate_ms, 2)
+        if served_p50 > gate_ms:
+            violations.append({"gate": "served_p50",
+                               "served_p50_ms": round(served_p50, 2),
+                               "bound_ms": round(gate_ms, 2)})
+
+        # ---- steady-state QPS at N=8 (cache-hot repeat stream) ---------
+        def run_qps(n_threads, iters_per):
+            barrier = threading.Barrier(n_threads + 1)
+            errs = []
+
+            def worker():
+                try:
+                    barrier.wait()
+                    for _ in range(iters_per):
+                        r = eng.execute(SQL_SCALAR)
+                        if r.get("exceptions"):
+                            errs.append(str(r["exceptions"])[:200])
+                            return
+                except Exception as e:  # noqa: BLE001
+                    errs.append(repr(e))
+
+            ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+            for t in ts:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in ts:
+                t.join()
+            wall = time.perf_counter() - t0
+            if errs:
+                raise RuntimeError(f"subrtt qps sweep failed: {errs[0]}")
+            return n_threads * iters_per / wall
+
+        run_qps(8, 2)  # warm the concurrent path
+        qps8 = run_qps(8, 6)
+        ref_qps, ref_src = _load_r05_concurrency_qps8()
+        detail["qps8"] = round(qps8, 2)
+        detail["qps8_reference"] = {"r05_qps8": ref_qps, "source": ref_src,
+                                    "required_x": 5.0}
+        if qps8 < 5.0 * ref_qps:
+            violations.append({"gate": "qps8", "qps8": round(qps8, 2),
+                               "required": round(5.0 * ref_qps, 2)})
+
+        # ---- cache + reduce observability snapshot ---------------------
+        hbm = dev.hbm_stats()
+        detail["partials_cache"] = {
+            k.replace("partials_cache_", ""): hbm[k]
+            for k in ("partials_cache_entries", "partials_cache_bytes",
+                      "partials_cache_hits", "partials_cache_misses",
+                      "partials_cache_evictions",
+                      "partials_cache_invalidations")}
+        detail["device_reduce"] = {
+            "queries": hbm["device_reduce_queries"],
+            "ms_total": hbm["device_reduce_ms"]}
+        detail["micro_device_trim_topk"] = _trim_topk_micro(G=1_000_000)
+        detail["note"] = (
+            "served_p50 is the cache-hot repeat scalar aggregation "
+            "(device partials cache hit: one link RTT + host decode, no "
+            "gather/kernel); gate = max(1.25*link_floor, 25ms abs floor "
+            "for RTT-free boxes). qps8 = 8-thread cache-hot steady "
+            "state vs 5x the r05 reference. fetch_bytes_* compare the "
+            "top-K group-by's packed buffer with the on-device trim on "
+            "vs off.")
+        return detail, violations
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
 
 
 def bench_faults(n_queries: int = 40):
@@ -1584,6 +1919,12 @@ def bench_observability(n_queries: int = 24):
         for i in range(2)
     ]
     for s in servers:
+        # the phase waterfall must keep observing gather/kernel/link on
+        # every iteration: partials-cache hits skip those phases and
+        # would hollow out the round-over-round breakdown this phase
+        # exists to record (cache-hot latency is detail.subrtt's metric)
+        if s.engine.device is not None:
+            s.engine.device.partials_cache_enabled = False
         s.start()
     broker = Broker(registry, timeout_s=30.0)
     try:
@@ -1793,11 +2134,22 @@ def main():
 
     ap = argparse.ArgumentParser(description="pinot-tpu bench")
     ap.add_argument(
-        "--phase", choices=("full", "faults", "observability", "join"),
+        "--phase",
+        choices=("full", "faults", "observability", "join", "subrtt"),
         default="full",
-        help="'faults' / 'observability' / 'join' run ONLY that phase "
-             "(no dataset build) so CI can gate on each standalone")
+        help="'faults' / 'observability' / 'join' / 'subrtt' run ONLY "
+             "that phase (no dataset build) so CI can gate on each "
+             "standalone")
     args = ap.parse_args()
+    if args.phase == "subrtt":
+        detail, violations = bench_subrtt()
+        print(json.dumps({"metric": "subrtt-phase standalone",
+                          "detail": {"subrtt": detail}}))
+        if violations:
+            print(f"subrtt gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(7)
+        return
     if args.phase == "join":
         detail, violations = bench_join()
         print(json.dumps({"metric": "join-phase standalone",
@@ -1873,6 +2225,7 @@ def main():
     faults_detail, faults_violations = bench_faults()
     observability_detail, observability_violations = bench_observability()
     join_detail, join_violations = bench_join()
+    subrtt_detail, subrtt_violations = bench_subrtt()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -1931,6 +2284,7 @@ def main():
                     "faults": faults_detail,
                     "observability": observability_detail,
                     "join": join_detail,
+                    "subrtt": subrtt_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -2000,6 +2354,10 @@ def main():
         print(f"join gate FAILED: {json.dumps(join_violations)}",
               file=sys.stderr)
         sys.exit(6)
+    if subrtt_violations:
+        print(f"subrtt gate FAILED: {json.dumps(subrtt_violations)}",
+              file=sys.stderr)
+        sys.exit(7)
 
 
 if __name__ == "__main__":

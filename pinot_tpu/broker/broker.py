@@ -1265,6 +1265,10 @@ class Broker:
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,
                 "numGroupsLimitReached": stats.num_groups_limit_reached,
+                # any server partial answered from its device partials
+                # cache (sub-RTT serving; querylog --per-template
+                # aggregates this into per-template hit rates)
+                "partialsCacheHit": stats.partials_cache_hit,
                 # summed across servers, like the reference's V3 metadata
                 "threadCpuTimeNs": stats.thread_cpu_time_ns,
                 "schedulerWaitMs": round(stats.scheduler_wait_ms, 3),

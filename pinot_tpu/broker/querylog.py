@@ -164,6 +164,7 @@ class QueryLogger:
                     "numSegmentsPrunedByBroker",
                     "numSegmentsPrunedByServer", "numBlocksPruned",
                     "numDocsScanned", "numGroupsLimitReached",
+                    "partialsCacheHit",
                 ) if resp.get(k) is not None
             },
         }

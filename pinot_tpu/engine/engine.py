@@ -216,6 +216,7 @@ class QueryEngine:
                 "numSegmentsPrunedByServer": stats.num_segments_pruned,
                 "numBlocksPruned": stats.num_blocks_pruned,
                 "numGroupsLimitReached": stats.num_groups_limit_reached,
+                "partialsCacheHit": stats.partials_cache_hit,
                 "totalDocs": stats.total_docs,
                 "timeUsedMs": round((time.time() - t0) * 1000, 3),
             }
@@ -236,7 +237,8 @@ class QueryEngine:
         finally:
             tdm.release(segments)
 
-    def execute_segments(self, q: QueryContext, segments, terminal: bool = False):
+    def execute_segments(self, q: QueryContext, segments, terminal: bool = False,
+                         trim_ok: bool = True):
         """Server-side partial execution over an explicit segment list →
         merged (unfinalized) IntermediateResult — what a server ships to the
         broker as a DataTable (ServerQueryExecutorV1Impl.processQuery).
@@ -245,12 +247,18 @@ class QueryEngine:
         will merge this result, so when the device batch is the SOLE
         partial, sketch aggregations may finalize on device and skip
         shipping G×m mergeable state over the host link. Server-shipped
-        partials stay mergeable (the broker combines them)."""
-        return self.execute_segments_async(q, segments, terminal)()
+        partials stay mergeable (the broker combines them).
+
+        ``trim_ok=False`` disables the on-device final reduce for callers
+        whose finalize runs under a DIFFERENT QueryContext than the one
+        executed here (star-tree substitution plans)."""
+        return self.execute_segments_async(q, segments, terminal,
+                                           trim_ok=trim_ok)()
 
     def execute_segments_async(self, q: QueryContext, segments,
                                terminal: bool = False, fallback_gate=None,
-                               deadline=None, tracer=None):
+                               deadline=None, tracer=None,
+                               trim_ok: bool = True):
         """LAUNCH phase of execute_segments → zero-arg fetch() closure.
 
         ``tracer`` (common/trace.py Tracer, optional): the query's
@@ -376,9 +384,17 @@ class QueryEngine:
             if self.device is not None and groups:
                 # device finalize is safe only when ONE device batch is the
                 # whole answer: no host segments, no star-tree/metadata
-                # partials, no second batch to merge with
-                final = (terminal and not results and not host_segs
-                         and len(groups) == 1)
+                # partials, no second batch to merge with. The same
+                # sole-partial condition gates the on-device final reduce
+                # (ops/device_reduce.py): "terminal" when nothing merges
+                # after (exact trim to offset+limit), "partial" when a
+                # broker still combines server partials (the
+                # trim_group_by keep bound, ORDER BY only).
+                sole = (not results and not host_segs and len(groups) == 1)
+                final = terminal and sole
+                reduce_mode = None
+                if trim_ok and sole:
+                    reduce_mode = "terminal" if terminal else "partial"
                 try:
                     for g in groups:
                         # the sealed group's Level-1 verdicts were already
@@ -391,7 +407,8 @@ class QueryEngine:
                             if g is device_sealed else None
                         handle = self.device.launch(q, g, final=final,
                                                     alive=hint,
-                                                    tracer=tracer)
+                                                    tracer=tracer,
+                                                    reduce_mode=reduce_mode)
                         handle.deadline = deadline
                         device_handles.append((handle, g))
                 except DeviceUnsupported:

@@ -194,6 +194,50 @@ def explain_plan(engine, q: QueryContext) -> dict:
     else:
         lines.append("    FILTER_MATCH_ENTIRE_SEGMENT")
     lines.append("    PROJECT(" + ", ".join(sorted(q.columns())) + ")")
+    if backend.startswith("DEVICE"):
+        # sub-RTT serving surfaces (ISSUE 9): the on-device final reduce
+        # (when the query's ORDER/LIMIT shape supports an in-kernel trim)
+        # and the device partials cache state
+        dev = engine.device
+        if q.group_by and not q.distinct:
+            from pinot_tpu.ops.device_reduce import plan_trim, trim_keep_count
+
+            # render the trim only when it would actually engage: the
+            # static bound must sit BELOW the real group-table length
+            # (product of cardinalities from a THROWAWAY context, like
+            # _width_lines — never batch_for; best-effort, host-only
+            # shapes simply render no line). The embedded explain path
+            # is terminal semantics (nothing merges after finalize).
+            spec = None
+            try:
+                from pinot_tpu.engine.device import (
+                    MAX_DENSE_GROUPS,
+                    MAX_SORTED_GROUPS,
+                )
+                from pinot_tpu.engine.params import BatchContext
+
+                tdm = engine.tables.get(q.table_name)
+                segs = list(tdm.segments.values()) if tdm is not None else []
+                if segs:
+                    ctx = BatchContext(segs)
+                    total = 1
+                    for g in q.group_by:
+                        total *= ctx.cardinality(g.name)
+                    if total > MAX_DENSE_GROUPS:
+                        total = min(dev.num_groups_limit, MAX_SORTED_GROUPS)
+                    spec = plan_trim(
+                        q, tuple(q.group_by), tuple(q.aggregations()),
+                        "groupby", total, "terminal",
+                        getattr(dev, "group_trim_size", 5000))
+            except Exception:  # noqa: BLE001 — display only
+                spec = None
+            if spec is not None:
+                lines.append(
+                    f"    DEVICE_REDUCE(trim={trim_keep_count(q, 'terminal')})")
+        if getattr(dev, "partials_cache_enabled", False) \
+                and q.options_ci().get("usepartialscache") is not False:
+            lines.append(
+                f"    CACHED_PARTIALS(entries={len(dev._partials)})")
     if (backend.startswith("DEVICE")
             and os.environ.get("PINOT_TPU_WIDTH_AUDIT", "") not in ("", "0")):
         tdm = engine.tables.get(q.table_name)

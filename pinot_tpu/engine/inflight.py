@@ -24,6 +24,7 @@ idle server dispatches immediately and pays no window latency.
 from __future__ import annotations
 
 import threading
+import time
 
 
 class InflightLaunch:
@@ -57,6 +58,10 @@ class InflightLaunch:
         # shared buffer another member resolves — spans recorded against
         # the handle's tracer land on THIS query's trace regardless
         self.tracer = None
+        # True when the launch was served from the device partials cache
+        # (no gather/dispatch/kernel — the fetch re-reads a cached packed
+        # buffer); surfaces as the result's partialsCacheHit stat
+        self.cache_hit = False
 
     def fetch(self):
         """Blocking phase: resolve the packed buffer → IntermediateResult.
@@ -70,7 +75,15 @@ class InflightLaunch:
         self._done = True
         try:
             if self.deadline is not None:
-                self.deadline.check("device fetch")
+                try:
+                    self.deadline.check("device fetch")
+                except BaseException:
+                    # this member will never run the shared resolve: it
+                    # counts as abandoned, or an all-timed-out cohort
+                    # leaves fetch_done unset and the next stream window
+                    # polls out its whole cap
+                    self._note_abandoned()
+                    raise
             try:
                 if self.tracer is not None:
                     # the member-side fetch wait: covers the cohort-shared
@@ -95,10 +108,23 @@ class InflightLaunch:
             # faults a week apart
             self._executor._note_device_success(
                 self._template, self._batch_key)
-            return self._executor._to_intermediate(
-                self._q, self._ctx, self._template, outs, self._aggs)
+            result = self._executor._to_intermediate(
+                self._q, self._ctx, self._template, outs, self._aggs,
+                cache_hit=self.cache_hit)
+            result.stats.partials_cache_hit = self.cache_hit
+            return result
         finally:
             self._executor._release_launch(self._batch_key)
+
+    def _note_abandoned(self):
+        """Tell a cohort this member will never fetch (resolve closures
+        carry the ``abandon`` hook; solo resolves don't — no-op)."""
+        abandon = getattr(self._resolve, "abandon", None)
+        if abandon is not None:
+            try:
+                abandon()
+            except Exception:  # noqa: BLE001 — bookkeeping must not mask
+                pass
 
     def release(self):
         """Abandon without fetching: drop the batch pin. Callers that fail
@@ -109,6 +135,10 @@ class InflightLaunch:
         Idempotent with fetch(); safe to call on an already-fetched handle."""
         if not self._done:
             self._done = True
+            # cohort members tell their cohort: an all-abandoned cohort
+            # must still set fetch_done or the next stream window stalls
+            # to its cap
+            self._note_abandoned()
             self._executor._release_launch(self._batch_key)
 
 
@@ -130,12 +160,18 @@ class _Cohort:
         self.open = True           # False once the window closed
         self.full = threading.Event()  # hit max_cohort: leader stops waiting
         self.ready = threading.Event()
+        # set once the shared buffer crossed the link (or the cohort
+        # failed): the SUCCESSOR cohort's launch window keys off it — the
+        # double-buffer handoff that keeps the link continuously busy
+        # (LaunchCoalescer stream windows)
+        self.fetch_done = threading.Event()
         self.error = None          # leader's dispatch failure, if any
         self._shared_resolve = None
         self._fetch_lock = threading.Lock()
         self._outs = None
         self._exc = None
         self._fetched = False
+        self._abandoned = 0        # members released without fetching
 
     def dispatch(self):
         """Leader only: one stacked launch for the whole cohort."""
@@ -143,8 +179,31 @@ class _Cohort:
             self._shared_resolve = self._launch_fn(self.members)
         except BaseException as e:  # noqa: BLE001 — members must observe it
             self.error = e
+            self.fetch_done.set()  # nothing will ever fetch; unblock successor
         finally:
             self.ready.set()
+            # members that abandoned BEFORE dispatch finished couldn't
+            # conclude the all-abandoned check; settle it now
+            with self._fetch_lock:
+                self._check_all_abandoned()
+
+    def note_abandoned(self):
+        """A member released its handle without fetching
+        (InflightLaunch.release — deadline expiry, upstream failure).
+        When EVERY member abandons, nothing will ever run the shared
+        fetch: fetch_done must still fire or the next same-key stream
+        window polls out its whole cap for a link that is already
+        free."""
+        with self._fetch_lock:
+            self._abandoned += 1
+            self._check_all_abandoned()
+
+    def _check_all_abandoned(self):
+        """Caller holds _fetch_lock. Membership is final once ready is
+        set (the window closed before dispatch ran)."""
+        if (self.ready.is_set() and not self._fetched
+                and self._abandoned >= len(self.members)):
+            self.fetch_done.set()
 
     def resolve_member(self, idx: int) -> dict:
         """Member ``idx``'s unpacked outputs. The shared buffer crosses
@@ -164,6 +223,7 @@ class _Cohort:
                 except BaseException as e:  # noqa: BLE001 — shared failure
                     self._exc = e
                 self._fetched = True
+                self.fetch_done.set()  # link free: successor may dispatch
         if self._exc is not None:
             raise self._exc
         return {k: v[idx] for k, v in self._outs.items()}
@@ -174,17 +234,36 @@ class LaunchCoalescer:
     dispatch. Pure synchronization — the executor supplies the actual
     stacked-launch closure (``DeviceExecutor._cohort_launch``)."""
 
-    def __init__(self, window_s: float = 0.003, max_cohort: int = 8):
+    def __init__(self, window_s: float = 0.003, max_cohort: int = 8,
+                 stream_cap_s: float = 0.25):
         self.enabled = True
         self.window_s = window_s      # leader's micro-batch window
         self.max_cohort = max_cohort  # vmap width cap (bounds recompiles)
+        # double-buffered launch/fetch streams: while cohort N's shared
+        # buffer is in its link flight, cohort N+1's leader holds its
+        # window open until N's fetch completes (capped at stream_cap_s
+        # for the abandoned-handle case where nobody ever fetches) — so
+        # arrivals during the RTT accumulate into ONE launch that
+        # dispatches the moment the link frees. Steady-state QPS becomes
+        # cohort_size / RTT, bounded by kernel time rather than by one
+        # round trip per query. A leader with no in-flight predecessor
+        # keeps the fixed micro-batch window (an idle link should not
+        # wait).
+        self.stream_cap_s = stream_cap_s
         self.force = False            # tests/bench: window regardless of load
         self.pressure_fn = None       # server wires scheduler.pressure here
         self._lock = threading.Lock()
         self._pending: dict = {}      # cohort key -> open _Cohort
+        # cohort key -> the last dispatched cohort's fetch_done EVENT —
+        # only the event, never the _Cohort: the cohort object closes
+        # over the batch's gathered device columns and the packed output
+        # buffer, and retaining it here would pin those past the batch
+        # LRU's eviction decisions
+        self._last_dispatched: dict = {}
         # observability (bench concurrency sweep reads deltas)
         self.cohorts_launched = 0
         self.queries_coalesced = 0    # members that joined past the leader
+        self.stream_windows = 0       # windows that keyed off a predecessor
 
     def should_window(self, executor_inflight: int) -> bool:
         """Gate: open a window only when concurrency makes a partner
@@ -230,16 +309,42 @@ class LaunchCoalescer:
             c = _Cohort(launch_fn)
             c.members.append(params)
             self._pending[key] = c
+            pred_done = self._last_dispatched.get(key)
+            if pred_done is not None and pred_done.is_set():
+                self._last_dispatched.pop(key, None)  # link already free
+                pred_done = None
         # leader: hold the micro-batch window open — but a cohort that
         # fills to max_cohort early dispatches immediately (the remaining
         # window would be pure added latency for everyone in it). A window
         # that finds NO partner costs window_s against a ~100ms link RTT;
         # the pressure gate keeps that bounded to genuinely-concurrent load.
-        c.full.wait(self.window_s)
+        #
+        # STREAM window (double-buffered launch/fetch): when the previous
+        # cohort of this key is still in its link flight, the window
+        # extends until that fetch completes — every arrival during the
+        # predecessor's RTT buffers into THIS cohort, and it dispatches
+        # the instant the link frees (capped so an abandoned predecessor
+        # can't stall the stream).
+        if pred_done is not None:
+            self.stream_windows += 1
+            deadline = time.monotonic() + self.stream_cap_s
+            while not c.full.is_set() and not pred_done.is_set():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                c.full.wait(min(0.002, left))
+        else:
+            c.full.wait(self.window_s)
         with self._lock:
             c.open = False
             if self._pending.get(key) is c:
                 self._pending.pop(key, None)
             self.cohorts_launched += 1
+            # LRU order: re-insert so the 64-key bound purges genuinely
+            # stale keys, never the hot template that just dispatched
+            self._last_dispatched.pop(key, None)
+            self._last_dispatched[key] = c.fetch_done
+            while len(self._last_dispatched) > 64:  # bound stale keys
+                self._last_dispatched.pop(next(iter(self._last_dispatched)))
         c.dispatch()
         return c, 0

@@ -762,6 +762,13 @@ def _slot(params: dict, counter: list, arr) -> str:
     a = np.asarray(arr)
     if a.dtype == np.float64:
         a = a.astype(np.float32)  # device columns are f32; avoid f64 upcast
+    sig = params.get("__hostsig__")
+    if sig is not None:
+        # host-bytes record for the executor's partials-cache digest
+        # (engine/device.py): the VALUE identity of this literal, taken
+        # BEFORE upload — reading it back off the device would cost the
+        # very round trip the cache exists to save
+        sig.append((key, a.dtype.str, a.shape, a.tobytes()))
     if a.nbytes <= _LITERAL_MAX_BYTES:
         ck = (a.dtype.str, a.shape, a.tobytes())
         with _LITERAL_CACHE_LOCK:

@@ -101,20 +101,29 @@ def merge_intermediates(q: QueryContext, results: list) -> IntermediateResult:
     raise ValueError(f"unknown result shape {shape}")
 
 
+def trim_bound(q: QueryContext, min_trim_size: int = 5000) -> int:
+    """The server-partial keep bound: ``max(5 * (offset+limit),
+    min_trim_size)``. The 5x headroom is the reference's guard against a
+    group that is globally top-K but not locally top-K on this server.
+    ONE copy of the policy — the host trim below and the device trim
+    (ops/device_reduce.py) both read it, so they cannot drift."""
+    return max(5 * (q.offset + q.limit), min_trim_size)
+
+
 def trim_group_by(q: QueryContext, merged: IntermediateResult,
                   min_trim_size: int = 5000) -> IntermediateResult:
     """Server-side order-by-aware group trim before the DataTable ships
-    (data/table/TableResizer.java analog): keep the top
-    ``max(5 * (offset+limit), min_trim_size)`` groups by the query's ORDER
-    BY, evaluated on finalized local partials. The 5x headroom is the
-    reference's guard against a group that is globally top-K but not
-    locally top-K on this server; HAVING queries are not trimmed (the
-    broker filters groups after the merge, so any local trim could starve
-    it of survivors)."""
+    (data/table/TableResizer.java analog): keep the top ``trim_bound``
+    groups by the query's ORDER BY, evaluated on finalized local
+    partials. HAVING queries are not trimmed (the broker filters groups
+    after the merge, so any local trim could starve it of survivors).
+    When the device already trimmed the sole partial in-kernel
+    (ops/device_reduce.py, same bound), n <= trim_size and this is a
+    no-op."""
     if merged.shape != "group_by" or not q.order_by or q.having is not None:
         return merged
     n = len(merged.group_keys[0])
-    trim_size = max(5 * (q.offset + q.limit), min_trim_size)
+    trim_size = trim_bound(q, min_trim_size)
     if n <= trim_size:
         return merged
     specs = [aggspec.make_spec(a) for a in q.aggregations()]

@@ -38,6 +38,10 @@ class ExecutionStats:
     # groups dropped by numGroupsLimit: the result is plan-dependent
     # partial (reference numGroupsLimitReached response metadata)
     num_groups_limit_reached: bool = False
+    # the device partials cache served this execution (engine/device.py):
+    # no gather/dispatch/kernel ran — the fetch re-read a cached packed
+    # buffer. Surfaces as partialsCacheHit in responses + the query log.
+    partials_cache_hit: bool = False
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -52,6 +56,7 @@ class ExecutionStats:
         self.thread_cpu_time_ns += other.thread_cpu_time_ns
         self.scheduler_wait_ms += other.scheduler_wait_ms
         self.num_groups_limit_reached |= other.num_groups_limit_reached
+        self.partials_cache_hit |= other.partials_cache_hit
 
 
 @dataclasses.dataclass

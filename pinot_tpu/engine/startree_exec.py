@@ -267,7 +267,11 @@ def execute_star_tree_group(engine, q: QueryContext, meta: dict, st_segments: li
     is tiny). ``terminal``: no upstream merge — sketch re-merges may
     finalize on device (convert passes their 'est' partials through)."""
     plan = build_plan(q, meta, st_segments[0])
-    r2 = engine.execute_segments(plan.q2, st_segments, terminal=terminal)
+    # trim_ok=False: the outer finalize runs under q, not plan.q2 — an
+    # in-kernel trim keyed to q2's order/limit could drop cube rows the
+    # parent query's reduce still needs
+    r2 = engine.execute_segments(plan.q2, st_segments, terminal=terminal,
+                                 trim_ok=False)
     return convert(r2, plan, q, parent_total_docs)
 
 

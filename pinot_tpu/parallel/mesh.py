@@ -86,9 +86,12 @@ def _combine_sorted_table(outs: dict) -> dict:
     # single shard's table. numGroupsLimit semantics stay host-side, via
     # the executor's n_groups_total check against sorted_k.
     # scalar observability leaves ride the ordinary psum combine, not the
-    # keyed table merge (they are per-shard counts, not table columns)
-    stat_keys = ("doc_count", "seg_matched", "n_groups_total", "skeys",
-                 "n_alive", "rows_filter", "blocks_total", "blocks_scanned")
+    # keyed table merge (they are per-shard counts, not table columns);
+    # the list is the SHARED ops/device_reduce.py STAT_KEYS contract plus
+    # skeys (consumed by the key merge itself)
+    from pinot_tpu.ops.device_reduce import STAT_KEYS
+
+    stat_keys = STAT_KEYS | {"skeys"}
     K = outs["skeys"].shape[-1]
     reds, cols = {}, {}
     for k, v in outs.items():
@@ -157,14 +160,16 @@ def shard_pipeline(pipeline_fn, mesh: Mesh, cohort: bool = False, post=None):
     The per-shard pipeline AND the cross-shard combine are vmapped over
     that axis inside ONE shard_map, so a whole cohort costs one dispatch
     and its collectives batch over ICI. ``post`` (cohort only): a
-    replicated post-combine transform (device sketch finalize) applied
-    per member INSIDE the vmap — its per-member semantics (regs → est)
+    replicated post-combine transform ``post(outs, params)`` (device
+    sketch finalize and/or the device-reduce trim, which reads its
+    ``tr_k`` bound from the member's params) applied per member INSIDE
+    the vmap — its per-member semantics (regs → est, table → top-K)
     must see unbatched shapes.
     """
 
     def one(cols, n_docs, p):
         outs = _combine_outs(pipeline_fn(cols, n_docs, p))
-        return post(outs) if post is not None else outs
+        return post(outs, p) if post is not None else outs
 
     def sharded(cols, n_docs, params):
         if cohort:
@@ -203,7 +208,7 @@ def shard_pipeline(pipeline_fn, mesh: Mesh, cohort: bool = False, post=None):
                 for k, v in params.items()
             }
         keys_fn = pipeline_fn if post is None else (
-            lambda c, nd, p: post(pipeline_fn(c, nd, p)))
+            lambda c, nd, p: post(pipeline_fn(c, nd, p), p))
         outs_shape = jax.eval_shape(keys_fn, cols, n_docs, shape_params)
 
         def out_spec(k: str) -> P:

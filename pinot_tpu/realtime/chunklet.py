@@ -55,6 +55,23 @@ from pinot_tpu.storage.segment import ColumnMetadata, Encoding, SegmentMetadata
 log = logging.getLogger("pinot_tpu.realtime.chunklet")
 
 
+def _invalidate_device_partials(match: str) -> None:
+    """Fan a partials-cache invalidation out to every live DeviceExecutor
+    (engine/device.py invalidate_cached_partials). Import-free when the
+    device module was never loaded — ingest worker processes must not
+    pull jax in just to notify a cache that cannot exist there.
+    Correctness never rides on this hook (batch keys change with the
+    chunklet set, so stale entries are unreachable); it frees the device
+    bytes they pin."""
+    dev_mod = sys.modules.get("pinot_tpu.engine.device")
+    if dev_mod is None:
+        return
+    try:
+        dev_mod.invalidate_cached_partials(match)
+    except Exception:  # noqa: BLE001 — cache hygiene must not fail ingest
+        log.exception("device partials invalidation failed for %r", match)
+
+
 def _use_dictionary(spec, no_dict_cols) -> bool:
     """Mirror the segment creator's encoding policy (storage/creator.py):
     strings always dict-encode; numeric dimensions/datetimes dict-encode
@@ -337,13 +354,23 @@ class ChunkletIndex:
                 ck = Chunklet(self.segment, len(self.chunklets), start, stop)
                 self.chunklets.append(ck)  # publish fully-built only
                 made += 1
+        if made:
+            # the chunklet set changed: device batches (and their cached
+            # partials) built over the OLD frozen prefix retire
+            _invalidate_device_partials(
+                f"<chunklet:{self.segment.segment_name}:")
         return made
 
     def note_invalidated(self, doc_id: int) -> None:
         i = doc_id // self.rows_per_chunklet
         cks = self.chunklets
         if i < len(cks):
+            was_clean = cks[i].is_clean
             cks[i].mark_dirty()
+            if was_clean:
+                # first upsert into this block: cached partials over any
+                # batch containing it are stale-by-construction
+                _invalidate_device_partials(cks[i].dir)
 
     def column_with_tail(self, name: str, n: int) -> np.ndarray:
         """Decoded column over docs [0, n): chunklet blocks for the frozen

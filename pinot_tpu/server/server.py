@@ -163,13 +163,26 @@ class ServerInstance:
         # deviceNarrowSavedBytes alongside rising evictions means batches
         # stopped fitting)
         if dev is not None:
+            # the device-reduce trim and the server's host trim must keep
+            # ONE policy bound (engine/reduce.py trim_bound)
+            dev.group_trim_size = group_trim_size
             # counters are plain executor ints (GIL-atomic reads); only
             # the byte gauges walk the batch list — one lightweight sum
             # each, not a full hbm_stats() snapshot 5x per scrape
             for gname, attr in (("deviceBatchHits", "batch_hits"),
                                 ("deviceBatchMisses", "batch_misses"),
                                 ("deviceBatchEvictions", "batch_evictions"),
-                                ("deviceLaunchFailures", "launch_failures")):
+                                ("deviceLaunchFailures", "launch_failures"),
+                                # device partials cache (sub-RTT serving):
+                                # repeat-query hit traffic + resident
+                                # bytes the cached packed buffers pin
+                                ("devicePartialsCacheBytes",
+                                 "partials_bytes"),
+                                ("devicePartialsCacheHits", "partials_hits"),
+                                ("devicePartialsCacheMisses",
+                                 "partials_misses"),
+                                ("devicePartialsCacheEvictions",
+                                 "partials_evictions")):
                 self._register_gauge(
                     gname, (lambda _a=attr, _d=dev: getattr(_d, _a)))
             self._register_gauge(

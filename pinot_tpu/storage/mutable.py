@@ -416,4 +416,10 @@ class MutableSegment:
         seg = ImmutableSegment(out_dir)
         if self._valid is not None:
             seg.valid_docs_mask = self._valid[:n].copy()
+        if ci is not None:
+            # seal retires the consuming segment's chunklet batches: drop
+            # any device partials cached over them (realtime/chunklet.py)
+            from pinot_tpu.realtime.chunklet import _invalidate_device_partials
+
+            _invalidate_device_partials(f"<chunklet:{self.segment_name}:")
         return seg

@@ -1,0 +1,226 @@
+"""Bench round differ: ``python -m pinot_tpu.tools.benchdiff OLD NEW``.
+
+Compares two recorded bench rounds (``BENCH_r*.json``) and exits non-zero
+when the new round regresses past a threshold — the CI face of the bench
+artifacts the driver records every PR.
+
+Input tolerance (both files): a round may be
+
+- the bench's own stdout JSON (``{"metric": ..., "detail": {...}}``),
+- the driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` where
+  ``parsed`` is the full doc **or None** — then the known detail
+  sections are brace-matched out of the truncated ``tail`` string, the
+  same recovery bench.py's ``_load_micro_reference`` performs,
+- partially populated (early rounds lack later phases): only metrics
+  present in BOTH rounds are compared; everything else is reported as
+  added/removed, never as a regression.
+
+Compared metric families (direction-aware):
+
+- per-suite query latencies (``ssb100m``/``taxi12m``/``subrtt`` entries'
+  ``p50_ms`` — lower is better),
+- micro kernel throughput (``micro.*.mrows_per_s`` — higher is better),
+- concurrency throughput (``concurrency.n*.qps`` — higher is better),
+- the phase waterfall (``observability.phase_p50_ms.*`` — lower is
+  better; informational by default since queue/link phases are noisy,
+  gated only under ``--gate-phases``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# sections brace-matched out of a truncated driver-wrapper tail
+_TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
+                  "observability", "blockskip", "narrow", "join", "faults",
+                  "breakdown")
+
+
+def _brace_match(text: str, key: str):
+    """json.loads the ``{...}`` object following ``"key":`` in ``text``,
+    or None (absent / truncated mid-object). String-aware: braces inside
+    JSON string values (a note containing '}' etc.) don't move the depth
+    counter."""
+    i = text.find(f'"{key}":')
+    if i < 0:
+        return None
+    j = text.find("{", i)
+    if j < 0:
+        return None
+    depth, k = 0, j
+    in_string = escape = False
+    while k < len(text):
+        ch = text[k]
+        if in_string:
+            if escape:
+                escape = False
+            elif ch == "\\":
+                escape = True
+            elif ch == '"':
+                in_string = False
+        elif ch == '"':
+            in_string = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        k += 1
+    try:
+        return json.loads(text[j:k + 1])
+    except ValueError:
+        return None
+
+
+def load_round(path: str) -> dict:
+    """Round file → detail dict (best effort, never raises on partial
+    rounds — an unreadable file IS an error)."""
+    with open(path) as f:
+        doc = json.load(f)
+    # driver wrapper?
+    if isinstance(doc, dict) and "tail" in doc and "metric" not in doc:
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            doc = parsed
+        else:
+            tail = doc.get("tail") or ""
+            detail = {}
+            for sec in _TAIL_SECTIONS:
+                got = _brace_match(tail, sec)
+                if got is not None:
+                    detail[sec] = got
+            return detail
+    if isinstance(doc, dict) and isinstance(doc.get("detail"), dict):
+        return doc["detail"]
+    return doc if isinstance(doc, dict) else {}
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def extract_metrics(detail: dict) -> dict:
+    """detail → {metric_name: (value, direction)} where direction is
+    "lower" (latency) or "higher" (throughput)."""
+    out: dict = {}
+    for suite in ("ssb100m", "taxi12m", "subrtt"):
+        sec = detail.get(suite)
+        if not isinstance(sec, dict):
+            continue
+        for qname, entry in sec.items():
+            if isinstance(entry, dict):
+                p50 = _num(entry.get("p50_ms"))
+                if p50 is not None:
+                    out[f"{suite}.{qname}.p50_ms"] = (p50, "lower")
+    micro = detail.get("micro")
+    if isinstance(micro, dict):
+        for kname, entry in micro.items():
+            if isinstance(entry, dict):
+                rate = _num(entry.get("mrows_per_s"))
+                if rate is not None:
+                    out[f"micro.{kname}.mrows_per_s"] = (rate, "higher")
+    conc = detail.get("concurrency")
+    if isinstance(conc, dict):
+        for lname, entry in conc.items():
+            if isinstance(entry, dict):
+                qps = _num(entry.get("qps"))
+                if qps is not None:
+                    out[f"concurrency.{lname}.qps"] = (qps, "higher")
+    obs = detail.get("observability")
+    if isinstance(obs, dict):
+        phases = obs.get("phase_p50_ms")
+        if isinstance(phases, dict):
+            for pname, v in phases.items():
+                v = _num(v)
+                if v is not None:
+                    out[f"phase.{pname}.p50_ms"] = (v, "lower")
+    sub = detail.get("subrtt")
+    if isinstance(sub, dict):
+        # link_floor_ms is deliberately NOT compared: it is a property of
+        # the box/tunnel, not the code (the served_p50 gate already
+        # normalizes by it), same noise class as the ungated phases
+        for k in ("served_p50_ms", "qps8"):
+            v = _num(sub.get(k))
+            if v is not None:
+                direction = "higher" if k == "qps8" else "lower"
+                out[f"subrtt.{k}"] = (v, direction)
+    return out
+
+
+def diff_rounds(old: dict, new: dict, threshold: float,
+                gate_phases: bool = False) -> dict:
+    """{regressions, improvements, unchanged, added, removed} over the
+    shared metric set. A metric regresses when it moves past
+    ``threshold`` (fraction) in its bad direction."""
+    mo, mn = extract_metrics(old), extract_metrics(new)
+    report = {"regressions": {}, "improvements": {}, "unchanged": {},
+              "added": sorted(set(mn) - set(mo)),
+              "removed": sorted(set(mo) - set(mn))}
+    for name in sorted(set(mo) & set(mn)):
+        vo, direction = mo[name]
+        vn, _ = mn[name]
+        if vo == 0:
+            report["unchanged"][name] = {"old": vo, "new": vn}
+            continue
+        ratio = vn / vo
+        entry = {"old": vo, "new": vn, "ratio": round(ratio, 3)}
+        worse = ratio > 1 + threshold if direction == "lower" \
+            else ratio < 1 - threshold
+        better = ratio < 1 - threshold if direction == "lower" \
+            else ratio > 1 + threshold
+        gated = gate_phases or not name.startswith("phase.")
+        if worse and gated:
+            report["regressions"][name] = entry
+        elif better:
+            report["improvements"][name] = entry
+        else:
+            report["unchanged"][name] = entry
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.tools.benchdiff",
+        description="compare two recorded bench rounds; non-zero exit on "
+                    "regression past --threshold")
+    ap.add_argument("old", help="reference round (BENCH_rNN.json)")
+    ap.add_argument("new", help="candidate round")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="regression tolerance as a fraction (default 0.25)")
+    ap.add_argument("--gate-phases", action="store_true",
+                    help="also gate the per-phase waterfall (noisy: queue/"
+                         "link phases swing with load; informational "
+                         "otherwise)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    try:
+        old = load_round(args.old)
+        new = load_round(args.new)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: cannot read rounds: {e}", file=sys.stderr)
+        return 2
+    report = diff_rounds(old, new, args.threshold, args.gate_phases)
+    if args.as_json:
+        print(json.dumps(report, indent=2))
+    else:
+        for bucket in ("regressions", "improvements"):
+            rows = report[bucket]
+            if rows:
+                print(f"{bucket} (threshold {args.threshold:.0%}):")
+                for name, e in rows.items():
+                    print(f"  {name}: {e['old']} -> {e['new']} "
+                          f"(x{e['ratio']})")
+        print(f"{len(report['unchanged'])} within threshold, "
+              f"{len(report['added'])} added, "
+              f"{len(report['removed'])} removed")
+        if not report["regressions"]:
+            print("no regressions")
+    return 1 if report["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

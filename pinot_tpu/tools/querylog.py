@@ -105,11 +105,16 @@ def summarize(entries: list, top: int = 5,
     if per_template:
         by_tpl: dict = {}
         for e in entries:
+            hit = (e.get("counters") or {}).get("partialsCacheHit")
             by_tpl.setdefault(e.get("template") or "?", []).append(
-                e.get("timeUsedMs", 0.0))
+                (e.get("timeUsedMs", 0.0), bool(hit)))
         summary["templates"] = {
             t: {"queries": len(v),
-                "p50Ms": round(_percentile(sorted(v), 0.5), 2)}
+                "p50Ms": round(_percentile(sorted(x for x, _ in v), 0.5), 2),
+                # device partials-cache hit rate for this literal-free
+                # template — the repeat-dashboard-query signal the cache
+                # exists to serve
+                "cacheHitRate": round(sum(1 for _, h in v if h) / len(v), 3)}
             for t, v in sorted(by_tpl.items())
         }
     slowest = sorted(entries, key=lambda e: e.get("timeUsedMs", 0.0),

@@ -226,6 +226,9 @@ class TestCohorts:
         sqls = [f"SELECT COUNT(*), SUM(m) FROM t "
                 f"WHERE ts BETWEEN {lo} AND {hi}" for lo, hi in windows]
         expected = [eng.execute(s) for s in sqls]  # solo (warm + oracle)
+        # the warm pass populated the device partials cache; this test
+        # exercises the COHORT machinery, so keep repeats off the cache
+        eng.device.partials_cache_enabled = False
         co = eng.device.coalescer
         co.force = True
         co.window_s = 0.05

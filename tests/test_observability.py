@@ -460,6 +460,7 @@ class TestTracerAcrossAsyncSplit:
 
         eng, _segs = traced_engine
         dev = eng.device
+        dev.partials_cache_enabled = False  # pin cohorts, not cache hits
         co = dev.coalescer
         co.force = True
         co.window_s = 0.25
