@@ -45,28 +45,49 @@ class QueryQuotaManager:
         self.registry = registry
         self._buckets: dict = {}  # raw table -> [tokens, last_ts, rate]
         self._lock = threading.Lock()
+        # rate lookups memoized per registry routing generation: config
+        # changes ride the tables section (which bumps the generation), so
+        # the memo is exact — and the steady-state query path stops paying
+        # three registry reads per query (ISSUE 10 hit-latency budget)
+        self._rates: dict = {}
+        self._rates_gen = None
 
     @staticmethod
     def _base_name(table: str) -> str:
         # one bucket per logical table: 'tbl', 'tbl_OFFLINE' and
-        # 'tbl_REALTIME' must draw from the SAME quota
-        for suffix in ("_OFFLINE", "_REALTIME"):
-            if table.endswith(suffix):
-                return table[: -len(suffix)]
-        return table
+        # 'tbl_REALTIME' must draw from the SAME quota — the same fold
+        # the freshness epochs use (single-sourced there; freshness is
+        # the dependency-free module, so the broker delegates to it)
+        from pinot_tpu.common import freshness
 
-    def _rate(self, base: str) -> Optional[float]:
+        return freshness.base_table(table)
+
+    def _rate(self, base: str, gen=None) -> Optional[float]:
+        if gen is None:
+            gen = self.registry.routing_generation()
+        with self._lock:
+            if self._rates_gen == gen and base in self._rates:
+                return self._rates[base]
+        rate = None
         for key in (base, f"{base}_OFFLINE", f"{base}_REALTIME"):
             cfg = self.registry.table_config(key)
             if cfg is not None and \
                     cfg.quota.max_queries_per_second is not None:
-                return float(cfg.quota.max_queries_per_second)
-        return None
+                rate = float(cfg.quota.max_queries_per_second)
+                break
+        with self._lock:
+            if self._rates_gen != gen:
+                self._rates = {}
+                self._rates_gen = gen
+            self._rates[base] = rate
+        return rate
 
-    def acquire(self, table: str) -> bool:
-        """True = admit; False = over quota (HTTP 429-shaped rejection)."""
+    def acquire(self, table: str, gen=None) -> bool:
+        """True = admit; False = over quota (HTTP 429-shaped rejection).
+        ``gen``: the caller's already-read routing generation (the broker
+        reads it ONCE per query and shares it across every memo)."""
         base = self._base_name(table)
-        rate = self._rate(base)
+        rate = self._rate(base, gen)
         if rate is None:
             return True
         now = time.time()
@@ -234,45 +255,151 @@ class LatencyTracker:
         return self.default_s if p90_ms is None else p90_ms / 1e3
 
 
-class RoutingManager:
-    """table → {instance: [segments]} from the registry's assignment
-    (BrokerRoutingManager.java:87 + balanced instance selection: one replica
-    per segment, round-robin across queries)."""
+class LoadTracker:
+    """Decayed per-instance load view feeding the replica-group pick
+    (AdaptiveServerSelector's NumInFlightReqSelector + server-latency
+    roles, ISSUE 10). Three signals fold into one score:
 
-    def __init__(self, registry: ClusterRegistry, failure_detector: FailureDetector):
+    - the server's scheduler ``pressure()`` + in-flight depth, piggybacked
+      in every DataTable partial (freshest; observed at gather time);
+    - the same pressure from the sync-loop heartbeat
+      (``InstanceInfo.pressure``) when no queries are flowing;
+    - this broker's own outstanding RPC count per instance (instant —
+      covers the window before any response could report back).
+
+    Reported observations EWMA-decay toward idle over ``DECAY_S`` so one
+    busy moment doesn't blacklist a server; past ``STALE_S`` the score is
+    None and the router falls back to rolling-p90 latency."""
+
+    DECAY_S = 10.0
+    STALE_S = 30.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._obs: dict = {}          # inst -> [ewma score, monotonic ts]
+        self._outstanding: dict = {}  # inst -> this broker's in-flight RPCs
+
+    def observe(self, instance_id: str, pressure, inflight=0,
+                ts: float = None) -> None:
+        import math
+
+        load = max(float(pressure or 0), float(inflight or 0))
+        now = time.monotonic() if ts is None else ts
+        with self._lock:
+            cur = self._obs.get(instance_id)
+            if cur is None:
+                self._obs[instance_id] = [load, now]
+                return
+            if cur[1] > now:
+                return  # a fresher (piggybacked) observation already landed
+            decayed = cur[0] * math.exp(-(now - cur[1]) / self.DECAY_S)
+            self._obs[instance_id] = [0.5 * decayed + 0.5 * load, now]
+
+    def note_dispatch(self, instance_id: str) -> None:
+        with self._lock:
+            self._outstanding[instance_id] = \
+                self._outstanding.get(instance_id, 0) + 1
+
+    def note_done(self, instance_id: str) -> None:
+        with self._lock:
+            n = self._outstanding.get(instance_id, 0) - 1
+            if n > 0:
+                self._outstanding[instance_id] = n
+            else:
+                self._outstanding.pop(instance_id, None)
+
+    def outstanding(self, instance_id: str) -> int:
+        with self._lock:
+            return self._outstanding.get(instance_id, 0)
+
+    def score(self, instance_id: str):
+        """Decayed reported load + this broker's own outstanding RPCs, or
+        None when the last report went stale (router falls back to p90)."""
+        import math
+
+        now = time.monotonic()
+        with self._lock:
+            out = self._outstanding.get(instance_id, 0)
+            cur = self._obs.get(instance_id)
+            if cur is None or now - cur[1] > self.STALE_S:
+                return None
+            return cur[0] * math.exp(-(now - cur[1]) / self.DECAY_S) + out
+
+
+class RoutingManager:
+    """table → {instance: [segments]} from the registry's external view
+    (BrokerRoutingManager.java:87 + instance selection).
+
+    Since ISSUE 10 this routes at REPLICA-GROUP granularity when the
+    controller has built a group map: the derived routing structures
+    (lineage/offline-filtered replicas + per-group segment coverage) are
+    cached per (table, registry routing generation) — rebuilt only when
+    the cluster actually changed, not per query — and each query goes to
+    ONE group's instances, picked least-loaded (decayed piggybacked
+    pressure, falling back to rolling-p90 latency when pressure is
+    stale). Tables without a group map keep the per-segment healthy-first
+    round-robin."""
+
+    # groups within this much of the best score share round-robin traffic
+    # (a strict argmin would starve an equally-idle group on float noise)
+    LOAD_TIE_EPS = 0.5
+
+    def __init__(self, registry: ClusterRegistry,
+                 failure_detector: FailureDetector, latency=None):
         self.registry = registry
         self.failures = failure_detector
+        self.latency = latency  # LatencyTracker: stale-pressure fallback
+        self.loads = LoadTracker()
+        # optional memoized instances supplier (the Broker wires its 0.25s
+        # _server_instances memo here) so the rate-limited heartbeat-load
+        # refresh doesn't pay a registry read — file-backed registries
+        # make that real I/O on the query path
+        self.instances_fn = None
         self._rr = itertools.count()
+        self._snap_lock = threading.Lock()
+        self._snapshots: dict = {}  # table -> (routing generation, snapshot)
+        self._last_hb_refresh = 0.0
+        # serializes pick + reservation: without it a burst of concurrent
+        # queries all read the same scores before any outstanding count
+        # moves and herd onto one group (observed: 2 servers at 55%
+        # utilization each, zero scaling)
+        self._pick_lock = threading.Lock()
 
     def routing_table(self, table: str) -> Optional[dict]:
-        routing, _ = self.routing_with_replicas(table)
+        routing, _, _ = self.routing_with_replicas(table)
         return routing
 
-    def routing_with_replicas(self, table: str) -> tuple:
-        """(routing {instance: [segments]}, replicas {segment: [instances]}).
+    # ---- cached derived routing state ------------------------------------
+    def _snapshot(self, table: str, gen=None) -> dict:
+        """The expensive derived structures, cached per (table, registry
+        routing generation) — ISSUE 10 satellite: a steady cluster costs
+        one dict lookup per query instead of a registry walk."""
+        if gen is None:
+            gen = self.registry.routing_generation()
+        with self._snap_lock:
+            ent = self._snapshots.get(table)
+            if ent is not None and ent[0] == gen:
+                return ent[1]
+        snap = self._build_snapshot(table)
+        with self._snap_lock:
+            self._snapshots[table] = (gen, snap)
+        return snap
 
-        The replicas map is what the scatter path's failure handling
-        consumes: on a transport failure (or a hedge trigger) the broker
-        re-sends the failed instance's segment list to another serving
-        replica instead of immediately declaring ``partialResult``."""
+    def _build_snapshot(self, table: str) -> dict:
         # route on the EXTERNAL VIEW (what servers actually serve), not the
-        # ideal-state assignment — assignment may race ahead of loading
-        view, records, lineage = self.registry.routing_snapshot(table)
-        if not view:
-            return None, {}
+        # ideal-state assignment — assignment may race ahead of loading.
         # Segment-lineage filter (reference SegmentLineage +
         # SegmentLineageBasedSegmentPreSelector): an IN_PROGRESS replace
         # routes the FROM set (the TO segments are still loading); a
         # COMPLETED one routes the TO set even while the FROM segments
         # linger in the external view awaiting deletion. This is what makes
         # a minion merge swap atomic from the query path's point of view.
+        view, records, lineage = self.registry.routing_snapshot(table)
         excluded = set()
         for entry in lineage.values():
             excluded.update(
                 entry["from"] if entry["state"] == "COMPLETED" else entry["to"]
             )
-        offset = next(self._rr)
-        out: dict[str, list] = {}
         replicas: dict[str, list] = {}
         for segment, instances in view.items():
             if segment in excluded:
@@ -281,6 +408,91 @@ class RoutingManager:
             if rec is not None and rec.state == SegmentState.OFFLINE:
                 continue
             replicas[segment] = list(instances)
+        # per-group coverage: group -> {segment: [serving members]}; a
+        # group missing ANY segment can't take whole queries and is left
+        # out (its instances still serve as per-segment retry replicas)
+        groups = self.registry.replica_groups(table)
+        group_cover: dict = {}
+        if replicas:
+            for name, members in groups.items():
+                mset = set(members)
+                cover: Optional[dict] = {}
+                for seg, insts in replicas.items():
+                    within = [i for i in insts if i in mset]
+                    if not within:
+                        cover = None
+                        break
+                    cover[seg] = within
+                if cover is not None:
+                    group_cover[name] = cover
+        return {"replicas": replicas, "groups": groups,
+                "group_cover": group_cover}
+
+    def _refresh_heartbeat_loads(self) -> None:
+        """Fold sync-loop heartbeat pressure into the load view (rate
+        limited — piggybacked response signals dominate under traffic)."""
+        now = time.monotonic()
+        if now - self._last_hb_refresh < 0.5:
+            return
+        self._last_hb_refresh = now
+        now_ms = time.time() * 1000
+        instances = (self.instances_fn() if self.instances_fn is not None
+                     else self.registry.instances(Role.SERVER))
+        for i in instances:
+            age_s = max(0.0, (now_ms - i.last_heartbeat_ms) / 1e3)
+            if age_s <= LoadTracker.STALE_S:
+                self.loads.observe(i.instance_id,
+                                   getattr(i, "pressure", 0.0),
+                                   ts=now - age_s)
+
+    # ---- query-time selection --------------------------------------------
+    def release(self, instances) -> None:
+        """Release reservations taken by ``routing_with_replicas(...,
+        reserve=True)`` — the broker calls this when the query's scatter
+        completes (one release per reserved occurrence)."""
+        for inst in instances:
+            self.loads.note_done(inst)
+
+    def routing_with_replicas(self, table: str, reserve: bool = False,
+                              gen=None) -> tuple:
+        """(routing {instance: [segments]},
+            replicas {segment: [instances]},
+            info {numReplicaGroupsQueried, replicaGroup, loadScore, ...}).
+
+        The replicas map is what the scatter path's failure handling
+        consumes: on a transport failure (or a hedge trigger) the broker
+        re-sends the failed instance's segment list to another serving
+        replica instead of immediately declaring ``partialResult``.
+
+        ``reserve=True`` (the broker's scatter path) atomically bumps the
+        picked instances' outstanding counts WITH the pick — concurrent
+        arrivals see each other's placements instead of herding onto one
+        group — and lists them under ``info["reserved"]``; the caller MUST
+        ``release()`` them when the query settles."""
+        snap = self._snapshot(table, gen)
+        replicas = snap["replicas"]
+        if not replicas:
+            return None, {}, {}
+        offset = next(self._rr)
+        info: dict = {"numReplicaGroupsQueried": 0}
+        if snap["group_cover"]:
+            # registry/heartbeat I/O stays OUTSIDE the pick lock — a
+            # file-backed refresh while holding it would serialize every
+            # concurrent query's group pick behind the read
+            self._refresh_heartbeat_loads()
+            with self._pick_lock:
+                routing, ginfo = self._route_via_group(snap, offset)
+                if routing is not None and reserve:
+                    reserved = []
+                    for inst, segs in routing.items():
+                        self.loads.note_dispatch(inst)
+                        reserved.append(inst)
+                    ginfo["reserved"] = reserved
+            if routing is not None:
+                info.update(ginfo)
+                return routing, replicas, info
+        out: dict[str, list] = {}
+        for segment, instances in replicas.items():
             # healthy replicas take traffic; a half-open one (backoff
             # window elapsed) joins the pool and, when the round-robin
             # actually picks it, claims the single probe slot — its query
@@ -300,12 +512,77 @@ class RoutingManager:
             if pick in half_open and not self.failures.try_probe(pick):
                 pick = healthy[offset % len(healthy)] if healthy else pick
             out.setdefault(pick, []).append(segment)
-        return out, replicas
+        if reserve and out:
+            reserved = []
+            for inst in out:
+                self.loads.note_dispatch(inst)
+                reserved.append(inst)
+            info["reserved"] = reserved
+        return out, replicas, info
+
+    def _route_via_group(self, snap: dict, offset: int) -> tuple:
+        """Pick ONE replica group for the whole query: least-loaded by
+        decayed piggybacked pressure across the group's serving members;
+        when any candidate's pressure is stale, every group re-scores on
+        rolling-p90 latency (one comparable basis). Near-tie groups share
+        round-robin traffic. Returns (routing, info) or (None, {}) when
+        no group has a routable replica for every segment (caller falls
+        back to per-segment selection). Caller holds ``_pick_lock`` and
+        has already refreshed heartbeat loads (outside the lock)."""
+        cands = []  # (name, cover, members serving + routable)
+        for name in sorted(snap["group_cover"]):
+            cover = snap["group_cover"][name]
+            members: set = set()
+            ok = True
+            for seg, within in cover.items():
+                routable = [i for i in within if self.failures.is_healthy(i)]
+                if not routable:
+                    ok = False
+                    break
+                members.update(routable)
+            if ok:
+                cands.append((name, cover, sorted(members)))
+        if not cands:
+            return None, {}
+        fresh = {name: [self.loads.score(i) for i in members]
+                 for name, _c, members in cands}
+        all_fresh = all(s is not None
+                        for scores in fresh.values() for s in scores)
+        scored = []
+        for name, cover, members in cands:
+            if all_fresh:
+                score = max(fresh[name]) if fresh[name] else 0.0
+            else:
+                # stale pressure somewhere: rolling-p90 latency (ms) for
+                # EVERY group so the comparison stays one-basis
+                score = max((self.latency.p90_s(i) for i in members),
+                            default=0.0) * 1e3 if self.latency is not None \
+                    else 0.0
+            scored.append((score, name, cover))
+        best = min(s for s, _n, _c in scored)
+        pool = [e for e in scored if e[0] <= best + self.LOAD_TIE_EPS]
+        score, gname, cover = pool[offset % len(pool)]
+        routing: dict = {}
+        for seg, within in cover.items():
+            routable = [i for i in within if self.failures.is_healthy(i)]
+            healthy = [i for i in routable
+                       if self.failures.state(i) == FailureDetector.ST_HEALTHY]
+            ipool = healthy + [i for i in routable if i not in healthy]
+            pick = ipool[offset % len(ipool)]
+            if pick not in healthy and not self.failures.try_probe(pick):
+                pick = healthy[offset % len(healthy)] if healthy else pick
+            routing.setdefault(pick, []).append(seg)
+        return routing, {
+            "numReplicaGroupsQueried": 1,
+            "replicaGroup": gname,
+            "loadScore": round(float(score), 3),
+            "loadBasis": "pressure" if all_fresh else "latency_p90",
+        }
 
 
 class Broker:
     def __init__(self, registry: ClusterRegistry, broker_id: str = "broker_0",
-                 timeout_s: float = 10.0, tls="auto"):
+                 timeout_s: float = 10.0, tls="auto", result_cache=None):
         self.registry = registry
         self.broker_id = broker_id
         self.timeout_s = timeout_s
@@ -320,10 +597,14 @@ class Broker:
         self.metrics = get_metrics("broker")
         self.quota = QueryQuotaManager(registry)
         self.failures = FailureDetector()
-        self.routing = RoutingManager(registry, self.failures)
         # hedge-delay percentiles come from the SHARED metrics histogram
-        # (one latency truth — ISSUE 7)
+        # (one latency truth — ISSUE 7); the router reads the same p90s
+        # as its stale-pressure load fallback (ISSUE 10)
         self.latency = LatencyTracker(registry=self.metrics)
+        self.routing = RoutingManager(registry, self.failures,
+                                      latency=self.latency)
+        self.routing.instances_fn = \
+            lambda: self._server_instances().values()
         # structured slow/error query log (broker/querylog.py): JSONL +
         # the /debug/queries ring
         from pinot_tpu.broker.querylog import QueryLogger
@@ -344,15 +625,85 @@ class Broker:
         # fixed hedge delay override; <= 0 means adaptive (rolling p90)
         self.hedge_delay_s = conf.get_float(
             "pinot.broker.hedging.delay.ms", 0.0) / 1e3
+        # broker result cache (ISSUE 10, broker/result_cache.py): OFF by
+        # default — partial-result and chaos semantics (tests and the
+        # fault bench deliberately repeat queries against faulted
+        # replicas) must stay exact unless the operator opts in via
+        # pinot.broker.resultcache.enabled / the constructor / SET
+        # useResultCache=true
+        from pinot_tpu.broker.result_cache import BrokerResultCache
+
+        self.result_cache_default = conf.get_bool(
+            "pinot.broker.resultcache.enabled", False) \
+            if result_cache is None else bool(result_cache)
+        self.result_cache = BrokerResultCache(
+            max_entries=int(conf.get_float(
+                "pinot.broker.resultcache.max.entries", 512)),
+            max_bytes=int(conf.get_float(
+                "pinot.broker.resultcache.max.bytes", float(32 << 20))))
+        # per-table {instance: freshness epoch} observed piggybacked in
+        # responses (merged with heartbeat epochs at validation time)
+        self._epoch_obs: dict = {}
+        self._epoch_lock = threading.Lock()
+        # hot-path memos (the <5ms cache-hit budget AND the cluster
+        # scaling gate: per-query broker CPU must stay far below per-query
+        # server CPU): every registry-derived per-query lookup — table
+        # names, physical-table split + hybrid time boundary — is cached
+        # under ONE routing generation read per query (exact: all inputs
+        # ride routing sections); the heartbeat epoch view keys on a
+        # 0.25s clock (within the heartbeat transport delay itself)
+        self._gen_memo: dict = {"gen": None}
+        self._inst_memo: tuple = (-1.0, {})
+        # optional TTL on the per-query routing-generation READ itself
+        # (pinot.broker.routing.gen.ttl.ms, default 0 = always fresh):
+        # on file-registry clusters the version read is a real syscall
+        # round-trip per query; a small TTL trades that for an equally
+        # small routing/invalidation delay (the reference's ZK-watch
+        # propagation is asynchronous in just the same way)
+        self.routing_gen_ttl_s = conf.get_float(
+            "pinot.broker.routing.gen.ttl.ms", 0.0) / 1e3
+        self._gen_ttl_memo = None  # (gen, monotonic ts)
+        self._rc_gauges = []
+        if self.result_cache_default:
+            # cache-enabled brokers only: the process-global registry keys
+            # gauges by (name, broker_id), and a cache-OFF broker sharing
+            # this id (the common probe/bench pattern) would overwrite a
+            # live cache's gauges — then delete them on its own close().
+            # Two cache-ENABLED brokers in one process still need distinct
+            # broker ids, like servers do for the PR-7 leak guard.
+            for gname, fn in (
+                    ("resultCacheEntries",
+                     (lambda _c=self.result_cache: len(_c))),
+                    ("resultCacheBytes",
+                     (lambda _c=self.result_cache: _c.bytes))):
+                self.metrics.gauge(gname, fn, tag=self.broker_id)
+                self._rc_gauges.append(gname)
         self._channels: dict[str, QueryRouterChannel] = {}
         self._channels_lock = threading.Lock()
         self._request_id = itertools.count(1)
         self._pool = futures.ThreadPoolExecutor(max_workers=16)
 
     def close(self) -> None:
+        for gname in self._rc_gauges:
+            self.metrics.remove_gauge(gname, tag=self.broker_id)
+        self._rc_gauges = []
         for ch in self._channels.values():
             ch.close()
         self._pool.shutdown(wait=False)
+
+    def _routing_gen(self) -> int:
+        """The per-query routing-generation read, optionally TTL-memoized
+        (see routing_gen_ttl_s). TTL 0 reads the registry every query."""
+        ttl = self.routing_gen_ttl_s
+        if ttl <= 0:
+            return self.registry.routing_generation()
+        now = time.monotonic()
+        memo = self._gen_ttl_memo
+        if memo is not None and now - memo[1] < ttl:
+            return memo[0]
+        gen = self.registry.routing_generation()
+        self._gen_ttl_memo = (gen, now)
+        return gen
 
     def _note_abandoned(self, fut, inst: str) -> None:
         """A straggler attempt resolved AFTER its entry settled (hedge
@@ -377,10 +728,22 @@ class Broker:
         else:
             self.failures.mark_failure(inst)
 
+    def _server_instances(self) -> dict:
+        """{instance id: InstanceInfo} for servers, memoized 0.25s — the
+        scatter path's endpoint lookups and the result cache's heartbeat
+        epoch view share one instances read per tick instead of one per
+        query. A restarted server's stale endpoint surfaces as a transport
+        failure inside the window; the replica retry path absorbs it."""
+        now = time.monotonic()
+        ts, info = self._inst_memo
+        if now - ts > 0.25:
+            info = {i.instance_id: i
+                    for i in self.registry.instances(Role.SERVER)}
+            self._inst_memo = (now, info)
+        return info
+
     def _channel(self, instance_id: str) -> Optional[QueryRouterChannel]:
-        info = {i.instance_id: i for i in self.registry.instances(Role.SERVER)}.get(
-            instance_id
-        )
+        info = self._server_instances().get(instance_id)
         if info is None:
             return None
         with self._channels_lock:  # pool threads race per-instance channels
@@ -391,6 +754,112 @@ class Broker:
                 ch = QueryRouterChannel(info.endpoint, tls=self.tls)
                 self._channels[instance_id] = ch
             return ch
+
+    # ---- per-generation registry view ------------------------------------
+    def _gen_view(self, gen=None) -> dict:
+        """The per-query registry lookups, memoized per routing
+        generation. One generation read (``gen=None``) — or zero, when the
+        caller already holds it — replaces the table-name walk and the
+        per-table physical split on every query of a steady cluster."""
+        if gen is None:
+            gen = self.registry.routing_generation()
+        view = self._gen_memo
+        if view.get("gen") != gen:
+            view = {"gen": gen, "tables": set(self.registry.tables()),
+                    "phys": {}}
+            self._gen_memo = view
+        return view
+
+    def _tables_set(self, gen=None) -> set:
+        return self._gen_view(gen)["tables"]
+
+    def _hb_epochs(self) -> dict:
+        """{logical table: {instance: epoch}} from server heartbeats —
+        rides the shared 0.25s instances memo, so the added staleness
+        window is the same order as the heartbeat transport delay (sync
+        tick) it rides on."""
+        out: dict = {}
+        for i in self._server_instances().values():
+            for base, ep in (getattr(i, "table_epochs", None)
+                             or {}).items():
+                if ep:
+                    out.setdefault(base, {})[i.instance_id] = int(ep)
+        return out
+
+    # piggybacked epoch observations not corroborated by a heartbeat
+    # expire after this window: a restarted server (fresh process, no
+    # epochs yet) stops heartbeating the table, and its old ratcheted
+    # observation must not keep pre-restart cache entries valid forever
+    EPOCH_OBS_TTL_S = 10.0
+
+    def _epoch_view(self, raw_table: str) -> dict:
+        """{instance: freshness epoch} for the logical table: live server
+        heartbeat epochs merged with (possibly fresher) piggybacked
+        response reports — the staleness contract a cached entry is
+        validated against on every hit."""
+        from pinot_tpu.common import freshness
+
+        base = freshness.base_table(raw_table)
+        view = dict(self._hb_epochs().get(base, {}))
+        now = time.monotonic()
+        with self._epoch_lock:
+            for inst, (ep, seen) in self._epoch_obs.get(base, {}).items():
+                # nonzero only: an epoch-0 (never-mutated) observation is
+                # restart-stable — post-restart state is identical, and
+                # segment-set changes ride the routing generation — and
+                # cache hits don't scatter, so expiring it would force a
+                # spurious refill miss every TTL on immutable tables
+                if ep and inst not in view \
+                        and now - seen > self.EPOCH_OBS_TTL_S:
+                    continue
+                if ep > view.get(inst, -1):
+                    view[inst] = ep
+        return view
+
+    def _note_epoch(self, physical_table: str, instance_id: str,
+                    epoch: int) -> None:
+        if epoch is None or epoch < 0:
+            return
+        from pinot_tpu.common import freshness
+
+        base = freshness.base_table(physical_table)
+        with self._epoch_lock:
+            per = self._epoch_obs.setdefault(base, {})
+            # last-write-wins, no ratchet: the server is authoritative for
+            # its own epoch, and a LOWER value is how a restarted process
+            # (fresh counter) surfaces under traffic — ratcheting past it
+            # would keep pre-restart cache entries validating forever. An
+            # out-of-order older response regressing the view briefly just
+            # invalidates an entry spuriously (conservative, self-heals on
+            # the next response)
+            per[instance_id] = (epoch, time.monotonic())
+
+    def _result_cache_key(self, q, for_explain: bool = False):
+        """Cache key for this query, or None when the query must not ride
+        the cache (disabled, traced, chaos-armed, or explicitly opted
+        out). ``for_explain`` keys the underlying query of an EXPLAIN so
+        the plan can render CACHED_RESULT."""
+        opts = q.options_ci()
+        use = opts.get("useresultcache")
+        if use is None:
+            enabled = self.result_cache_default
+        elif isinstance(use, bool):
+            enabled = use
+        else:
+            # quoted SET values arrive as strings: 'false' must opt OUT,
+            # not truthy-enable a stale-tolerant path the user refused
+            enabled = str(use).strip().lower() in ("true", "1", "yes")
+        if not enabled or faults.ACTIVE:
+            # chaos harness armed: fault tests repeat queries on purpose
+            # and must observe every injected failure, not a cached hit
+            return None
+        if q.explain and not for_explain:
+            return None
+        if opts.get("trace") or opts.get("faultinject"):
+            return None
+        from pinot_tpu.broker.querylog import template_key
+
+        return self.result_cache.key_for(q, template_key(q))
 
     # ---- request handling ------------------------------------------------
     def execute(self, sql: str) -> dict:
@@ -436,7 +905,11 @@ class Broker:
                 # single-stage queries), stage 2 runs broker-local
                 return self._execute_multistage(stmt, sql, t0)
             q = optimize_query(compile_select(stmt))
-            q = self._resolve_table_case(q)
+            # ONE routing-generation read serves this whole query: quota
+            # rate memo, table-name fold, physical split, routing snapshot
+            # and the result cache all share it
+            gen = self._routing_gen()
+            q = self._resolve_table_case(q, gen)
             if q.explain:
                 from pinot_tpu.engine.explain import explain_plan
 
@@ -447,8 +920,19 @@ class Broker:
                     device = None
                     tables: dict = {}
 
-                return explain_plan(_NoDevice(), q)
-            if not self.quota.acquire(q.table_name):
+                plan = explain_plan(_NoDevice(), q)
+                ck = self._result_cache_key(q, for_explain=True)
+                if ck is not None and self.result_cache.peek_fresh(
+                        ck, self._epoch_view(q.table_name), gen):
+                    # the very next execution of this query would serve
+                    # from the broker result cache — surface it on top
+                    rows = plan["resultTable"]["rows"]
+                    lines = ["CACHED_RESULT(broker result cache: "
+                             "fresh entry)"] + [r[0] for r in rows]
+                    plan["resultTable"]["rows"] = [
+                        [ln, i, i - 1] for i, ln in enumerate(lines)]
+                return plan
+            if not self.quota.acquire(q.table_name, gen):
                 # quota rejection before any fan-out
                 # (BaseBrokerRequestHandler's quota check placement)
                 self.metrics.count("queriesQuotaExceeded")
@@ -459,9 +943,30 @@ class Broker:
                     # pacing hint for clients (Retry-After analog): the
                     # token bucket refills within about a second
                     "retryAfterSeconds": 0.5}, t0)
+            cache_key = self._result_cache_key(q)
+            cache_gen = None
+            cache_view = None
+            if cache_key is not None:
+                # the generation and epoch view are captured BEFORE the
+                # scatter: a cluster change mid-flight stores an entry
+                # that can never validate (conservative), not one that
+                # serves stale
+                cache_gen = gen
+                cache_view = self._epoch_view(q.table_name)
+                cached = self.result_cache.get(
+                    cache_key, cache_view, cache_gen)
+                if cached is not None:
+                    self.metrics.count("resultCacheHits")
+                    resp = dict(cached)
+                    resp["resultCacheHit"] = True
+                    resp["requestId"] = next(self._request_id)
+                    resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
+                    self.metrics.time_ms("query", resp["timeUsedMs"])
+                    return self._log_query(sql, q, resp, t0)
+                self.metrics.count("resultCacheMisses")
             if q.options_ci().get("trace"):
                 tracer = trace.start_trace()
-            resp = self._scatter_gather(q, sql)
+            resp = self._scatter_gather(q, sql, gen)
             if tracer is not None:
                 resp.setdefault("traceInfo", {})["broker"] = tracer.to_json()
                 if tracer.trace_id:
@@ -474,8 +979,21 @@ class Broker:
         finally:
             if tracer is not None:
                 trace.end_trace()
+        own_epochs = resp.pop("__epochView__", None)
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
         self.metrics.time_ms("query", resp["timeUsedMs"])
+        if cache_key is not None:
+            resp["resultCacheHit"] = False
+            if not resp.get("exceptions") and not resp.get("partialResult"):
+                # only COMPLETE successes cache. The recorded view is the
+                # PRE-scatter view overlaid with the epochs THIS query's
+                # own partials piggybacked — never the global observation
+                # state at put time, which may already hold epochs newer
+                # than the data these rows reflect (a concurrent ingest +
+                # query landing mid-gather would stamp stale rows fresh)
+                put_view = dict(cache_view or {})
+                put_view.update(own_epochs or {})
+                self.result_cache.put(cache_key, resp, put_view, cache_gen)
         return self._log_query(sql, q, resp, t0)
 
     def _execute_multistage(self, stmt, sql: str, t0: float) -> dict:
@@ -684,13 +1202,14 @@ class Broker:
             log.exception("query log record failed")
         return resp
 
-    def _resolve_table_case(self, q: QueryContext) -> QueryContext:
+    def _resolve_table_case(self, q: QueryContext,
+                            gen=None) -> QueryContext:
         """Case-insensitive table resolution against the registry
         (BaseBrokerRequestHandler.java:245-254 / TableCache's
         ignore-case lookup): FROM mytable matches a registered MyTable.
         Exact matches win; ambiguous case-folds keep the literal name."""
         raw = q.table_name
-        names = set(self.registry.tables())
+        names = self._tables_set(gen)
         candidates = {raw, f"{raw}_OFFLINE", f"{raw}_REALTIME"}
         if candidates & names:
             return q
@@ -710,6 +1229,9 @@ class Broker:
         positions the servers produced."""
         from pinot_tpu.query.rewrite import expand_star
 
+        if not any(e.is_identifier and e.name == "*"
+                   for e in q.select_expressions):
+            return q  # no star: don't pay a schema read per query
         schema = None
         for key in (q.table_name, f"{q.table_name}_OFFLINE", f"{q.table_name}_REALTIME"):
             schema = self.registry.table_schema(key)
@@ -719,15 +1241,41 @@ class Broker:
             return q
         return expand_star(q, schema.column_names())
 
-    def _physical_tables(self, raw: str) -> list:
+    def _physical_tables(self, raw: str, gen=None) -> list:
         """Raw table name → [(physical key, time filter or None)].
 
         A hybrid table (both _OFFLINE and _REALTIME registered) is split at
         the time boundary = max offline segment end time: offline answers
         time <= boundary, realtime answers time > boundary
         (routing/timeboundary/TimeBoundaryManager.java +
-        BaseBrokerRequestHandler.java:387-395)."""
-        tables = set(self.registry.tables())
+        BaseBrokerRequestHandler.java:387-395).
+
+        Memoized per routing generation (exact: the name set, table config
+        and boundary inputs all ride routing sections) — the steady-state
+        hot path pays a dict lookup, not a registry walk per query."""
+        view = self._gen_view(gen)
+        hit = view["phys"].get(raw)
+        if hit is not None:
+            return hit
+        out = self._split_physical(raw, view["tables"])
+        view["phys"][raw] = out
+        return out
+
+    def _pruning_inputs(self, physical: str, gen=None) -> tuple:
+        """(segment records, time column) for broker-side pruning,
+        memoized per routing generation like the physical split (segment
+        records and table config both ride routing sections)."""
+        view = self._gen_view(gen)
+        hit = view.get(("prune", physical))
+        if hit is not None:
+            return hit
+        records = self.registry.segments(physical)
+        cfg = self.registry.table_config(physical)
+        out = (records, cfg.time_column if cfg is not None else None)
+        view[("prune", physical)] = out
+        return out
+
+    def _split_physical(self, raw: str, tables: set) -> list:
         if raw in tables:
             return [(raw, None)]
         off, rt = f"{raw}_OFFLINE", f"{raw}_REALTIME"
@@ -773,7 +1321,19 @@ class Broker:
             raise KeyError(f"table {raw!r} not found")
         return out
 
-    def _scatter_gather(self, q: QueryContext, sql: str) -> dict:
+    def _scatter_gather(self, q: QueryContext, sql: str, gen=None) -> dict:
+        """Thin reservation bracket around the scatter body: routing
+        reserves the picked instances' outstanding counts atomically with
+        the pick (concurrent queries balance instead of herding), and the
+        release is guaranteed here however the query settles."""
+        reserved: list = []
+        try:
+            return self._scatter_gather_inner(q, sql, reserved, gen)
+        finally:
+            self.routing.release(reserved)
+
+    def _scatter_gather_inner(self, q: QueryContext, sql: str,
+                              reserved: list, gen=None) -> dict:
         from pinot_tpu.common.trace import active, span
 
         q = self._expand_star(q)
@@ -814,16 +1374,37 @@ class Broker:
         num_pruned = 0
         num_pruned_value = 0  # excluded by per-column min/max stats alone
         fully_pruned = []  # fallback: keep one segment so reduce sees a shape
+        # replica-group attribution (ISSUE 10 satellite): how many groups
+        # this query's routing touched + the chosen group's load score, so
+        # the query log and bench can attribute tail latency to routing
+        rg_queried = 0
+        rg_load_score = None
+        rg_name = None
+        # freshness epochs piggybacked by THIS query's own partials — the
+        # result cache records these (merged over the pre-scatter view),
+        # never the global observation state at put time, which can hold
+        # epochs newer than the data this query actually scanned
+        own_epochs: dict = {}
         with span("broker.route"):
-            for physical, time_filter in self._physical_tables(q.table_name):
-                routing, reps = self.routing.routing_with_replicas(physical)
+            for physical, time_filter in self._physical_tables(q.table_name,
+                                                               gen):
+                routing, reps, rinfo = \
+                    self.routing.routing_with_replicas(physical,
+                                                       reserve=True,
+                                                       gen=gen)
+                reserved.extend(rinfo.get("reserved", ()))
+                rg_queried += int(rinfo.get("numReplicaGroupsQueried", 0)
+                                  or 0)
+                if rinfo.get("loadScore") is not None and \
+                        (rg_load_score is None
+                         or rinfo["loadScore"] > rg_load_score):
+                    rg_load_score = rinfo["loadScore"]
+                    rg_name = rinfo.get("replicaGroup")
                 if not routing:
                     continue
                 for seg, insts in reps.items():
                     replicas[(physical, seg)] = insts
-                records = self.registry.segments(physical)
-                cfg = self.registry.table_config(physical)
-                time_col = cfg.time_column if cfg is not None else None
+                records, time_col = self._pruning_inputs(physical, gen)
                 for inst, segs in routing.items():
                     kept, pruned, by_value = prune_segments(
                         q, records, segs, time_col, time_filter)
@@ -983,15 +1564,6 @@ class Broker:
                 groups.setdefault(pick, []).append(seg)
             return groups
 
-        for inst, phys, segs, tf in scatter:
-            e = {
-                "inst": inst, "phys": phys, "segs": segs, "tf": tf,
-                "futs": [], "ev": threading.Event(), "attempted": {inst},
-                "consumed": set(),
-            }
-            submit_attempt(e, inst)
-            entries.append(e)
-
         # hedging (SET useHedging=true / pinot.broker.hedging.enabled):
         # after the target replica's rolling p90 (or the configured fixed
         # delay), duplicate a still-unanswered request to a second
@@ -1001,6 +1573,36 @@ class Broker:
         hedging = (not use_streaming) and (
             opts.get("usehedging") is True
             or (self.hedging_enabled and opts.get("usehedging") is not False))
+
+        for inst, phys, segs, tf in scatter:
+            entries.append({
+                "inst": inst, "phys": phys, "segs": segs, "tf": tf,
+                "futs": [], "ev": threading.Event(), "attempted": {inst},
+                "consumed": set(),
+            })
+        if len(entries) == 1 and not hedging and not faults.ACTIVE:
+            # replica-group routing's common case: the WHOLE query goes to
+            # one server. Run the primary attempt inline on this thread —
+            # the pool handoff + event wakeup are pure overhead (several
+            # cross-thread futex round-trips per query, each a sentry trip
+            # under sandboxed kernels) when there is nothing to overlap.
+            # Failures still flow through harvest's retry machinery via
+            # the pre-resolved future. Chaos runs keep the pool path: the
+            # deadline-bounded event wait is what bounds a blackholed RPC.
+            e = entries[0]
+            fut: futures.Future = futures.Future()
+            try:
+                fut.set_result(call(e["inst"], e["phys"], e["segs"],
+                                    e["tf"], "primary"))
+            except BaseException as exc:  # noqa: BLE001 — future carries it
+                fut.set_exception(exc)
+            with entries_lock:
+                e["futs"].append(
+                    (fut, e["inst"], frozenset(e["segs"]), "primary"))
+            e["ev"].set()
+        else:
+            for e in entries:
+                submit_attempt(e, e["inst"])
 
         def maybe_hedge(e):
             if deadline.expired():
@@ -1209,6 +1811,19 @@ class Broker:
                     for r in parts:
                         if r.trace is not None:
                             server_traces.setdefault(tkey, []).extend(r.trace)
+                        # piggybacked load + freshness (ISSUE 10): feed
+                        # the decayed load score and the result cache's
+                        # per-table epoch view BEFORE stats merge away
+                        # the per-instance values
+                        st = r.stats
+                        if st.server_pressure >= 0 or st.server_inflight >= 0:
+                            self.routing.loads.observe(
+                                inst, max(0, st.server_pressure),
+                                max(0, st.server_inflight))
+                        self._note_epoch(e["phys"], inst, st.table_epoch)
+                        if st.table_epoch is not None and \
+                                st.table_epoch > own_epochs.get(inst, -1):
+                            own_epochs[inst] = st.table_epoch
                         results.append(r)
                     if parts:
                         responded.add(inst)
@@ -1224,15 +1839,20 @@ class Broker:
                 # nothing answered before the budget expired: a typed
                 # in-band QUERY_TIMEOUT response, delivered promptly —
                 # not an opaque ConnectionError after N server waits
-                return {
+                resp_timeout = {
                     "exceptions": exceptions,
                     "partialResult": True,
                     "numServersQueried": len(n_servers | attempted_all),
                     "numServersResponded": len(responded),
                     "numRetries": attempt_counts["retries"],
                     "numHedges": attempt_counts["hedges"],
+                    "numReplicaGroupsQueried": rg_queried,
                     "requestId": request_id,
                 }
+                if rg_load_score is not None:
+                    resp_timeout["loadScore"] = rg_load_score
+                    resp_timeout["replicaGroup"] = rg_name
+                return resp_timeout
             raise ConnectionError(f"all servers failed: {exceptions}")
 
         with span("broker.reduce"):
@@ -1253,6 +1873,9 @@ class Broker:
                 "numServersResponded": len(responded),
                 "numRetries": attempt_counts["retries"],
                 "numHedges": attempt_counts["hedges"],
+                # replica-group routing attribution (ISSUE 10): groups
+                # touched + the chosen group's load score at pick time
+                "numReplicaGroupsQueried": rg_queried,
                 "numDocsScanned": stats.num_docs_scanned,
                 "numEntriesScannedInFilter": stats.num_entries_scanned_in_filter,
                 "numEntriesScannedPostFilter": stats.num_entries_scanned_post_filter,
@@ -1275,4 +1898,10 @@ class Broker:
                 "requestId": request_id,
             }
         )
+        if rg_load_score is not None:
+            resp["loadScore"] = rg_load_score
+            resp["replicaGroup"] = rg_name
+        # internal side channel for the result cache's put (stripped by
+        # execute before the response leaves the broker)
+        resp["__epochView__"] = own_epochs
         return resp

@@ -165,6 +165,11 @@ class QueryLogger:
                     "numSegmentsPrunedByServer", "numBlocksPruned",
                     "numDocsScanned", "numGroupsLimitReached",
                     "partialsCacheHit",
+                    # cluster-tier attribution (ISSUE 10): which replica
+                    # group took the query at what load score, and whether
+                    # the broker result cache answered without a scatter
+                    "numReplicaGroupsQueried", "replicaGroup",
+                    "loadScore", "resultCacheHit",
                 ) if resp.get(k) is not None
             },
         }

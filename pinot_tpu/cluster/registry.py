@@ -58,6 +58,13 @@ class InstanceInfo:
     last_heartbeat_ms: int = 0
     # instance tags (Helix tag analog): tier placement targets one tag
     tags: list = dataclasses.field(default_factory=list)
+    # load signal published with the heartbeat (scheduler pressure():
+    # admitted + queued queries) — the broker's load-aware routing reads
+    # it when no fresher piggybacked response signal exists
+    pressure: float = 0.0
+    # {logical table: freshness epoch} (common/freshness.py) — the broker
+    # result cache's staleness view when queries aren't flowing
+    table_epochs: dict = dataclasses.field(default_factory=dict)
 
     @property
     def endpoint(self) -> str:
@@ -100,6 +107,7 @@ def _to_json(state: dict) -> dict:
         "tasks": state.get("tasks", {}),
         "task_metadata": state.get("task_metadata", {}),
         "segment_lineage": state.get("segment_lineage", {}),
+        "replica_groups": state.get("replica_groups", {}),
     }
 
 
@@ -119,6 +127,7 @@ def _from_json(d: dict) -> dict:
         "tasks": d.get("tasks", {}),
         "task_metadata": d.get("task_metadata", {}),
         "segment_lineage": d.get("segment_lineage", {}),
+        "replica_groups": d.get("replica_groups", {}),
     }
 
 
@@ -135,8 +144,41 @@ class ClusterRegistry:
             "assignment": {},
             "external_view": {},
             "partition_assignment": {},
+            "replica_groups": {},
             "leases": {},
         }
+        # bumped by every mutation that can change what a query routes to
+        # or reads (segments, assignment, external view, lineage, replica
+        # groups — NOT heartbeats): the broker's routing snapshot cache
+        # and result cache key on it (ISSUE 10)
+        self._routing_gen = 0
+        self._write_ver = 0  # any-write token (state_version)
+
+    def _note_routing_change(self) -> None:
+        with self._lock:
+            self._routing_gen += 1
+
+    def routing_generation(self) -> int:
+        """Cheap monotonic token: while it holds still, a broker may
+        reuse its cached routing snapshot and serve fresh-epoch cached
+        results (FileRegistry overrides this with per-section version
+        counters so the token is cross-process)."""
+        with self._lock:
+            return self._routing_gen
+
+    def state_version(self) -> int:
+        """Change token over the whole state: pollers skip work while it
+        holds still. The in-memory form bumps on EVERY write tx (an
+        over-approximation — heartbeats count — but in-process polls are
+        nanoseconds; FileRegistry narrows it to real section changes)."""
+        with self._lock:
+            return self._write_ver
+
+    def sections_version(self, sections) -> int:
+        """Section-subset change token (FileRegistry refines this to the
+        named sections' version counters; in-memory, any write bumps)."""
+        with self._lock:
+            return self._write_ver
 
     # ---- tx plumbing (overridden by FileRegistry) ------------------------
     def _read(self) -> dict:
@@ -151,6 +193,7 @@ class ClusterRegistry:
             out = fn(state)
             if write:
                 self._write(state)
+                self._write_ver += 1
             return out
 
     def _tx_read(self, fn):
@@ -161,10 +204,21 @@ class ClusterRegistry:
         info.last_heartbeat_ms = int(time.time() * 1000)
         self._tx(lambda s: s["instances"].__setitem__(info.instance_id, info))
 
-    def heartbeat(self, instance_id: str) -> None:
+    def heartbeat(self, instance_id: str, pressure: float = None,
+                  table_epochs: dict = None) -> None:
+        """Liveness tick, optionally carrying the instance's current load
+        (scheduler pressure) and per-table freshness epochs — the passive
+        half of the broker's load/staleness view (the active half rides
+        piggybacked in every DataTable response)."""
+
         def fn(s):
-            if instance_id in s["instances"]:
-                s["instances"][instance_id].last_heartbeat_ms = int(time.time() * 1000)
+            info = s["instances"].get(instance_id)
+            if info is not None:
+                info.last_heartbeat_ms = int(time.time() * 1000)
+                if pressure is not None:
+                    info.pressure = float(pressure)
+                if table_epochs is not None:
+                    info.table_epochs = dict(table_epochs)
 
         self._tx(fn)
 
@@ -273,6 +327,7 @@ class ClusterRegistry:
                         ev[seg] = [i for i in ev[seg] if i != instance_id]
 
         self._tx(fn)
+        self._note_routing_change()
 
     # ---- tables ----------------------------------------------------------
     def add_table(self, config: TableConfig, schema: Schema,
@@ -286,14 +341,17 @@ class ClusterRegistry:
             s["assignment"].setdefault(key, {})
 
         self._tx(fn)
+        self._note_routing_change()
 
     def drop_table(self, table: str) -> None:
         def fn(s):
             for key in ("tables", "schemas", "segments", "assignment",
-                        "external_view", "partition_assignment"):
+                        "external_view", "partition_assignment",
+                        "replica_groups"):
                 s[key].pop(table, None)
 
         self._tx(fn)
+        self._note_routing_change()
 
     def update_schema(self, table: str, schema: Schema) -> None:
         """Schema evolution: replace a registered table's schema (the
@@ -321,6 +379,9 @@ class ClusterRegistry:
             s["tables"][table] = config.to_json()
 
         self._tx(fn)
+        # config rides the tables section: broker memos keyed on the
+        # routing generation (quota rates, table-name sets) must refresh
+        self._note_routing_change()
 
     def table_schema(self, table: str) -> Optional[Schema]:
         d = self._tx_read(lambda s: s["schemas"].get(table))
@@ -356,6 +417,7 @@ class ClusterRegistry:
                 assign[record.name] = list(instance_ids)
 
         self._tx(fn)
+        self._note_routing_change()
 
     def remove_segment(self, table: str, name: str) -> None:
         def fn(s):
@@ -363,6 +425,7 @@ class ClusterRegistry:
             s["assignment"].get(table, {}).pop(name, None)
 
         self._tx(fn)
+        self._note_routing_change()
 
     def segments(self, table: str) -> dict:
         return self._tx_read(lambda s: dict(s["segments"].get(table, {})))
@@ -374,6 +437,24 @@ class ClusterRegistry:
         self._tx(lambda s: s["assignment"].__setitem__(
             table, {k: list(v) for k, v in mapping.items()}
         ))
+        self._note_routing_change()
+
+    # ---- replica groups (ReplicaGroupSegmentAssignment analog) -----------
+    def set_replica_groups(self, table: str, groups: dict) -> None:
+        """{group name: [instance ids]} — each group holds ONE complete
+        replica of the table; the broker routes a whole query to one
+        group's instances (InstanceSelector over replica-group instance
+        partitions in the reference)."""
+        self._tx(lambda s: s["replica_groups"].__setitem__(
+            table, {str(k): list(v) for k, v in groups.items()}
+        ))
+        self._note_routing_change()
+
+    def replica_groups(self, table: str) -> dict:
+        return self._tx_read(
+            lambda s: {k: list(v) for k, v in
+                       s["replica_groups"].get(table, {}).items()}
+        )
 
     def assigned_segments(self, instance_id: str) -> dict:
         """{table: [segment names]} hosted by this instance (server sync)."""
@@ -394,19 +475,28 @@ class ClusterRegistry:
         for right now (loaded immutable + live consuming segments)."""
 
         def fn(s):
+            # change-tracked: the steady-state sync tick (same serving set
+            # every 200ms) must not churn the routing generation and blow
+            # the broker's routing/result caches
+            changed = False
             ev_all = s["external_view"]
             for table, ev in ev_all.items():
+                keep = set(serving.get(table, ()))
                 for seg in list(ev):
-                    if instance_id in ev[seg]:
+                    if instance_id in ev[seg] and seg not in keep:
                         ev[seg] = [i for i in ev[seg] if i != instance_id]
+                        changed = True
             for table, names in serving.items():
                 ev = ev_all.setdefault(table, {})
                 for name in names:
                     lst = ev.setdefault(name, [])
                     if instance_id not in lst:
                         lst.append(instance_id)
+                        changed = True
+            return changed
 
-        self._tx(fn)
+        if self._tx(fn):
+            self._note_routing_change()
 
     def scrub_instances(self, instance_ids) -> None:
         """Remove hard-dead instances from every external-view entry in one
@@ -429,6 +519,7 @@ class ClusterRegistry:
                         ev[seg] = [i for i in insts if i not in ids]
 
         self._tx(fn)
+        self._note_routing_change()
 
     def external_view(self, table: str) -> dict:
         return self._tx_read(
@@ -679,7 +770,9 @@ class ClusterRegistry:
             }
             return lid
 
-        return self._tx(fn)
+        lid = self._tx(fn)
+        self._note_routing_change()
+        return lid
 
     def complete_lineage(self, table: str, lineage_id: str) -> bool:
         """CAS flip IN_PROGRESS → COMPLETED. Returns False if the entry was
@@ -694,7 +787,10 @@ class ClusterRegistry:
             e["ts_ms"] = int(time.time() * 1000)
             return True
 
-        return self._tx(fn)
+        out = self._tx(fn)
+        if out:
+            self._note_routing_change()
+        return out
 
     def try_abort_lineage(self, table: str, lineage_id: str) -> bool:
         """CAS IN_PROGRESS → ABORTING (controller repair claims the unwind).
@@ -709,7 +805,10 @@ class ClusterRegistry:
             e["ts_ms"] = int(time.time() * 1000)
             return True
 
-        return self._tx(fn)
+        out = self._tx(fn)
+        if out:
+            self._note_routing_change()
+        return out
 
     def revert_lineage(self, table: str, lineage_id: str) -> bool:
         """Drop a non-COMPLETED entry (failed/aborted replace). A COMPLETED
@@ -724,7 +823,10 @@ class ClusterRegistry:
             del lin[lineage_id]
             return True
 
-        return self._tx(fn)
+        out = self._tx(fn)
+        if out:
+            self._note_routing_change()
+        return out
 
     def lineage(self, table: str) -> dict:
         return self._tx_read(
@@ -779,7 +881,16 @@ class ClusterRegistry:
 _SECTIONS = (
     "instances", "tables", "schemas", "segments", "assignment",
     "external_view", "partition_assignment", "segment_completion",
-    "tasks", "task_metadata", "segment_lineage", "leases",
+    "tasks", "task_metadata", "segment_lineage", "replica_groups",
+    "leases",
+)
+
+# sections whose change means "what a query routes to (or would read)
+# moved" — the FileRegistry's routing generation sums exactly these
+# version counters, so heartbeats/leases/tasks never blow broker caches
+_ROUTING_SECTIONS = (
+    "tables", "segments", "assignment", "external_view",
+    "segment_lineage", "replica_groups",
 )
 
 
@@ -856,6 +967,7 @@ class FileRegistry(ClusterRegistry):
         self._cache: dict = {}      # section -> parsed state
         self._raw: dict = {}        # section -> serialized text (dirty check)
         self._sig: dict = {}        # section -> file stat signature
+        self._lock_fh = None        # persistent flock fd (see _locked)
         self._migrate_legacy()
 
     def _migrate_legacy(self) -> None:
@@ -878,12 +990,19 @@ class FileRegistry(ClusterRegistry):
     @contextlib.contextmanager
     def _locked(self, write: bool):
         with self._lock:
-            with open(self._lock_path, "a+") as lf:
-                fcntl.flock(lf, fcntl.LOCK_EX if write else fcntl.LOCK_SH)
-                try:
-                    yield
-                finally:
-                    fcntl.flock(lf, fcntl.LOCK_UN)
+            # the lock fd is opened ONCE and kept: under sandboxed kernels
+            # (gVisor-class gofer fs) every open() is an ~ms RPC, and the
+            # old open-per-tx pattern made the file lock itself the most
+            # expensive part of an otherwise cached read tx. self._lock
+            # already serializes threads, so one fd per process is safe.
+            lf = self._lock_fh
+            if lf is None or lf.closed:
+                lf = self._lock_fh = open(self._lock_path, "a+")
+            fcntl.flock(lf, fcntl.LOCK_EX if write else fcntl.LOCK_SH)
+            try:
+                yield
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
 
     def _read_versions(self) -> dict:
         """Per-section change counters — one tiny file read per tx; a
@@ -1030,6 +1149,26 @@ class FileRegistry(ClusterRegistry):
 
     def state_version(self) -> int:
         """Cheap change token: pollers can skip work while it holds still
-        (the ZK-watch analog for file-backed clusters)."""
-        with self._locked(write=False):
-            return sum(self._read_versions().values())
+        (the ZK-watch analog for file-backed clusters). Lock-free like
+        routing_generation: the version file is replaced atomically, so a
+        torn read is impossible and the flock would only add syscalls to
+        the hot polling path."""
+        return sum(self._read_versions().values())
+
+    def sections_version(self, sections) -> int:
+        """Change token over a CHOSEN section subset — the server sync
+        loop polls (tables, schemas, segments, assignment,
+        partition_assignment, ...) without being re-triggered by every
+        controller lease renewal, peer heartbeat, or external-view
+        publish (lock-free, see state_version)."""
+        v = self._read_versions()
+        return sum(v.get(name, 0) for name in sections)
+
+    def routing_generation(self) -> int:
+        """Cross-process routing-change token: the sum of the ROUTING
+        section version counters (the version file is written atomically,
+        so this reads lock-free). Heartbeats touch only instances.json and
+        don't move it — byte-identical section writes are skipped at
+        staging, so a steady-state sync tick bumps nothing."""
+        v = self._read_versions()
+        return sum(v.get(name, 0) for name in _ROUTING_SECTIONS)

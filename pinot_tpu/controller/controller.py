@@ -158,6 +158,126 @@ class SegmentAssigner:
         self.registry.set_assignment(table, new)
         return new
 
+    # ---- replica groups (ISSUE 10) ---------------------------------------
+    # assignment/segment/ReplicaGroupSegmentAssignmentStrategy +
+    # InstanceReplicaGroupPartitionSelector analog: live servers are
+    # partitioned into R named groups, each holding ONE complete replica
+    # of the table; every segment places exactly one copy in each group.
+    # The broker then routes a whole query to a single group's instances
+    # (instead of ad-hoc per-segment replica picks), which is what makes
+    # per-group load attribution — and near-linear multi-server QPS —
+    # possible.
+
+    def build_replica_groups(self, table: str, replication: int) -> dict:
+        """Minimal-change group membership for the live server set: keep
+        every surviving member in its current group, fill new servers into
+        the smallest groups, and only then level residual skew. Returns
+        {group name: [instance ids]} (empty when no live servers)."""
+        live = sorted(i.instance_id for i in self._live_servers())
+        if not live:
+            return {}
+        r = max(1, min(replication, len(live)))
+        names = [f"rg_{i}" for i in range(r)]
+        old = self.registry.replica_groups(table)
+        groups: dict = {}
+        assigned: set = set()
+        for name in names:
+            members = [m for m in old.get(name, ())
+                       if m in live and m not in assigned]
+            groups[name] = members
+            assigned.update(members)
+        for inst in live:
+            if inst not in assigned:
+                smallest = min(names, key=lambda n: (len(groups[n]), n))
+                groups[smallest].append(inst)
+        # level heavy skew (dissolved groups / uneven survivors): move one
+        # member at a time from the largest to the smallest group
+        while True:
+            small = min(names, key=lambda n: (len(groups[n]), n))
+            big = max(names, key=lambda n: (len(groups[n]), n))
+            if len(groups[big]) - len(groups[small]) <= 1:
+                break
+            groups[small].append(groups[big].pop())
+        return groups
+
+    def rebalance_replica_groups(self, table: str, replication: int) -> dict:
+        """(Re)build groups + per-group segment placement; writes both the
+        group map and the assignment. Movement is minimal: membership
+        keeps survivors in place, and unpartitioned segments move only to
+        fix replication or to fill a joined server up to its fair share
+        (ceil(n_segments / group size)). Partitioned segments place
+        DETERMINISTICALLY by partition id — co-partitioned segments land
+        on the same member, so a partition-EQ query (which the broker
+        prunes with the same common/pruning.py algebra the server uses)
+        touches exactly one instance per group."""
+        groups = self.build_replica_groups(table, replication)
+        if not groups:
+            return {}
+        records = self.registry.segments(table)
+        current = self.registry.assignment(table)
+        seg_names = sorted(set(records) | set(current))
+        new: dict = {}
+        for name in sorted(groups):
+            members = groups[name]
+            if not members:
+                continue
+            cap = -(-max(1, len(seg_names)) // len(members))
+            counts = {m: 0 for m in members}
+            placed: dict = {}
+            mset = set(members)
+            # pass 1: partition-determined + sticky placements
+            for seg in seg_names:
+                rec = records.get(seg)
+                cur = [i for i in current.get(seg, ()) if i in mset]
+                if rec is not None and rec.partition_ids:
+                    pick = members[int(rec.partition_ids[0]) % len(members)]
+                elif cur and counts[cur[0]] < cap:
+                    pick = cur[0]
+                else:
+                    continue  # homeless: place in pass 2, least-loaded
+                placed[seg] = pick
+                counts[pick] += 1
+            # pass 2: everything else goes least-loaded
+            for seg in seg_names:
+                if seg in placed:
+                    continue
+                pick = min(members, key=lambda m: (counts[m], m))
+                placed[seg] = pick
+                counts[pick] += 1
+            for seg, pick in placed.items():
+                new.setdefault(seg, []).append(pick)
+        self.registry.set_replica_groups(table, groups)
+        self.registry.set_assignment(table, new)
+        return new
+
+    def assign_with_groups(self, table: str, rec) -> Optional[list]:
+        """Upload-path placement when a replica-group map exists: one
+        member per group (partition-aware, else least-loaded by current
+        assignment). None when the table has no usable group map — the
+        caller falls back to the balanced legacy strategy."""
+        groups = self.registry.replica_groups(table)
+        live = {i.instance_id for i in self._live_servers()}
+        groups = {n: [m for m in ms if m in live] for n, ms in groups.items()}
+        groups = {n: ms for n, ms in groups.items() if ms}
+        if not groups:
+            return None
+        current = self.registry.assignment(table)
+        counts: dict = {}
+        for insts in current.values():
+            for i in insts:
+                counts[i] = counts.get(i, 0) + 1
+        out = []
+        for name in sorted(groups):
+            members = groups[name]
+            if rec is not None and rec.partition_ids:
+                pick = members[int(rec.partition_ids[0]) % len(members)]
+            else:
+                pick = min(members, key=lambda m: (counts.get(m, 0), m))
+            counts[pick] = counts.get(pick, 0) + 1
+            if pick not in out:
+                out.append(pick)
+        return out
+
 
 class Controller:
     def __init__(self, registry: ClusterRegistry, deep_store_dir: str,
@@ -421,10 +541,14 @@ class Controller:
                     cfg = self.registry.table_config(table)
                     if cfg is None:
                         continue
-                    self.assigner.rebalance(
-                        table, self._table_replication(cfg),
-                        servers=sorted(hard_live),
-                    )
+                    if self.registry.replica_groups(table):
+                        self.assigner.rebalance_replica_groups(
+                            table, self._table_replication(cfg))
+                    else:
+                        self.assigner.rebalance(
+                            table, self._table_replication(cfg),
+                            servers=sorted(hard_live),
+                        )
         return changed
 
     # ---- segment lifecycle -----------------------------------------------
@@ -460,7 +584,9 @@ class Controller:
             **_partition_record_fields(meta),
             **_column_stats_fields(meta),
         )
-        instances = self.assigner.assign(self._table_replication(cfg))
+        instances = self.assigner.assign_with_groups(table, record)
+        if instances is None:
+            instances = self.assigner.assign(self._table_replication(cfg))
         self.registry.add_segment(record, instances)
         return record
 
@@ -482,7 +608,48 @@ class Controller:
         cfg = self.registry.table_config(table)
         if cfg is None:
             raise KeyError(f"table {table!r} not found")
+        if self.registry.replica_groups(table):
+            # replica-group-aware tables stay replica-group-aware: a plain
+            # rebalance must not silently collapse the group structure
+            return self.assigner.rebalance_replica_groups(
+                table, self._table_replication(cfg))
         return self.assigner.rebalance(table, self._table_replication(cfg))
+
+    def setup_replica_groups(self, table: str) -> dict:
+        """Opt a table into replica-group segment assignment (ISSUE 10):
+        partitions the live servers into ``replication`` named groups and
+        places every segment once per group. From here on uploads place
+        group-aware and ``rebalance``/the periodic repair keep the group
+        map consistent with membership. Returns the new assignment."""
+        table = self.resolve(table)
+        cfg = self.registry.table_config(table)
+        if cfg is None:
+            raise KeyError(f"table {table!r} not found")
+        return self.assigner.rebalance_replica_groups(
+            table, self._table_replication(cfg))
+
+    def run_replica_group_repair(self) -> list:
+        """Rebalance-on-join/leave for replica-group tables: when the live
+        server set no longer matches a table's group membership (a server
+        joined, died, or deregistered), rebuild the groups with minimal
+        movement. Runs from the periodic loop like the other repairs."""
+        live = {i.instance_id for i in self.assigner._live_servers()}
+        fixed = []
+        for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA partitioning)
+            groups = self.registry.replica_groups(table)
+            if not groups:
+                continue
+            members = {m for ms in groups.values() for m in ms}
+            if members != live and live:
+                cfg = self.registry.table_config(table)
+                if cfg is None:
+                    continue
+                self.assigner.rebalance_replica_groups(
+                    table, self._table_replication(cfg))
+                fixed.append(table)
+        return fixed
 
     # ---- minion task generation (PinotTaskManager analog) ----------------
     def run_task_generation(self, now_ms: Optional[int] = None) -> list:
@@ -629,6 +796,7 @@ class Controller:
                 # holder only
                 steps = [self.run_retention, self.run_realtime_repair,
                          self.run_dim_table_replication,
+                         self.run_replica_group_repair,
                          self.run_segment_relocation]
                 if self._leads_global():
                     steps += [self.run_task_generation, self.run_task_repair]

@@ -636,6 +636,63 @@ def factorize_multi(cols: list) -> tuple:
     return keys, ginv
 
 
+# bincount table bound for the dictionary group-key fast path: the combined
+# code space (product of group-column cardinalities) must stay small enough
+# that one flat int64 count array beats sorting (8 MB at the bound)
+DICT_GROUP_MAX_PRODUCT = 1 << 20
+
+
+def dict_factorize_multi(ev, group_exprs, doc_idx):
+    """(unique_key_arrays, group_idx, n_groups) via dictionary ids, or None
+    when any group expression can't ride the fast path.
+
+    The reference's DictionaryBasedGroupKeyGenerator regime: when every
+    group key is a single-value DICT column, group on the forward-index
+    ids directly — one bincount over the combined code space instead of a
+    value-space sort — and decode ONLY the surviving group keys through
+    the dictionary. Immutable dictionaries are sorted (id order == value
+    order), so ascending combined codes enumerate exactly the same
+    (lexicographically sorted) key tuples ``factorize_multi`` produces:
+    the two paths are interchangeable bit-exactly."""
+    seg = ev.seg
+    if not isinstance(seg, ImmutableSegment):
+        return None  # mutable dictionaries grow in insert order: unsorted
+    cards = []
+    dicts = []
+    for g in group_exprs:
+        if not g.is_identifier or g.name.startswith("$"):
+            return None
+        meta = seg.metadata.columns.get(g.name)
+        if meta is None or not meta.single_value or not meta.has_dictionary:
+            return None
+        d = seg.dictionary(g.name)
+        if d is None or len(d) == 0:
+            return None
+        dicts.append(d)
+        cards.append(len(d))
+    product = 1
+    for c in cards:
+        product *= c
+        if product > DICT_GROUP_MAX_PRODUCT:
+            return None
+    combined = None
+    for g, card in zip(group_exprs, cards):
+        ids = np.asarray(seg.forward(g.name))[: ev.n][doc_idx]
+        ids = ids.astype(np.int64, copy=False)
+        combined = ids if combined is None else combined * card + ids
+    present = np.flatnonzero(np.bincount(combined, minlength=product))
+    lut = np.empty(product, dtype=np.int64)
+    lut[present] = np.arange(len(present), dtype=np.int64)
+    ginv = lut[combined]
+    keys = []
+    rem = present
+    for card, d in zip(reversed(cards), reversed(dicts)):
+        keys.append(d.take(rem % card))
+        rem = rem // card
+    keys.reverse()
+    return tuple(keys), ginv, len(present)
+
+
 class HostExecutor:
     """Executes one query over a list of segments, returning per-segment
     IntermediateResults (merged by engine/reduce.py)."""
@@ -731,6 +788,7 @@ class HostExecutor:
         has_mv = any(
             g.is_identifier and ev.is_mv_column(g.name) for g in q.group_by
         )
+        fast = None
         if has_mv:
             rep, mv_vals = self._expand_mv_groups(ev, q.group_by, doc_idx)
             doc_idx = doc_idx[rep]
@@ -739,7 +797,13 @@ class HostExecutor:
                 for gi, g in enumerate(q.group_by)
             ]
         else:
-            key_cols = [ev.eval(g, doc_idx) for g in q.group_by]
+            # dictionary group-key fast path: group on forward-index ids
+            # (no value decode, no sort) when every key is a SV DICT
+            # column — bit-exact with the value-space factorization
+            fast = dict_factorize_multi(ev, q.group_by, doc_idx) \
+                if len(doc_idx) else None
+            key_cols = None if fast is not None \
+                else [ev.eval(g, doc_idx) for g in q.group_by]
         if len(doc_idx) == 0:
             empty_keys = tuple(np.asarray(k)[:0] for k in key_cols)
             specs = [aggspec.make_spec(a) for a in aggs]
@@ -749,8 +813,11 @@ class HostExecutor:
                 agg_partials=[s.empty(0) for s in specs],
                 stats=stats,
             )
-        keys, ginv = factorize_multi(key_cols)
-        n_groups = len(keys[0])
+        if fast is not None:
+            keys, ginv, n_groups = fast
+        else:
+            keys, ginv = factorize_multi(key_cols)
+            n_groups = len(keys[0])
         # per-query override (SET numGroupsLimit = N, the reference's
         # query option) over the engine default
         limit = self.num_groups_limit
@@ -763,6 +830,8 @@ class HostExecutor:
             # the flag tells callers the result is plan-dependent-partial
             # (reference numGroupsLimitReached response metadata)
             stats.num_groups_limit_reached = True
+            if key_cols is None:
+                key_cols = [ev.eval(g, doc_idx) for g in q.group_by]
             _, first_idx = np.unique(ginv, return_index=True)
             keep = np.argsort(first_idx)[:limit]
             keep_mask = np.isin(ginv, keep)

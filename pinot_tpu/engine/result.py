@@ -42,6 +42,15 @@ class ExecutionStats:
     # no gather/dispatch/kernel ran — the fetch re-read a cached packed
     # buffer. Surfaces as partialsCacheHit in responses + the query log.
     partials_cache_hit: bool = False
+    # load signal piggybacked on every server partial (ISSUE 10): the
+    # answering server's scheduler pressure() and in-flight query depth
+    # at fetch time. -1 = not a server partial. The broker reads these
+    # PER INSTANCE before the reduce merges stats (max survives).
+    server_pressure: int = -1
+    server_inflight: int = -1
+    # the answering server's freshness epoch for the queried table
+    # (common/freshness.py): the broker result cache's staleness signal
+    table_epoch: int = -1
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -57,6 +66,11 @@ class ExecutionStats:
         self.scheduler_wait_ms += other.scheduler_wait_ms
         self.num_groups_limit_reached |= other.num_groups_limit_reached
         self.partials_cache_hit |= other.partials_cache_hit
+        self.server_pressure = max(self.server_pressure,
+                                   other.server_pressure)
+        self.server_inflight = max(self.server_inflight,
+                                   other.server_inflight)
+        self.table_epoch = max(self.table_epoch, other.table_epoch)
 
 
 @dataclasses.dataclass

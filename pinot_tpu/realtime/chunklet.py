@@ -356,9 +356,14 @@ class ChunkletIndex:
                 made += 1
         if made:
             # the chunklet set changed: device batches (and their cached
-            # partials) built over the OLD frozen prefix retire
+            # partials) built over the OLD frozen prefix retire, and the
+            # table's freshness epoch bumps so broker result caches can't
+            # serve answers computed over the old split (ISSUE 10)
+            from pinot_tpu.common import freshness
+
             _invalidate_device_partials(
                 f"<chunklet:{self.segment.segment_name}:")
+            freshness.bump(self.segment.table_config.table_name)
         return made
 
     def note_invalidated(self, doc_id: int) -> None:
@@ -369,7 +374,8 @@ class ChunkletIndex:
             cks[i].mark_dirty()
             if was_clean:
                 # first upsert into this block: cached partials over any
-                # batch containing it are stale-by-construction
+                # batch containing it are stale-by-construction (the
+                # table epoch itself bumps in MutableSegment.invalidate)
                 _invalidate_device_partials(cks[i].dir)
 
     def column_with_tail(self, name: str, n: int) -> np.ndarray:

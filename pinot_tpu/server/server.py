@@ -123,6 +123,11 @@ class ServerInstance:
             "pinot.server.segment.peer.retry.timeout.ms", 10_000.0) / 1e3
         self.peer_download_timeout_s = conf.get_float(
             "pinot.server.segment.peer.download.timeout.ms", 60_000.0) / 1e3
+        # registry heartbeat cadence (load + freshness view), decoupled
+        # from the (faster) segment-sync tick — see _sync_loop
+        self.heartbeat_interval_s = conf.get_float(
+            "pinot.server.heartbeat.interval.ms", 2_000.0) / 1e3
+        self._last_serving = None  # last published ExternalView payload
         self._shutting_down = False
         self._inflight_queries = 0
         self._inflight_cond = threading.Condition()
@@ -484,6 +489,13 @@ class ServerInstance:
                 # transport level; cleanup() still runs via the
                 # BaseException path so the process itself stays sound
                 faults.inject("server.crash", target=self.instance_id)
+            from pinot_tpu.common import freshness
+
+            # freshness snapshot BEFORE the scan: a mutation landing
+            # mid-query must make the recorded epoch look stale to the
+            # broker result cache (conservative re-scatter), never stamp
+            # pre-mutation rows with the post-mutation epoch
+            epoch_at_start = freshness.epoch(q.table_name)
             with span("server.execute", tracer):
                 # the fetch-time host fallback (sorted-table overflow) is
                 # heavy CPU work on a slot-free thread: re-admit it
@@ -518,6 +530,14 @@ class ServerInstance:
                 if acct:
                     merged.stats.scheduler_wait_ms = acct.get(
                         "scheduler_wait_ms", 0.0)
+                # load + freshness piggyback (ISSUE 10): every response
+                # carries this server's current pressure/in-flight depth
+                # (the broker's load-aware replica-group pick) and the
+                # table's freshness epoch as of scan START (the broker
+                # result cache's staleness signal)
+                merged.stats.server_pressure = self.scheduler.pressure()
+                merged.stats.server_inflight = self._inflight_queries
+                merged.stats.table_epoch = epoch_at_start
                 self.queries_served += 1
                 if tracer is not None:
                     # encode itself can't appear in the trace: the spans
@@ -609,6 +629,10 @@ class ServerInstance:
                     f"segments for table {q.table_name!r}",
                 )]
             q = self.engine._expand_star(q, segments[0])
+            from pinot_tpu.common import freshness
+
+            # pre-scan snapshot, same contract as the unary path
+            epoch_at_start = freshness.epoch(q.table_name)
             budget = q.offset + q.limit
             produced = 0
             pruned = 0
@@ -641,18 +665,69 @@ class ServerInstance:
             last.num_segments_pruned = pruned
             last.total_docs += unexecuted_docs + sum(
                 s.n_docs for s in remaining)
+            last.server_pressure = self.scheduler.pressure()
+            last.server_inflight = self._inflight_queries
+            last.table_epoch = epoch_at_start
             self.queries_served += 1
             return [encode(b) for b in blocks]
         finally:
             if tdm is not None:
                 tdm.release(acquired)
 
+    # registry sections whose change obligates a full _sync_once — NOT
+    # instances (peer heartbeats), leases (controller HA renewals), or
+    # external_view (peers' publishes, and our own): those churn
+    # constantly in a healthy cluster without changing what THIS server
+    # should host
+    _SYNC_SECTIONS = ("tables", "schemas", "segments", "assignment",
+                      "partition_assignment", "segment_lineage")
+
+    def _serving_map(self) -> dict:
+        return {
+            table: list(tdm.segments)
+            for table, tdm in self.engine.tables.items() if tdm.segments
+        }
+
     # ---- segment sync (state model replacement) --------------------------
     def _sync_loop(self) -> None:
+        from pinot_tpu.common import freshness
+
+        last_hb = 0.0
+        last_token = None
         while not self._stop.is_set():
             try:
-                self._sync_once()
-                self.registry.heartbeat(self.instance_id)
+                # a full reconcile tick is 7+ registry transactions; under
+                # sandboxed kernels (gVisor-class gofer fs) each costs
+                # ~10ms of open/stat/flock syscalls, which at a 200ms
+                # cadence kept the sync thread nearly CONTINUOUSLY busy
+                # and stole the query threads' cores (measured: 2-server
+                # QPS flat vs 1 server until this skip). Poll only the
+                # lock-free section-version token; reconcile when it (or
+                # our own serving set) moved, or on the heartbeat cadence
+                # as a self-heal backstop.
+                now = time.time()
+                hb_due = now - last_hb >= self.heartbeat_interval_s
+                token = self.registry.sections_version(self._SYNC_SECTIONS)
+                if hb_due or token != last_token \
+                        or self._serving_map() != self._last_serving:
+                    self._sync_once()
+                    # re-read: _sync_once's own writes (segment state
+                    # flips, seals) must not re-trigger next tick
+                    last_token = self.registry.sections_version(
+                        self._SYNC_SECTIONS)
+                if hb_due:
+                    # heartbeat carries the load + freshness view (ISSUE
+                    # 10): brokers read pressure for load-aware routing
+                    # when no fresher piggybacked response signal exists,
+                    # and the table epochs keep their result caches honest
+                    # even when no queries are flowing. Cadence is
+                    # DECOUPLED from the sync tick: a heartbeat is a full
+                    # locked read-modify-write of the registry file, and N
+                    # servers writing it every 200ms serialize on the lock.
+                    self.registry.heartbeat(
+                        self.instance_id, pressure=self.scheduler.pressure(),
+                        table_epochs=freshness.snapshot())
+                    last_hb = now
             except Exception:
                 log.exception("segment sync failed")
             self._stop.wait(self.sync_interval_s)
@@ -787,11 +862,9 @@ class ServerInstance:
                     tdm.remove_segment(name)
         self._sync_realtime()
         # publish what this instance can actually answer for (ExternalView)
-        serving = {
-            table: list(tdm.segments) for table, tdm in self.engine.tables.items()
-            if tdm.segments
-        }
+        serving = self._serving_map()
         self.registry.update_external_view(self.instance_id, serving)
+        self._last_serving = serving
 
     def _sync_realtime(self) -> None:
         """Reconcile stream consumers against the (multi-replica) partition

@@ -267,7 +267,12 @@ class MutableSegment:
                 new[: len(self._valid)] = self._valid
                 self._valid = new
             self._count = doc_id + 1  # publish: readers never see doc_id
-            return doc_id
+        from pinot_tpu.common import freshness
+
+        # broker result caches keyed on the table freshness epoch must
+        # never serve counts from before this row (ISSUE 10)
+        freshness.bump(self.table_config.table_name)
+        return doc_id
 
     def index_batch(self, rows) -> int:
         """Columnar batch indexing (the chunklet subsystem's ingest basis):
@@ -295,13 +300,19 @@ class MutableSegment:
                     new[: len(self._valid)] = self._valid
                     self._valid = new
             self._count = row0 + n  # publish the whole batch at once
-            return row0
+        from pinot_tpu.common import freshness
+
+        freshness.bump(self.table_config.table_name)
+        return row0
 
     def invalidate(self, doc_id: int) -> None:
         """Upsert: flip this doc out of validDocIds
         (ThreadSafeMutableRoaringBitmap analog)."""
         if self._valid is not None:
             self._valid[doc_id] = False
+            from pinot_tpu.common import freshness
+
+            freshness.bump(self.table_config.table_name)
             if self.chunklet_index is not None:
                 # a promoted chunklet covering this doc can no longer run
                 # unmasked on the device path
@@ -422,4 +433,9 @@ class MutableSegment:
             from pinot_tpu.realtime.chunklet import _invalidate_device_partials
 
             _invalidate_device_partials(f"<chunklet:{self.segment_name}:")
+        from pinot_tpu.common import freshness
+
+        # seal swaps the consuming backend for the immutable one: cached
+        # broker results built over the old split must re-validate
+        freshness.bump(self.table_config.table_name)
         return seg

@@ -69,7 +69,10 @@ def cmd_start_server(args) -> int:
     from pinot_tpu.server.server import ServerInstance
 
     server = ServerInstance(args.id, _registry(args.registry), args.data_dir,
-                            host=args.host, port=args.port)
+                            host=args.host, port=args.port,
+                            max_concurrent_queries=args.max_concurrent,
+                            device_executor=None if args.no_device
+                            else "auto")
     server.start()
     print(f"server {args.id} running on gRPC port {server.transport.port}")
     _block()
@@ -195,6 +198,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="bind + advertised gRPC host (container/pod "
                          "hostname or IP in multi-host deployments)")
     sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--no-device", action="store_true",
+                    help="host-only executor (skip jax/XLA entirely: "
+                         "fast startup for CPU-bound cluster tiers and "
+                         "the bench's multi-process scaling phase)")
+    sp.add_argument("--max-concurrent", type=int, default=8,
+                    help="scheduler admission width (concurrent queries "
+                         "per server; excess queues). Size to the cores "
+                         "this process may actually use — past that, "
+                         "concurrent queries thrash instead of queueing")
     sp.set_defaults(fn=cmd_start_server)
 
     sp = sub.add_parser("start-broker")

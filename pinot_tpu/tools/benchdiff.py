@@ -21,6 +21,10 @@ Compared metric families (direction-aware):
   ``p50_ms`` — lower is better),
 - micro kernel throughput (``micro.*.mrows_per_s`` — higher is better),
 - concurrency throughput (``concurrency.n*.qps`` — higher is better),
+- cluster-tier scaling (``cluster.servers.n*.qps`` /
+  ``cluster.scaling_efficiency_2`` — higher is better — and
+  ``cluster.result_cache.hit_p50_ms`` — lower is better), compared only
+  when BOTH rounds carry a ``detail.cluster`` section,
 - the phase waterfall (``observability.phase_p50_ms.*`` — lower is
   better; informational by default since queue/link phases are noisy,
   gated only under ``--gate-phases``).
@@ -35,7 +39,7 @@ import sys
 # sections brace-matched out of a truncated driver-wrapper tail
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
-                  "breakdown")
+                  "cluster", "breakdown")
 
 
 def _brace_match(text: str, key: str):
@@ -77,11 +81,21 @@ def _brace_match(text: str, key: str):
 
 def load_round(path: str) -> dict:
     """Round file → detail dict (best effort, never raises on partial
-    rounds — an unreadable file IS an error)."""
+    rounds — an unreadable file IS an error).
+
+    A round whose JSON parses to ``None``/empty (driver recorded a
+    crashed run: ``parsed: null`` with no recoverable tail, or a bare
+    ``null`` document) is SKIPPED with a warning instead of a traceback —
+    every metric then reports as added/removed, never as a regression."""
     with open(path) as f:
         doc = json.load(f)
+    if not isinstance(doc, dict) or not doc:
+        print(f"benchdiff: warning: round {path!r} parsed to "
+              f"{'empty' if doc == {} else type(doc).__name__}; "
+              f"treating as an empty round", file=sys.stderr)
+        return {}
     # driver wrapper?
-    if isinstance(doc, dict) and "tail" in doc and "metric" not in doc:
+    if "tail" in doc and "metric" not in doc:
         parsed = doc.get("parsed")
         if isinstance(parsed, dict):
             doc = parsed
@@ -92,10 +106,14 @@ def load_round(path: str) -> dict:
                 got = _brace_match(tail, sec)
                 if got is not None:
                     detail[sec] = got
+            if not detail:
+                print(f"benchdiff: warning: round {path!r} has no parsed "
+                      f"doc and no recoverable tail sections",
+                      file=sys.stderr)
             return detail
-    if isinstance(doc, dict) and isinstance(doc.get("detail"), dict):
+    if isinstance(doc.get("detail"), dict):
         return doc["detail"]
-    return doc if isinstance(doc, dict) else {}
+    return doc
 
 
 def _num(v):
@@ -138,6 +156,23 @@ def extract_metrics(detail: dict) -> dict:
                 v = _num(v)
                 if v is not None:
                     out[f"phase.{pname}.p50_ms"] = (v, "lower")
+    clu = detail.get("cluster")
+    if isinstance(clu, dict):
+        servers = clu.get("servers")
+        if isinstance(servers, dict):
+            for lname, entry in servers.items():
+                if isinstance(entry, dict):
+                    qps = _num(entry.get("qps"))
+                    if qps is not None:
+                        out[f"cluster.{lname}.qps"] = (qps, "higher")
+        eff = _num(clu.get("scaling_efficiency_2"))
+        if eff is not None:
+            out["cluster.scaling_efficiency_2"] = (eff, "higher")
+        rc = clu.get("result_cache")
+        if isinstance(rc, dict):
+            p50 = _num(rc.get("hit_p50_ms"))
+            if p50 is not None:
+                out["cluster.result_cache.hit_p50_ms"] = (p50, "lower")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of

@@ -401,3 +401,383 @@ class TestServerErrors:
         ]
         assert len(r["resultTable"]["rows"]) == 5
         assert all(len(row) == 3 for row in r["resultTable"]["rows"])
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 10: replica-group assignment, load-aware routing, broker result cache
+# ---------------------------------------------------------------------------
+
+TABLE_OFF = "sales_OFFLINE"
+
+
+def _assignment_by_group(registry, table=TABLE_OFF):
+    """{group name: {segment: instance}} from the written assignment."""
+    groups = registry.replica_groups(table)
+    assign = registry.assignment(table)
+    out = {}
+    for gname, members in groups.items():
+        mset = set(members)
+        out[gname] = {
+            seg: next((i for i in insts if i in mset), None)
+            for seg, insts in assign.items()
+        }
+    return out
+
+
+class TestReplicaGroupAssignment:
+    def test_every_segment_r_covered(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _offline_table(tmp_path, controller, n_segments=6, replication=2)
+        controller.setup_replica_groups("sales")
+        groups = registry.replica_groups(TABLE_OFF)
+        assert len(groups) == 2
+        # groups partition the live servers (no instance in two groups)
+        members = [m for ms in groups.values() for m in ms]
+        assert len(members) == len(set(members)) == 3
+        # every segment: exactly one copy per group, R copies total
+        assign = registry.assignment(TABLE_OFF)
+        assert len(assign) == 6
+        for seg, insts in assign.items():
+            assert len(insts) == 2, (seg, insts)
+            for gname, ms in groups.items():
+                assert len(set(insts) & set(ms)) == 1, (seg, gname)
+
+    def test_rebalance_on_join_moves_minimum(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _offline_table(tmp_path, controller, n_segments=8, replication=1)
+        controller.setup_replica_groups("sales")
+        before = registry.assignment(TABLE_OFF)
+        groups_before = registry.replica_groups(TABLE_OFF)
+        # a 4th server joins; repair rebuilds groups with minimal movement
+        s_new = ServerInstance("server_3", registry,
+                               str(tmp_path / "srv3"), device_executor=None)
+        s_new.start()
+        try:
+            controller.run_replica_group_repair()
+            after = registry.assignment(TABLE_OFF)
+            groups_after = registry.replica_groups(TABLE_OFF)
+            # survivors keep their group membership (no leveling can
+            # trigger here: R=1 means one group before and after)
+            for gname, ms in groups_before.items():
+                assert set(ms) <= set(groups_after[gname]), \
+                    (gname, ms, groups_after)
+            # the new server lands in exactly one group
+            placed = [g for g, ms in groups_after.items()
+                      if "server_3" in ms]
+            assert len(placed) == 1
+            # minimal movement: only segments filling the joiner's fair
+            # share move — fair share = ceil(8 segments / group size)
+            group = groups_after[placed[0]]
+            fair = -(-8 // len(group))
+            moved = sum(
+                1 for seg in before
+                if set(before[seg]) != set(after.get(seg, ()))
+            )
+            assert moved <= fair, (moved, fair, before, after)
+            # coverage invariant survives the join
+            for seg, insts in after.items():
+                assert len(insts) == 1
+        finally:
+            s_new.stop()
+
+    def test_partition_aware_placement(self, cluster, tmp_path):
+        from pinot_tpu.common.table_config import SegmentPartitionConfig
+
+        registry, controller, servers, broker = cluster
+        schema = Schema.build(
+            name="sales",
+            dimensions=[("region", DataType.STRING)],
+            metrics=[("store_id", DataType.INT),
+                     ("amount", DataType.INT)],
+        )
+        cfg = TableConfig(
+            table_name="sales", replication=1,
+            partition=SegmentPartitionConfig(
+                column_partition_map={"store_id": ("modulo", 4)}),
+        )
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(4)
+        # two segments per modulo-partition: co-partitioned segments must
+        # co-locate (the broker prunes partition-EQ queries with the same
+        # common/pruning.py algebra the server uses — placement has to
+        # agree or the pruned route would miss its one holder)
+        for i in range(8):
+            part = i % 4
+            store = np.full(300, part, dtype=np.int64) + \
+                4 * rng.integers(0, 20, 300)
+            cols = {
+                "region": np.array(["na", "eu"])[rng.integers(0, 2, 300)],
+                "store_id": store.astype(np.int32),
+                "amount": rng.integers(1, 100, 300).astype(np.int32),
+            }
+            d = str(tmp_path / f"pseg{i}")
+            build_segment(schema, cols, d, cfg, f"sales_p{i}")
+            controller.upload_segment("sales", d)
+        controller.setup_replica_groups("sales")
+        records = registry.segments(TABLE_OFF)
+        by_group = _assignment_by_group(registry)
+        for gname, seg_map in by_group.items():
+            # the controller indexes the group list in REGISTRY order
+            # (build_replica_groups insertion order), not sorted
+            group_list = registry.replica_groups(TABLE_OFF)[gname]
+            by_part = {}
+            for seg, inst in seg_map.items():
+                rec = records[seg]
+                assert rec.partition_ids, seg
+                pid = int(rec.partition_ids[0])
+                by_part.setdefault(pid, set()).add(inst)
+                # deterministic pick: partition id -> member
+                assert inst == group_list[pid % len(group_list)], \
+                    (seg, pid, inst, group_list)
+            for pid, insts in by_part.items():
+                assert len(insts) == 1, (gname, pid, insts)
+
+
+class TestLoadAwareRouting:
+    def _registry_with_groups(self):
+        from pinot_tpu.cluster.registry import InstanceInfo, SegmentRecord
+
+        registry = ClusterRegistry()
+        for inst in ("a", "b"):
+            registry.register_instance(
+                InstanceInfo(instance_id=inst, role=Role.SERVER))
+        schema = Schema.build(name="t", dimensions=[("d", DataType.STRING)],
+                              metrics=[("m", DataType.INT)])
+        registry.add_table(TableConfig(table_name="t"), schema)
+        for seg in ("t_s0", "t_s1"):
+            registry.add_segment(
+                SegmentRecord(name=seg, table="t_OFFLINE", n_docs=10),
+                ["a", "b"])
+        registry.update_external_view("a", {"t_OFFLINE": ["t_s0", "t_s1"]})
+        registry.update_external_view("b", {"t_OFFLINE": ["t_s0", "t_s1"]})
+        registry.set_replica_groups("t_OFFLINE",
+                                    {"rg_0": ["a"], "rg_1": ["b"]})
+        return registry
+
+    def test_least_loaded_group_wins(self):
+        from pinot_tpu.broker.broker import FailureDetector, RoutingManager
+
+        registry = self._registry_with_groups()
+        rm = RoutingManager(registry, FailureDetector())
+        # instance "a" reports a saturated scheduler, "b" reports idle
+        rm.loads.observe("a", pressure=8.0)
+        rm.loads.observe("b", pressure=0.0)
+        picks = set()
+        for _ in range(6):
+            routing, replicas, info = rm.routing_with_replicas("t_OFFLINE")
+            assert info["numReplicaGroupsQueried"] == 1
+            assert info["loadScore"] is not None
+            picks.add(info["replicaGroup"])
+            assert set(routing) == {"b"}, routing
+        assert picks == {"rg_1"}
+
+    def test_tied_groups_share_round_robin(self):
+        from pinot_tpu.broker.broker import FailureDetector, RoutingManager
+
+        registry = self._registry_with_groups()
+        rm = RoutingManager(registry, FailureDetector())
+        rm.loads.observe("a", pressure=0.0)
+        rm.loads.observe("b", pressure=0.0)
+        picks = [rm.routing_with_replicas("t_OFFLINE")[2]["replicaGroup"]
+                 for _ in range(8)]
+        assert set(picks) == {"rg_0", "rg_1"}
+
+    def test_reservation_counts_concurrent_arrivals(self):
+        from pinot_tpu.broker.broker import FailureDetector, RoutingManager
+
+        registry = self._registry_with_groups()
+        rm = RoutingManager(registry, FailureDetector())
+        rm.loads.observe("a", pressure=0.0)
+        rm.loads.observe("b", pressure=0.0)
+        # two reserving queries that never release must land on DIFFERENT
+        # groups: the second pick sees the first's outstanding count
+        _, _, i1 = rm.routing_with_replicas("t_OFFLINE", reserve=True)
+        _, _, i2 = rm.routing_with_replicas("t_OFFLINE", reserve=True)
+        assert {i1["replicaGroup"], i2["replicaGroup"]} == {"rg_0", "rg_1"}
+        for info in (i1, i2):
+            for inst in info.get("reserved", ()):
+                rm.release([inst])
+
+    def test_unhealthy_group_skipped(self):
+        from pinot_tpu.broker.broker import FailureDetector, RoutingManager
+
+        registry = self._registry_with_groups()
+        det = FailureDetector(initial_backoff_s=30.0)
+        rm = RoutingManager(registry, det)
+        rm.loads.observe("a", pressure=0.0)
+        rm.loads.observe("b", pressure=5.0)  # loaded BUT healthy
+        det.mark_failure("a")  # idle group's only member is down
+        for _ in range(4):
+            routing, _, info = rm.routing_with_replicas("t_OFFLINE")
+            assert info["replicaGroup"] == "rg_1"
+            assert set(routing) == {"b"}
+
+
+class TestBrokerResultCache:
+    def test_hit_miss_parity_and_invalidation(self, cluster, tmp_path):
+        from pinot_tpu.common import freshness
+
+        registry, controller, servers, broker = cluster
+        _offline_table(tmp_path, controller, n_segments=3, rows=500)
+        assert wait_until(
+            lambda: len(registry.external_view(TABLE_OFF)) == 3)
+        cbroker = Broker(registry, broker_id="cache_broker",
+                         timeout_s=10.0, result_cache=True)
+        try:
+            sql = ("SELECT region, COUNT(*), SUM(amount) FROM sales "
+                   "GROUP BY region ORDER BY region")
+            miss = cbroker.execute(sql)
+            assert not miss["exceptions"], miss
+            assert miss["resultCacheHit"] is False
+            hit = cbroker.execute(sql)
+            assert hit["resultCacheHit"] is True
+            # parity: hit == miss == cache-off broker, bit-exact
+            off = broker.execute(sql)
+            assert hit["resultTable"]["rows"] == \
+                miss["resultTable"]["rows"] == off["resultTable"]["rows"]
+            assert cbroker.result_cache.stats()["hits"] == 1
+            # a routing change (new segment uploaded) invalidates: the
+            # next execution is a MISS and sees the new rows
+            schema = registry.table_schema(TABLE_OFF)
+            rng = np.random.default_rng(77)
+            cols = {
+                "region": np.array(["apac"] * 40),
+                "product": np.array([f"p{j}" for j in range(50)])[
+                    rng.integers(0, 50, 40)],
+                "amount": np.full(40, 7, dtype=np.int32),
+            }
+            d = str(tmp_path / "late_seg")
+            build_segment(schema, cols, d,
+                          TableConfig(table_name="sales"), "sales_late")
+            controller.upload_segment("sales", d)
+            assert wait_until(
+                lambda: len(registry.external_view(TABLE_OFF)) == 4)
+            r2 = cbroker.execute(sql)
+            assert r2["resultCacheHit"] is False
+            assert r2["resultTable"]["rows"] != hit["resultTable"]["rows"]
+            # an epoch bump (in-place mutation, e.g. a consuming append)
+            # invalidates even with the segment set unchanged. Servers
+            # report epochs via heartbeat + piggyback; in-process they
+            # share the freshness module, so bump + heartbeat directly.
+            assert cbroker.execute(sql)["resultCacheHit"] is True  # r2 filled
+            freshness.bump("sales")
+            for s in servers:
+                registry.heartbeat(s.instance_id,
+                                   table_epochs=freshness.snapshot())
+            time.sleep(0.3)  # ride out the broker's instances memo
+            r3 = cbroker.execute(sql)
+            assert r3["resultCacheHit"] is False
+            assert r3["resultTable"]["rows"] == \
+                r2["resultTable"]["rows"]
+            assert cbroker.result_cache.stats()["invalidations"] >= 1
+        finally:
+            cbroker.close()
+            freshness.reset()
+
+    def test_opt_out_and_uncacheable_queries(self, cluster, tmp_path):
+        registry, controller, servers, broker = cluster
+        _offline_table(tmp_path, controller, n_segments=1, rows=100)
+        assert wait_until(
+            lambda: len(registry.external_view(TABLE_OFF)) == 1)
+        cbroker = Broker(registry, broker_id="cache_broker2",
+                         timeout_s=10.0, result_cache=True)
+        try:
+            sql = "SELECT COUNT(*) FROM sales"
+            cbroker.execute(sql)
+            assert cbroker.execute(sql)["resultCacheHit"] is True
+            r = cbroker.execute("SET useResultCache = false; " + sql)
+            assert "resultCacheHit" not in r
+            # cache-off broker can opt IN per query
+            r2 = broker.execute("SET useResultCache = true; " + sql)
+            assert r2["resultCacheHit"] is False
+            r3 = broker.execute("SET useResultCache = true; " + sql)
+            assert r3["resultCacheHit"] is True
+        finally:
+            cbroker.close()
+
+    def test_epoch_bump_seams(self, tmp_path):
+        """The three in-place mutation seams (append, upsert-invalidate,
+        seal) and chunklet promotion all bump the table freshness epoch —
+        the contract the broker cache's staleness view rests on."""
+        from pinot_tpu.common import freshness
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        freshness.reset()
+        schema = Schema.build(
+            name="rt", dimensions=[("zone", DataType.STRING)],
+            metrics=[("fare", DataType.INT)],
+            primary_key_columns=["zone"],
+        )
+        # ChunkletIndex floors rows_per_chunklet at 1024, so index past
+        # that to make promote() actually freeze a block
+        cfg = TableConfig(
+            table_name="rt",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=1024,
+                                     device_min_rows=0))
+        seg = MutableSegment(schema, "rt__0", cfg, enable_upsert=True)
+        assert freshness.epoch("rt") == 0
+        seg.index({"zone": "z1", "fare": 3})
+        e1 = freshness.epoch("rt")
+        assert e1 >= 1
+        seg.index_batch([{"zone": f"z{i}", "fare": i} for i in range(1100)])
+        e2 = freshness.epoch("rt")
+        assert e2 > e1
+        if seg.chunklet_index is not None:
+            made = seg.chunklet_index.promote()
+            assert made >= 1
+            assert freshness.epoch("rt") > e2
+        e3 = freshness.epoch("rt")
+        seg.invalidate(0)
+        assert freshness.epoch("rt") > e3
+        e4 = freshness.epoch("rt")
+        seg.seal(str(tmp_path / "sealed"))
+        assert freshness.epoch("rt") > e4
+        freshness.reset()
+
+
+class TestClusterQpsSmoke:
+    def test_three_server_replica_group_qps(self, cluster, tmp_path):
+        """3 in-process servers over real gRPC, replica groups R=3 (one
+        full copy each): concurrent traffic routes whole queries to single
+        groups, spreads across all three, and answers correctly."""
+        import threading
+
+        registry, controller, servers, broker = cluster
+        _offline_table(tmp_path, controller, n_segments=4, rows=1500,
+                       replication=3)
+        controller.setup_replica_groups("sales")
+        assert wait_until(lambda: all(
+            len(v) == 3
+            for v in registry.external_view(TABLE_OFF).values()) and len(
+            registry.external_view(TABLE_OFF)) == 4, timeout=30)
+        expected = broker.execute(
+            "SELECT region, COUNT(*) FROM sales GROUP BY region "
+            "ORDER BY region")
+        assert not expected["exceptions"]
+        assert expected["numReplicaGroupsQueried"] == 1
+        assert expected.get("loadScore") is not None
+        rows = expected["resultTable"]["rows"]
+        errors = []
+        groups_seen = set()
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(8):
+                r = broker.execute(
+                    "SELECT region, COUNT(*) FROM sales GROUP BY region "
+                    "ORDER BY region")
+                with lock:
+                    if r.get("exceptions") or \
+                            r["resultTable"]["rows"] != rows:
+                        errors.append(r)
+                    groups_seen.add(r.get("replicaGroup"))
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors, errors[:1]
+        # ties share round-robin traffic: all three groups serve
+        assert groups_seen == {"rg_0", "rg_1", "rg_2"}, groups_seen
