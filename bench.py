@@ -2339,6 +2339,9 @@ def bench_observability(n_queries: int = 24):
         # exists to record (cache-hot latency is detail.subrtt's metric)
         if s.engine.device is not None:
             s.engine.device.partials_cache_enabled = False
+        # fast heartbeats so the heat snapshot (ISSUE 11) lands inside
+        # the phase's runtime rather than the 2s default cadence
+        s.heartbeat_interval_s = 0.3
         s.start()
     broker = Broker(registry, timeout_s=30.0)
     try:
@@ -2454,6 +2457,92 @@ def bench_observability(n_queries: int = 24):
             violations.append(
                 f"phase-sum reconciliation drift: median per-server span "
                 f"coverage {med_cov:.3f} < 0.90 of server.total")
+
+        # ---- EXPLAIN ANALYZE smoke (ISSUE 11) --------------------------
+        # the new instrumentation must execute through the broker,
+        # render a per-kernel GB/s-vs-HBM-peak line, and leave the query
+        # results bit-identical to the plain form
+        ea = broker.execute("EXPLAIN ANALYZE " + plain)
+        ea_rows = (ea.get("resultTable") or {}).get("rows") or []
+        ea_lines = [r[0] for r in ea_rows]
+        plain_resp = broker.execute(plain)
+        analyzed = (ea.get("analyzedResponse") or {}).get("resultTable")
+        bit_identical = analyzed == plain_resp.get("resultTable")
+        kernel_lines = [ln for ln in ea_lines if "GB/s" in ln]
+        detail["explain_analyze"] = {
+            "lines": len(ea_lines),
+            "kernel_lines": len(kernel_lines),
+            "sample_kernel_line": (kernel_lines[0].strip()
+                                   if kernel_lines else None),
+            "bit_identical": bool(bit_identical),
+        }
+        if ea.get("exceptions") or not ea_lines:
+            violations.append(
+                f"EXPLAIN ANALYZE smoke failed: "
+                f"{ea.get('exceptions') or 'no plan rows'}")
+        if not any("% of HBM peak" in ln for ln in kernel_lines):
+            violations.append(
+                "EXPLAIN ANALYZE rendered no per-kernel "
+                "'GB/s (x% of HBM peak)' line")
+        if not bit_identical:
+            violations.append(
+                "EXPLAIN ANALYZE results not bit-identical to the "
+                "non-ANALYZE form")
+
+        # ---- roofline detail (ISSUE 11) --------------------------------
+        # per-kernel achieved GB/s vs the probed peak, merged across the
+        # in-process servers' executors — lands top-level as
+        # detail.roofline so benchdiff can gate per-kernel deltas
+        from pinot_tpu.ops import roofline as _rl
+
+        merged_kernels: dict = {}
+        for s in servers:
+            dev = s.engine.device
+            if dev is None:
+                continue
+            for label, agg in dev.roofline_stats()["kernels"].items():
+                m = merged_kernels.setdefault(
+                    label, {"queries": 0, "cache_hits": 0,
+                            "bytes_moved": 0, "kernel_ms": 0.0,
+                            "link_ms": 0.0})
+                for k in m:
+                    m[k] += agg.get(k, 0)
+        peak = _rl.peak_if_probed()
+        for label, m in merged_kernels.items():
+            m["kernel_ms"] = round(m["kernel_ms"], 3)
+            m["link_ms"] = round(m["link_ms"], 3)
+            if m["kernel_ms"] > 0:
+                gbps = m["bytes_moved"] / (m["kernel_ms"] / 1e3) / 1e9
+                m["gbps"] = round(gbps, 3)
+                pct = _rl.pct_of_peak(gbps, peak)
+                if pct is not None:
+                    m["pct_of_peak"] = pct
+        detail["roofline"] = {
+            "peak_gbps": round(peak, 1) if peak else None,
+            "kernels": merged_kernels,
+        }
+        if not merged_kernels:
+            violations.append("roofline accounting recorded no kernels")
+
+        # ---- segment-temperature snapshot (ISSUE 11) -------------------
+        from pinot_tpu.controller.controller import aggregate_heat
+
+        heat = {}
+        t_end = time.time() + 10
+        while time.time() < t_end:
+            heat = aggregate_heat(registry, "obs")
+            if heat.get("segments"):
+                break
+            time.sleep(0.2)
+        detail["heat"] = {
+            "instancesReporting": heat.get("instancesReporting", 0),
+            "segments": dict(list(
+                (heat.get("segments") or {}).items())[:8]),
+        }
+        if not heat.get("segments"):
+            violations.append(
+                "segment-temperature telemetry: no heat reported via "
+                "heartbeats within 10s")
     finally:
         broker.close()
         for s in servers:
@@ -2595,7 +2684,9 @@ def main():
     if args.phase == "observability":
         detail, violations = bench_observability()
         print(json.dumps({"metric": "observability-phase standalone",
-                          "detail": {"observability": detail}}))
+                          "detail": {"observability": detail,
+                                     "roofline": detail.get("roofline",
+                                                            {})}}))
         if violations:
             print(f"observability gate FAILED: {json.dumps(violations)}",
                   file=sys.stderr)
@@ -2710,6 +2801,10 @@ def main():
                     "chunklet": chunklet_detail,
                     "faults": faults_detail,
                     "observability": observability_detail,
+                    # per-kernel achieved-GB/s vs HBM peak (ISSUE 11) —
+                    # top-level so tools/benchdiff.py gates per-kernel
+                    # deltas round over round
+                    "roofline": observability_detail.get("roofline", {}),
                     "join": join_detail,
                     "subrtt": subrtt_detail,
                     "cluster": cluster_detail,

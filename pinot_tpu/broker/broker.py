@@ -580,6 +580,15 @@ class RoutingManager:
         }
 
 
+class _NoEngine:
+    """Broker-side EXPLAIN stand-in: no local executor or segments —
+    filter lines show generic PREDICATE operators (index choice is
+    per-segment, server-side)."""
+
+    device = None
+    tables: dict = {}
+
+
 class Broker:
     def __init__(self, registry: ClusterRegistry, broker_id: str = "broker_0",
                  timeout_s: float = 10.0, tls="auto", result_cache=None):
@@ -910,17 +919,16 @@ class Broker:
             # and the result cache all share it
             gen = self._routing_gen()
             q = self._resolve_table_case(q, gen)
+            if q.explain and getattr(q, "analyze", False):
+                # EXPLAIN ANALYZE (ISSUE 11): execute the underlying
+                # query through the FULL scatter path (traced, so the
+                # per-server phase ladder and roofline records fill),
+                # then render the plan annotated with the actuals
+                return self._explain_analyze_single(sql, q)
             if q.explain:
                 from pinot_tpu.engine.explain import explain_plan
 
-                class _NoDevice:
-                    # broker-side explain has no local executor or segments:
-                    # filter lines show generic PREDICATE operators (index
-                    # choice is per-segment, server-side)
-                    device = None
-                    tables: dict = {}
-
-                plan = explain_plan(_NoDevice(), q)
+                plan = explain_plan(_NoEngine(), q)
                 ck = self._result_cache_key(q, for_explain=True)
                 if ck is not None and self.result_cache.peek_fresh(
                         ck, self._epoch_view(q.table_name), gen):
@@ -996,6 +1004,39 @@ class Broker:
                 self.result_cache.put(cache_key, resp, put_view, cache_gen)
         return self._log_query(sql, q, resp, t0)
 
+    def _explain_analyze_single(self, sql: str, q: QueryContext) -> dict:
+        """Single-stage EXPLAIN ANALYZE: strip the keyword pair, re-enter
+        execute() with tracing forced on (routing / retry / hedging /
+        quota / logging all apply to the real run), annotate the static
+        plan with the response's actuals. The executed response rides as
+        ``analyzedResponse`` — callers verify its rows are bit-identical
+        to the plain form."""
+        from pinot_tpu.engine.explain import explain_plan
+
+        return self._explain_analyze_via(
+            sql, lambda: explain_plan(_NoEngine(), q))
+
+    def _explain_analyze_via(self, sql: str, render_static) -> dict:
+        """The shared EA sequence (single-stage AND multistage): strip
+        ``EXPLAIN ANALYZE``, re-execute with trace forced on and the
+        partials cache bypassed (the kernel must actually RUN to be
+        measured; results are bit-identical either way), pass errors
+        through verbatim, annotate the static plan from
+        ``render_static()``, attach the executed response."""
+        from pinot_tpu.engine.explain import annotate_analyze
+        from pinot_tpu.sql.parser import strip_explain_analyze
+
+        stripped = strip_explain_analyze(sql)
+        if stripped == sql:  # nothing stripped: render the static plan
+            return render_static()
+        inner = self.execute(
+            "SET trace = true; SET usePartialsCache = false; " + stripped)
+        if inner.get("exceptions"):
+            return inner
+        out = annotate_analyze(render_static(), inner)
+        out["analyzedResponse"] = inner
+        return out
+
     def _execute_multistage(self, stmt, sql: str, t0: float) -> dict:
         """Two-stage (join / window) execution at the broker. Stage-1 leaf
         scans are plain single-stage SELECT queries issued through
@@ -1051,7 +1092,13 @@ class Broker:
         if plan.explain:
             from pinot_tpu.engine.explain import explain_multistage
 
-            return explain_multistage(None, plan)
+            if not getattr(plan, "analyze", False):
+                return explain_multistage(None, plan)
+            # EXPLAIN ANALYZE on a join/window plan: execute the real
+            # two-stage query (leaves traced through the ordinary
+            # scatter-gather), then annotate the static plan tree
+            return self._explain_analyze_via(
+                sql, lambda: explain_multistage(None, plan))
 
         # the user's SET options (trace, numGroupsLimit, ...) ride every
         # leaf scan — the scatter-gather below is where the PR-6 deadline
@@ -1105,6 +1152,8 @@ class Broker:
                     "numRetries": 0, "numHedges": 0, "totalDocs": 0}
         trace_info: dict = {}
         table_rows = {}
+        leaf_rows: dict = {}       # alias -> stage-1 row count (ANALYZE)
+        roofline_recs: list = []   # leaf + join-step roofline flights
         need = needed_columns(plan)
         for src in plan.sources:
             cols = need[src.alias]
@@ -1123,6 +1172,10 @@ class Broker:
             r = self.execute(leaf)
             if r.get("traceInfo"):
                 trace_info[f"leaf:{src.alias}"] = r["traceInfo"]
+            for rec in r.get("roofline") or ():
+                roofline_recs.append(
+                    {**rec, "kernel": f"leaf:{src.alias}:"
+                                      f"{rec.get('kernel', 'kernel')}"})
             if r.get("exceptions"):
                 # surface the leaf's typed error verbatim (429 keeps its
                 # retryAfterSeconds pacing hint, 250 stays a timeout)
@@ -1141,6 +1194,7 @@ class Broker:
             for k in counters:
                 counters[k] += int(r.get(k) or 0)
             rows = r["resultTable"]["rows"]
+            leaf_rows[src.alias] = len(rows)
             if len(rows) > MAX_STAGE1_ROWS:
                 raise RuntimeError(
                     f"stage-1 row set for table {src.table!r} hit the "
@@ -1165,6 +1219,7 @@ class Broker:
             # would return a success AFTER the client's deadline
             return _timeout_resp()
         result, meta = run_plan(plan, table_rows, device=None)
+        roofline_recs.extend(meta.get("roofline") or ())
         resp = result.to_json()
         resp.update(counters)
         resp.update({
@@ -1172,8 +1227,11 @@ class Broker:
             "requestId": f"{self.broker_id}_{next(self._request_id)}",
             "numStages": meta["numStages"],
             "numJoinedRows": meta["numJoinedRows"],
+            "leafRows": leaf_rows,
             "timeUsedMs": round((time.time() - t0) * 1000, 3),
         })
+        if roofline_recs:
+            resp["roofline"] = roofline_recs
         if trace_info:
             resp["traceInfo"] = trace_info
         if meta["joinStrategy"]:
@@ -1638,6 +1696,7 @@ class Broker:
         results, exceptions = [], []
         query_errors = []
         server_traces = {}
+        server_roofline = []  # per-flight roofline records, instance-tagged
         responded = set()  # instances whose response was USED
         attempted_all = set()
 
@@ -1811,6 +1870,11 @@ class Broker:
                     for r in parts:
                         if r.trace is not None:
                             server_traces.setdefault(tkey, []).extend(r.trace)
+                        # roofline flight records (ISSUE 11): instance-
+                        # tagged for EXPLAIN ANALYZE / the query log
+                        for rec in getattr(r, "roofline", None) or ():
+                            server_roofline.append(
+                                {**rec, "instance": tkey})
                         # piggybacked load + freshness (ISSUE 10): feed
                         # the decayed load score and the result cache's
                         # per-table epoch view BEFORE stats merge away
@@ -1895,9 +1959,16 @@ class Broker:
                 # summed across servers, like the reference's V3 metadata
                 "threadCpuTimeNs": stats.thread_cpu_time_ns,
                 "schedulerWaitMs": round(stats.scheduler_wait_ms, 3),
+                # kernel roofline accounting (ISSUE 11), summed across
+                # server partials; the per-flight detail rides "roofline"
+                "deviceBytesMoved": stats.device_bytes_moved,
+                "deviceKernelMs": round(stats.device_kernel_ms, 3),
+                "deviceLinkMs": round(stats.device_link_ms, 3),
                 "requestId": request_id,
             }
         )
+        if server_roofline:
+            resp["roofline"] = server_roofline
         if rg_load_score is not None:
             resp["loadScore"] = rg_load_score
             resp["replicaGroup"] = rg_name

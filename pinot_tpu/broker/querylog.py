@@ -170,9 +170,17 @@ class QueryLogger:
                     # the broker result cache answered without a scatter
                     "numReplicaGroupsQueried", "replicaGroup",
                     "loadScore", "resultCacheHit",
+                    # kernel roofline accounting (ISSUE 11): HBM bytes
+                    # the device pipelines moved vs their kernel wall
+                    "deviceBytesMoved", "deviceKernelMs", "deviceLinkMs",
                 ) if resp.get(k) is not None
             },
         }
+        roofline = resp.get("roofline")
+        if roofline:
+            # per-flight achieved-GB/s records, capped so one scattered
+            # query over many servers can't bloat a log line
+            entry["roofline"] = list(roofline)[:8]
         trace_info = resp.get("traceInfo")
         if trace_info:
             entry["traceInfo"] = trace_info

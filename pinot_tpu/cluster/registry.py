@@ -65,6 +65,12 @@ class InstanceInfo:
     # {logical table: freshness epoch} (common/freshness.py) — the broker
     # result cache's staleness view when queries aren't flowing
     table_epochs: dict = dataclasses.field(default_factory=dict)
+    # per-segment access-temperature snapshot (ISSUE 11,
+    # server/heat.py SegmentHeatTracker.snapshot(): {table: {segment:
+    # {rate, bytesRate, accesses, bytes, lastAccessTs}}}, hottest-N per
+    # table) — the controller aggregates it behind /tables/{t}/heat,
+    # the input ROADMAP 3's tier promotion/demotion will consume
+    heat: dict = dataclasses.field(default_factory=dict)
 
     @property
     def endpoint(self) -> str:
@@ -205,11 +211,13 @@ class ClusterRegistry:
         self._tx(lambda s: s["instances"].__setitem__(info.instance_id, info))
 
     def heartbeat(self, instance_id: str, pressure: float = None,
-                  table_epochs: dict = None) -> None:
+                  table_epochs: dict = None, heat: dict = None) -> None:
         """Liveness tick, optionally carrying the instance's current load
-        (scheduler pressure) and per-table freshness epochs — the passive
-        half of the broker's load/staleness view (the active half rides
-        piggybacked in every DataTable response)."""
+        (scheduler pressure), per-table freshness epochs, and the
+        per-segment heat snapshot (ISSUE 11) — the passive half of the
+        broker's load/staleness view (the active half rides piggybacked
+        in every DataTable response) and the controller's temperature
+        aggregation input."""
 
         def fn(s):
             info = s["instances"].get(instance_id)
@@ -219,6 +227,8 @@ class ClusterRegistry:
                     info.pressure = float(pressure)
                 if table_epochs is not None:
                     info.table_epochs = dict(table_epochs)
+                if heat is not None:
+                    info.heat = dict(heat)
 
         self._tx(fn)
 

@@ -21,6 +21,7 @@ read it from here instead of keeping private sample windows.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 import time
 from typing import Callable, Optional
@@ -123,6 +124,21 @@ class Timer:
                 "avgMs": round(avg, 3),
                 "minMs": round(self.min_ms, 3) if self.count else 0.0,
                 "maxMs": round(self.max_ms, 3)}
+
+
+# Prometheus metric names must match [a-zA-Z_:][a-zA-Z0-9_:]* — but
+# registry keys carry free-form tags (instance ids, traceInfo-derived
+# attempt keys like "inst (retry)", table names with dots). EVERY
+# illegal character maps to "_" so the exposition stays parseable by a
+# real scraper; the "pinot_tpu_" prefix keeps the first character legal.
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize(k: str) -> str:
+    """Registry key → legal Prometheus metric name (ISSUE 11 satellite:
+    spaces/parens in instance-keyed names previously emitted an
+    exposition prometheus_client refuses to parse)."""
+    return "pinot_tpu_" + _PROM_NAME_RE.sub("_", k)
 
 
 class MetricsRegistry:
@@ -254,9 +270,6 @@ class MetricsRegistry:
         ``_bucket{le=...}`` lines (only buckets where the cumulative
         count advances, plus ``+Inf`` — a sparse but valid exposition),
         ``_sum``/``_count``, and a separate untyped ``_max`` sample."""
-
-        def sanitize(k: str) -> str:
-            return "pinot_tpu_" + k.replace(".", "_").replace("-", "_")
 
         lines = []
         with self._lock:

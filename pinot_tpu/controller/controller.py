@@ -33,6 +33,49 @@ from pinot_tpu.storage.segment import ImmutableSegment
 log = logging.getLogger("pinot_tpu.controller")
 
 
+def aggregate_heat(registry: ClusterRegistry, table: str) -> dict:
+    """Cluster-wide segment-temperature view for one table (ISSUE 11):
+    merges every server heartbeat's piggybacked heat snapshot
+    (server/heat.py) across instances and the table's physical variants
+    — decayed rates sum (a 2-replica hot segment is twice as hot to the
+    cluster), lifetime counters sum, last access takes the max.  The
+    payload behind ``GET /tables/{t}/heat`` and
+    ``tools/clusterstat.py``; the ranking ROADMAP 3's tier
+    promotion/demotion policy will consume."""
+    candidates = {table, f"{table}_OFFLINE", f"{table}_REALTIME"}
+    segs: dict = {}
+    reporting = 0
+    for info in registry.instances(Role.SERVER):
+        h = getattr(info, "heat", None) or {}
+        seen = False
+        for t in candidates:
+            per = h.get(t)
+            if not per:
+                continue
+            seen = True
+            for seg, rec in per.items():
+                agg = segs.setdefault(seg, {
+                    "rate": 0.0, "bytesRate": 0.0, "accesses": 0,
+                    "bytes": 0, "lastAccessTs": 0.0, "instances": 0})
+                agg["rate"] = round(
+                    agg["rate"] + float(rec.get("rate") or 0.0), 4)
+                agg["bytesRate"] = round(
+                    agg["bytesRate"] + float(rec.get("bytesRate") or 0.0), 1)
+                agg["accesses"] += int(rec.get("accesses") or 0)
+                agg["bytes"] += int(rec.get("bytes") or 0)
+                agg["lastAccessTs"] = max(
+                    agg["lastAccessTs"], float(rec.get("lastAccessTs") or 0))
+                agg["instances"] += 1
+        if seen:
+            reporting += 1
+    return {
+        "table": table,
+        "instancesReporting": reporting,
+        "segments": dict(sorted(segs.items(),
+                                key=lambda kv: -kv[1]["rate"])),
+    }
+
+
 def _column_stats_fields(meta) -> dict:
     """Per-column min/max from segment metadata, JSON-plain, for the
     SegmentRecord the broker prunes on (SegmentZKMetadata's column
@@ -302,6 +345,11 @@ class Controller:
         self._ha_thread: Optional[threading.Thread] = None
         self._ha_stopped = False
         self._held_partitions: set = set()
+
+    def table_heat(self, table: str) -> dict:
+        """Aggregated per-segment access temperature for ``table``
+        (ISSUE 11) — the GET /tables/{t}/heat payload."""
+        return aggregate_heat(self.registry, table)
 
     # ---- HA: lease-based leader election + lead-controller partitioning --
     # The reference runs N controllers with Helix leader election and

@@ -71,6 +71,31 @@ class ControllerHttpServer:
                             principal, tables)
                     self._send(200, {"tables": sorted(tables)})
                     return
+                heat_name = None
+                if self.path.startswith("/tables/") \
+                        and self.path.rstrip("/").endswith("/heat"):
+                    heat_name = self.path[len("/tables/"):].rstrip("/")
+                    heat_name = heat_name[: -len("/heat")].strip("/")
+                if heat_name:
+                    # GET /tables/{t}/heat (ISSUE 11): cluster-aggregated
+                    # per-segment access temperature from the servers'
+                    # heartbeat-piggybacked heat snapshots — the tier
+                    # lifecycle's promotion/demotion input. Requires a
+                    # NON-EMPTY table segment: plain GET /tables/heat is
+                    # the metadata route for a table literally named
+                    # "heat", not an aggregation over ''.
+                    name = heat_name
+                    if outer._access is not None and \
+                            not outer._access.allows(principal, name):
+                        self._send(403, {"error": f"Permission denied on "
+                                                  f"table {name!r}"})
+                        return
+                    from pinot_tpu.controller.controller import (
+                        aggregate_heat,
+                    )
+
+                    self._send(200, aggregate_heat(outer.registry, name))
+                    return
                 if self.path.startswith("/tables/"):
                     name = self.path[len("/tables/"):].strip("/")
                     if outer._access is not None and \

@@ -245,6 +245,8 @@ def encode(result: IntermediateResult) -> bytes:
         "partials": None,
         "n_keys": None,
         "trace": result.trace,
+        # per-flight roofline records (ISSUE 11) ride like trace spans
+        "roofline": result.roofline,
     }
 
     if result.group_keys is not None:
@@ -340,4 +342,5 @@ def decode(data: bytes) -> IntermediateResult:
         rows=rows,
         stats=stats,
         trace=meta.get("trace"),
+        roofline=meta.get("roofline"),
     )

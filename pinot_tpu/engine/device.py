@@ -1043,6 +1043,14 @@ class DeviceExecutor:
         self._pipeline_failures: dict = {}   # (template, batch_key) -> n
         self._quarantined: dict = {}         # key -> quarantined-at ts
         self._poisoned_batches: set = set()  # evict once their pins drain
+        # kernel roofline accounting (ISSUE 11): per-pipeline-label
+        # aggregates of the static bytes-moved cost model (ColPlan-width
+        # column planes, block-skip gather ratio, trimmed fetch bytes)
+        # against the measured kernel/link wall — achieved GB/s vs the
+        # per-process HBM peak probe (ops/roofline.py), surfaced through
+        # hbm_stats()["roofline"], the deviceKernelGbps histogram, and
+        # per-query IntermediateResult.roofline records
+        self._roofline: dict = {}
         # last-launch capture for kernel profiling (bench breakdown):
         # (pipeline, cols, n_docs, params, bytes_in). OPT-IN: retaining
         # the launch pins a whole batch's HBM past the batch cache's
@@ -1274,6 +1282,9 @@ class DeviceExecutor:
             max_cached_bytes=self.MAX_CACHED_BYTES,
             batches=per_batch,
         )
+        # kernel roofline accounting (ISSUE 11): per-pipeline achieved
+        # GB/s vs the probed HBM peak
+        snap["roofline"] = self.roofline_stats()
         return snap
 
     def _retain_launch(self, key) -> None:
@@ -1415,18 +1426,24 @@ class DeviceExecutor:
         bits.extend(g.name for g in (q.group_by or ()) if g.is_identifier)
         return ":".join(bits)
 
-    def _make_resolve(self, bufs_dev, layout, tracer=None):
+    def _make_resolve(self, bufs_dev, layout, tracer=None, flight=None):
         """fetch-phase closure shared by solo and cohort launches: ONE
         blocking device_get of the dispatched packed buffer, observability
         accounting under the lock, unpack by the precomputed layout.
 
-        ``tracer``: the dispatching query's Tracer (cohorts: the
-        LEADER's). When tracing, the blocking wait splits into a KERNEL
-        span (block_until_ready — remaining device compute since
-        dispatch) and a LINK span (device_get — the host transfer), the
-        waterfall's kernel-ms vs link-ms separation; untraced fetches
-        keep the single-call fast path so tracing-off overhead is one
-        ``None`` check."""
+        The blocking wait always splits into a KERNEL wait
+        (block_until_ready — remaining device compute since dispatch) and
+        a LINK wait (device_get — the host transfer): the split feeds the
+        ALWAYS-ON roofline accounting (ISSUE 11 — achieved GB/s needs
+        kernel-ms without tracing armed), and ``tracer`` (the dispatching
+        query's, cohorts: the LEADER's) additionally records the pair as
+        spans — the waterfall's kernel-ms vs link-ms separation. The
+        untraced overhead is one extra no-op call on an already-complete
+        buffer.
+
+        ``flight``: the launch's roofline flight dict (None = no
+        accounting, e.g. the bench's profile captures); filled with the
+        per-flight record via _note_flight after the unpack."""
         def resolve():
             import time as _time
 
@@ -1436,6 +1453,10 @@ class DeviceExecutor:
             if tracer is not None:
                 with trace_span("kernel", tracer):
                     jax.block_until_ready(bufs_dev)
+            else:
+                jax.block_until_ready(bufs_dev)
+            _t_kernel = _time.perf_counter()
+            if tracer is not None:
                 with trace_span("link", tracer):
                     bufs = jax.device_get(bufs_dev)
             else:
@@ -1443,17 +1464,128 @@ class DeviceExecutor:
             # blocking wait = link round trip + kernel; bench subtracts it
             # from wall time for a MEASURED host_ms (floor-subtraction
             # overstated host work by the link's RTT variance)
-            wait = _time.perf_counter() - _t_get
+            _t_link = _time.perf_counter()
+            wait = _t_link - _t_get
             bufs = {k: np.asarray(v) for k, v in bufs.items()}
+            fetched = sum(v.nbytes for v in bufs.values())
             with self._lock:
                 self.last_get_wait_s = wait
                 # observability: what actually crossed the host link
-                self.fetch_bytes_total += sum(v.nbytes for v in bufs.values())
+                self.fetch_bytes_total += fetched
                 self.fetch_leaves_total += len(bufs)
             self.metrics.time_ms("deviceFetchMs", wait * 1e3)
-            return _unpack_outs(bufs, layout)
+            outs = _unpack_outs(bufs, layout)
+            if flight is not None:
+                self._note_flight(flight, outs, fetched,
+                                  _t_kernel - _t_get, _t_link - _t_kernel)
+            return outs
 
         return resolve
+
+    # ---- kernel roofline accounting (ISSUE 11) ---------------------------
+    @staticmethod
+    def _pipeline_label(template, blockskip: bool, trim) -> str:
+        """Human-stable per-pipeline label the roofline aggregates key on:
+        the template SHAPE plus the compile-affecting execution modes —
+        coarse on purpose (per-template keys would fragment the stats
+        into one-row buckets per literal-free query shape)."""
+        label = template[0]
+        if blockskip:
+            label += "+bskip"
+        if trim is not None:
+            label += "+trim"
+        return label
+
+    def _new_flight(self, label: str, cache_hit: bool = False) -> dict:
+        """Per-launch roofline flight record skeleton. ``data_bytes`` /
+        ``zone_bytes`` are the static cost model's inputs (filled after
+        the column gather); the resolve fills timings and the final
+        record via _note_flight."""
+        return {"label": label, "cache_hit": cache_hit,
+                "data_bytes": 0, "zone_bytes": 0, "record": None}
+
+    def _note_flight(self, flight: dict, outs: dict, fetched_bytes: int,
+                     kernel_s: float, link_s: float) -> None:
+        """Fold one resolved flight into the roofline accounting: the
+        modeled bytes (column planes at their ColPlan widths, data planes
+        scaled by the block-skip gather ratio the kernel reported, plus
+        the packed fetch buffer) over the measured kernel wall → achieved
+        GB/s, compared against the once-probed HBM peak. Cache hits (no
+        kernel ran) count separately and never feed the GB/s histogram."""
+        from pinot_tpu.ops import roofline as rl
+
+        try:
+            cache_hit = bool(flight.get("cache_hit"))
+            ratio = 1.0
+            bt, bs = outs.get("blocks_total"), outs.get("blocks_scanned")
+            if bt is not None and bs is not None:
+                total_b = float(np.sum(np.asarray(bt)))
+                if total_b > 0:
+                    ratio = min(1.0, float(np.sum(np.asarray(bs))) / total_b)
+            bytes_moved = 0 if cache_hit else int(
+                flight["zone_bytes"] + flight["data_bytes"] * ratio
+                + fetched_bytes)
+            kernel_ms = kernel_s * 1e3
+            link_ms = link_s * 1e3
+            rec = {"kernel": flight["label"],
+                   "bytesMoved": bytes_moved,
+                   "bytesFetched": int(fetched_bytes),
+                   "kernelMs": round(kernel_ms, 3),
+                   "linkMs": round(link_ms, 3),
+                   "cacheHit": cache_hit}
+            gbps = None
+            if not cache_hit and kernel_s > 1e-9:
+                gbps = bytes_moved / kernel_s / 1e9
+                rec["gbps"] = round(gbps, 3)
+                # the probe runs ONCE per process, lazily, on the first
+                # accounted flight (~tens of ms; warm queries never pay)
+                peak = rl.hbm_peak_gbps()
+                pct = rl.pct_of_peak(gbps, peak)
+                if pct is not None:
+                    rec["peakGbps"] = round(peak, 1)
+                    rec["pctOfPeak"] = pct
+            flight["record"] = rec
+            with self._lock:
+                agg = self._roofline.setdefault(
+                    flight["label"],
+                    {"queries": 0, "cache_hits": 0, "bytes_moved": 0,
+                     "kernel_ms": 0.0, "link_ms": 0.0})
+                agg["queries"] += 1
+                agg["link_ms"] += link_ms
+                if cache_hit:
+                    agg["cache_hits"] += 1
+                else:
+                    agg["bytes_moved"] += bytes_moved
+                    agg["kernel_ms"] += kernel_ms
+            if gbps is not None:
+                self.metrics.observe("deviceKernelGbps", gbps)
+        except Exception:  # noqa: BLE001 — accounting must never fail a fetch
+            log.exception("roofline flight accounting failed")
+
+    def roofline_stats(self) -> dict:
+        """Per-pipeline roofline snapshot: modeled bytes / kernel wall →
+        achieved GB/s per label, against the probed peak (None until the
+        first accounted flight triggers the probe — reading stats never
+        spends device time on the probe itself)."""
+        from pinot_tpu.ops import roofline as rl
+
+        with self._lock:
+            aggs = {k: dict(v) for k, v in self._roofline.items()}
+        peak = rl.peak_if_probed()
+        kernels = {}
+        for label, agg in aggs.items():
+            entry = dict(agg)
+            entry["kernel_ms"] = round(entry["kernel_ms"], 3)
+            entry["link_ms"] = round(entry["link_ms"], 3)
+            if agg["kernel_ms"] > 0:
+                gbps = agg["bytes_moved"] / (agg["kernel_ms"] / 1e3) / 1e9
+                entry["gbps"] = round(gbps, 3)
+                pct = rl.pct_of_peak(gbps, peak)
+                if pct is not None:
+                    entry["pct_of_peak"] = pct
+            kernels[label] = entry
+        return {"peak_gbps": round(peak, 1) if peak else None,
+                "kernels": kernels}
 
     # ---- template build --------------------------------------------------
     def _agg_template(self, i: int, a: Expression, ctx: BatchContext, params, counter):
@@ -1797,6 +1929,11 @@ class DeviceExecutor:
         pkey = self._pipeline_key(template, use_bs, wsig, trim)
         entry = self._pipeline_entry(template, agg_tpls, final, use_bs,
                                      widths, wsig, trim)
+        # roofline flight (ISSUE 11): always-on except under profile
+        # capture (the bench's amortized kernel probe re-dispatches the
+        # same launch and would pollute the per-query aggregates)
+        flight = None if self.profile_enabled else self._new_flight(
+            self._pipeline_label(template, use_bs, trim))
 
         # device partials cache: a repeat execution — same pipeline, same
         # batch, same literal/ps_alive/param VALUES — skips the gather +
@@ -1816,10 +1953,14 @@ class DeviceExecutor:
             hit = self._partials_get(cache_key)
             if hit is not None:
                 bufs_dev, clayout = hit
-                resolve = self._make_resolve(bufs_dev, clayout, tracer)
+                if flight is not None:
+                    flight["cache_hit"] = True
+                resolve = self._make_resolve(bufs_dev, clayout, tracer,
+                                             flight)
                 handle = InflightLaunch(self, q, ctx, template, aggs,
                                         batch_key, resolve)
                 handle.cache_hit = True
+                handle.flight = flight
                 return handle
         cols = {}
         with trace_span("gather", tracer):
@@ -1852,6 +1993,18 @@ class DeviceExecutor:
             cols, n_docs, params, _ = pad_to_multiple(
                 cols, n_docs, params, self.mesh.devices.size
             )
+        if flight is not None:
+            # static cost-model inputs: plane bytes at their ColPlan
+            # widths (the arrays ARE stored narrow), split data vs zone —
+            # the block-skip form reads zone planes fully but data planes
+            # only for gathered blocks (_note_flight applies the ratio
+            # the kernel reports)
+            for ck, cv in cols.items():
+                nb = int(getattr(cv, "nbytes", 0))
+                if ck.startswith((bs_ops.ZLO, bs_ops.ZHI)):
+                    flight["zone_bytes"] += nb
+                else:
+                    flight["data_bytes"] += nb
 
         # ONE packed buffer crosses the host link: device_get fetches tree
         # leaves serially, so on a high-RTT link every leaf would be a full
@@ -1878,8 +2031,11 @@ class DeviceExecutor:
         with trace_span("dispatch", tracer):
             resolve = self._dispatch(
                 entry, batch_key, cols, n_docs, params, lkey, layout, tracer,
-                cache_key)
-        return InflightLaunch(self, q, ctx, template, aggs, batch_key, resolve)
+                cache_key, flight)
+        handle = InflightLaunch(self, q, ctx, template, aggs, batch_key,
+                                resolve)
+        handle.flight = flight
+        return handle
 
     # ---- dispatch: solo vs coalesced -------------------------------------
     def _pipeline_key(self, template, blockskip, wsig, trim) -> tuple:
@@ -1968,7 +2124,7 @@ class DeviceExecutor:
             return entry
 
     def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout,
-                  tracer=None, cache_key=None):
+                  tracer=None, cache_key=None, flight=None):
         """Dispatch one query: through the coalescer when concurrency makes
         a cohort partner likely, else solo. Returns the resolve() closure
         the InflightLaunch fetch phase blocks on. Coalescing is disabled
@@ -1991,7 +2147,7 @@ class DeviceExecutor:
             cohort, idx = co.join(
                 ckey, params,
                 lambda members: self._cohort_launch(
-                    entry, cols, n_docs, members, lkey, tracer))
+                    entry, cols, n_docs, members, lkey, tracer, flight))
 
             def resolve(_c=cohort, _i=idx):
                 return _c.resolve_member(_i)
@@ -2002,10 +2158,10 @@ class DeviceExecutor:
             resolve.abandon = cohort.note_abandoned
             return resolve
         return self._solo_launch(entry, cols, n_docs, params, layout, tracer,
-                                 cache_key)
+                                 cache_key, flight)
 
     def _solo_launch(self, entry, cols, n_docs, params, layout, tracer=None,
-                     cache_key=None):
+                     cache_key=None, flight=None):
         pipeline = entry["pipeline"]
         if self.profile_enabled:
             with self._lock:
@@ -2021,9 +2177,10 @@ class DeviceExecutor:
             # Cohort members never insert — their buffer interleaves the
             # whole cohort's rows
             self._partials_put(cache_key, bufs_dev, layout)
-        return self._make_resolve(bufs_dev, layout, tracer)
+        return self._make_resolve(bufs_dev, layout, tracer, flight)
 
-    def _cohort_launch(self, entry, cols, n_docs, members, lkey, tracer=None):
+    def _cohort_launch(self, entry, cols, n_docs, members, lkey, tracer=None,
+                       flight=None):
         """Leader side of a coalesced cohort: stack every member's params
         along a leading axis and dispatch ONE vmapped launch; the shared
         resolve() fetches ONE packed buffer for the whole cohort (each
@@ -2034,7 +2191,7 @@ class DeviceExecutor:
             # whole extra compile of the template for nothing
             layout = entry["layouts"][lkey]
             base = self._solo_launch(entry, cols, n_docs, members[0], layout,
-                                     tracer)
+                                     tracer, flight=flight)
             return lambda: {k: v[None] for k, v in base().items()}
         pipeline_v, inner_v = self._cohort_pipeline(entry)
         # pad the cohort to the next power of two (repeating the last
@@ -2060,7 +2217,7 @@ class DeviceExecutor:
             with self._lock:
                 entry["cohort_layouts"][ck] = layout
         bufs_dev = pipeline_v(cols, n_docs, pstack)  # async dispatch
-        return self._make_resolve(bufs_dev, layout, tracer)
+        return self._make_resolve(bufs_dev, layout, tracer, flight)
 
     def _cohort_pipeline(self, entry):
         """(jitted packed pipeline, inner fn) over params carrying a

@@ -199,10 +199,19 @@ class QueryEngine:
                 return execute_multistage(self, stmt, t0)
             q = optimize_query(compile_select(stmt))
             if q.explain:
+                if q.analyze:
+                    return self._explain_analyze(q, t0)
                 return self._explain(q)
-            result, stats = self.execute_query(q)
+            result, merged = self._execute_merged(q)
         except Exception as e:  # noqa: BLE001 — reference returns exceptions in-band
             return {"exceptions": [{"errorCode": 200, "message": f"{type(e).__name__}: {e}"}]}
+        return self._stats_response(result, merged, t0)
+
+    @staticmethod
+    def _stats_response(result, merged, t0: float) -> dict:
+        """Broker-response-shaped dict from a finalized result + merged
+        intermediate (the one shared by execute and EXPLAIN ANALYZE)."""
+        stats = merged.stats
         resp = result.to_json()
         resp.update(
             {
@@ -218,12 +227,25 @@ class QueryEngine:
                 "numGroupsLimitReached": stats.num_groups_limit_reached,
                 "partialsCacheHit": stats.partials_cache_hit,
                 "totalDocs": stats.total_docs,
+                # kernel roofline accounting (ISSUE 11)
+                "deviceBytesMoved": stats.device_bytes_moved,
+                "deviceKernelMs": round(stats.device_kernel_ms, 3),
+                "deviceLinkMs": round(stats.device_link_ms, 3),
                 "timeUsedMs": round((time.time() - t0) * 1000, 3),
             }
         )
+        if getattr(merged, "roofline", None):
+            resp["roofline"] = merged.roofline
         return resp
 
-    def execute_query(self, q: QueryContext):
+    def execute_query(self, q: QueryContext, tracer=None):
+        result, merged = self._execute_merged(q, tracer=tracer)
+        return result, merged.stats
+
+    def _execute_merged(self, q: QueryContext, tracer=None):
+        """(finalized ResultTable, merged IntermediateResult) — the inner
+        execute path; keeps the merged result (trace/roofline/stat
+        leaves) available to callers that render more than rows."""
         tdm = self.tables.get(q.table_name)
         if tdm is None:
             raise KeyError(f"table {q.table_name!r} not found")
@@ -231,9 +253,10 @@ class QueryEngine:
         try:
             if not segments:
                 raise ValueError(f"table {q.table_name!r} has no segments")
-            merged = self.execute_segments(q, segments, terminal=True)
+            merged = self.execute_segments_async(
+                q, segments, terminal=True, tracer=tracer)()
             q = self._expand_star(q, segments[0])
-            return finalize(q, merged), merged.stats
+            return finalize(q, merged), merged
         finally:
             tdm.release(segments)
 
@@ -505,6 +528,13 @@ class QueryEngine:
 
             with span("merge", tracer):
                 merged = merge_intermediates(q, res)
+            # per-flight roofline records (ISSUE 11) concatenate across
+            # partials (merge_intermediates builds a fresh result; the
+            # single-partial shortcut passes its own list through)
+            roofs = [rec for r in res if getattr(r, "roofline", None)
+                     for rec in r.roofline]
+            if roofs:
+                merged.roofline = roofs
             # device partials carry their own launch-level pruned counts
             # (alive-masked batch members); add the segments dropped here
             merged.stats.num_segments_pruned += pruned + len(fallback_pruned)
@@ -572,6 +602,32 @@ class QueryEngine:
         from pinot_tpu.engine.explain import explain_plan
 
         return explain_plan(self, q)
+
+    def _explain_analyze(self, q: QueryContext, t0: float) -> dict:
+        """EXPLAIN ANALYZE (ISSUE 11): execute the underlying query for
+        real (traced, so the phase ladder fills), then render the plan
+        tree annotated with per-node actuals. The executed response rides
+        along as ``analyzedResponse`` so callers can verify the results
+        are bit-identical to the non-ANALYZE form."""
+        import dataclasses
+
+        from pinot_tpu.common.trace import Tracer
+        from pinot_tpu.engine.explain import annotate_analyze, explain_plan
+
+        # the partials cache is bypassed for the analyzed run: a cache
+        # hit skips the kernel entirely, and the point of ANALYZE is to
+        # MEASURE it (results are bit-identical either way — pinned by
+        # the subrtt differential suite)
+        q_run = dataclasses.replace(
+            q, explain=False, analyze=False,
+            options=q.options + (("usePartialsCache", False),))
+        tracer = Tracer("analyze")
+        result, merged = self._execute_merged(q_run, tracer=tracer)
+        resp = self._stats_response(result, merged, t0)
+        resp["traceInfo"] = {"server": tracer.to_json()}
+        out = annotate_analyze(explain_plan(self, q), resp)
+        out["analyzedResponse"] = resp
+        return out
 
 
 def _impossible(q: QueryContext):

@@ -145,6 +145,108 @@ def explain_multistage(engine, plan) -> dict:
     return _rows_response(lines)
 
 
+def _fmt_ms(v) -> str:
+    try:
+        return f"{float(v):.2f}ms"
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _kernel_line(rec: dict) -> str:
+    """One roofline flight → the per-kernel ``GB/s (x% of HBM peak)``
+    line EXPLAIN ANALYZE renders (ISSUE 11 / ROADMAP 1: the SNIPPETS.md
+    "GB/s vs HBM peak reported per query" target)."""
+    label = rec.get("kernel", "kernel")
+    inst = rec.get("instance")
+    where = f"@{inst}" if inst else ""
+    if rec.get("cacheHit"):
+        return (f"    KERNEL({label}{where}: CACHED_PARTIALS, "
+                f"linkMs={rec.get('linkMs')})")
+    gbps = rec.get("gbps")
+    pct = rec.get("pctOfPeak")
+    peak = rec.get("peakGbps")
+    if gbps is None:
+        perf = "n/a"
+    elif pct is not None:
+        perf = f"{gbps} GB/s ({pct}% of HBM peak {peak} GB/s)"
+    else:
+        perf = f"{gbps} GB/s"
+    return (f"    KERNEL({label}{where}: {perf}, "
+            f"bytes={rec.get('bytesMoved')}, "
+            f"kernelMs={rec.get('kernelMs')}, linkMs={rec.get('linkMs')})")
+
+
+def annotate_analyze(plan: dict, resp: dict) -> dict:
+    """EXPLAIN ANALYZE rendering (ISSUE 11): the static plan tree from
+    explain_plan / explain_multistage, annotated in place with per-node
+    actuals from the EXECUTED response — rows in/out on the reduce /
+    combine / join / scan nodes, matched rows + blocks pruned on the
+    filter root — followed by an ANALYZE subtree carrying the segment
+    counters, the per-phase ms waterfall (merged traceInfo), one KERNEL
+    line per roofline flight (achieved GB/s vs the HBM peak), and the
+    cache-hit provenance (device partials / broker result cache)."""
+    from pinot_tpu.tools.querylog import phase_breakdown
+
+    lines = [r[0] for r in plan["resultTable"]["rows"]]
+    nrows = len(((resp.get("resultTable") or {}).get("rows")) or [])
+    docs = resp.get("numDocsScanned")
+    leaf_rows = resp.get("leafRows") or {}
+    # multistage plans carry PER-TABLE pushdown filters; the cluster-wide
+    # docsScanned total belongs to none of them, so the filter-root
+    # annotation is single-stage-only (leafRows is the multistage marker)
+    multistage = bool(leaf_rows) or resp.get("numJoinedRows") is not None
+    filter_done = multistage
+    out = []
+    for ln in lines:
+        s = ln.strip()
+        if s.startswith("BROKER_REDUCE"):
+            ln += (f" (actual: rows={nrows}, "
+                   f"timeMs={resp.get('timeUsedMs')})")
+        elif s.startswith("STAGE_2_"):
+            # stage 2 consumes the JOINED row set, not the stage-1 scan
+            # docs (a 1M-doc scan joining down to 500 rows must say 500)
+            n_in = resp.get("numJoinedRows")
+            ln += (f" (actual: in={docs if n_in is None else n_in} rows, "
+                   f"out={nrows} rows)")
+        elif s.startswith("COMBINE_"):
+            ln += f" (actual: in={docs} rows, out={nrows} rows)"
+        elif s.startswith("JOIN_") and resp.get("numJoinedRows") is not None:
+            ln += f" (actual: out={resp['numJoinedRows']} rows)"
+        elif s.startswith("SCAN("):
+            alias = s[len("SCAN("):].split("=", 1)[0]
+            if alias in leaf_rows:
+                ln += f" (actual: out={leaf_rows[alias]} rows)"
+        elif (s.startswith("FILTER_") and not filter_done
+              and not s.startswith("FILTER_MATCH_ENTIRE")
+              and docs is not None):
+            filter_done = True  # annotate the ROOT filter node only
+            ln += (f" (actual: matched={docs} rows, "
+                   f"blocksPruned={resp.get('numBlocksPruned', 0)})")
+        out.append(ln)
+
+    out.append("  ANALYZE")
+    out.append(f"    ROWS(scanned={docs}, returned={nrows}, "
+               f"totalDocs={resp.get('totalDocs')})")
+    out.append(
+        "    SEGMENTS("
+        f"queried={resp.get('numSegmentsQueried')}, "
+        f"processed={resp.get('numSegmentsProcessed')}, "
+        f"matched={resp.get('numSegmentsMatched')}, "
+        f"prunedByServer={resp.get('numSegmentsPrunedByServer')}, "
+        f"prunedByBroker={resp.get('numSegmentsPrunedByBroker', 0)}, "
+        f"blocksPruned={resp.get('numBlocksPruned')})")
+    phases = phase_breakdown({"traceInfo": resp.get("traceInfo") or {}})
+    if phases:
+        out.append("    PHASE(" + ", ".join(
+            f"{k}={_fmt_ms(v)}" for k, v in sorted(phases.items())) + ")")
+    for rec in resp.get("roofline") or ():
+        out.append(_kernel_line(rec))
+    out.append(
+        f"    CACHE(partialsCacheHit={bool(resp.get('partialsCacheHit'))}, "
+        f"resultCacheHit={bool(resp.get('resultCacheHit'))})")
+    return _rows_response(out)
+
+
 def explain_plan(engine, q: QueryContext) -> dict:
     lines: list[str] = []
     aggs = q.aggregations()

@@ -62,6 +62,13 @@ class InflightLaunch:
         # (no gather/dispatch/kernel — the fetch re-reads a cached packed
         # buffer); surfaces as the result's partialsCacheHit stat
         self.cache_hit = False
+        # roofline flight dict (ISSUE 11), set by the executor when
+        # accounting is on: the resolve fills flight["record"] with the
+        # modeled-bytes/kernel-ms/GB/s record, and fetch() folds it into
+        # the result's stats + roofline list. Cohort members other than
+        # the leader carry an unfilled flight (the shared kernel is
+        # attributed once, to the leader's trace and record).
+        self.flight = None
 
     def fetch(self):
         """Blocking phase: resolve the packed buffer → IntermediateResult.
@@ -112,6 +119,16 @@ class InflightLaunch:
                 self._q, self._ctx, self._template, outs, self._aggs,
                 cache_hit=self.cache_hit)
             result.stats.partials_cache_hit = self.cache_hit
+            rec = None if self.flight is None else self.flight.get("record")
+            if rec is not None:
+                # per-query roofline accounting (ISSUE 11): the flight's
+                # record rides the result so servers ship it in DataTable
+                # metadata and the broker/EXPLAIN ANALYZE render it
+                result.roofline = [rec]
+                st = result.stats
+                st.device_bytes_moved += int(rec.get("bytesMoved") or 0)
+                st.device_kernel_ms += float(rec.get("kernelMs") or 0.0)
+                st.device_link_ms += float(rec.get("linkMs") or 0.0)
             return result
         finally:
             self._executor._release_launch(self._batch_key)

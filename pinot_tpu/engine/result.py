@@ -51,6 +51,16 @@ class ExecutionStats:
     # the answering server's freshness epoch for the queried table
     # (common/freshness.py): the broker result cache's staleness signal
     table_epoch: int = -1
+    # kernel roofline accounting (ISSUE 11): modeled HBM bytes the device
+    # pipeline moved (ColPlan-width column planes scaled by the block-skip
+    # gather ratio, plus the trimmed fetch buffer) and the measured
+    # kernel/link wall — achieved GB/s = bytes / kernel time, computed at
+    # export against the per-process HBM peak (ops/roofline.py). Summed
+    # across partials on merge; per-flight detail rides
+    # IntermediateResult.roofline.
+    device_bytes_moved: int = 0
+    device_kernel_ms: float = 0.0
+    device_link_ms: float = 0.0
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -71,6 +81,9 @@ class ExecutionStats:
         self.server_inflight = max(self.server_inflight,
                                    other.server_inflight)
         self.table_epoch = max(self.table_epoch, other.table_epoch)
+        self.device_bytes_moved += other.device_bytes_moved
+        self.device_kernel_ms += other.device_kernel_ms
+        self.device_link_ms += other.device_link_ms
 
 
 @dataclasses.dataclass
@@ -90,6 +103,11 @@ class IntermediateResult:
     rows: Optional[dict] = None
     stats: ExecutionStats = dataclasses.field(default_factory=ExecutionStats)
     trace: Optional[list] = None  # phase spans when SET trace = true
+    # per-flight roofline records (ISSUE 11): one dict per device launch
+    # this partial folded in ({kernel, bytesMoved, kernelMs, linkMs,
+    # gbps, peakGbps, pctOfPeak, cacheHit}) — concatenated across
+    # partials, shipped in DataTable metadata like ``trace``
+    roofline: Optional[list] = None
 
 
 @dataclasses.dataclass

@@ -1,0 +1,124 @@
+"""HBM/memcpy peak probe: the denominator of every roofline line.
+
+The r05 micro table showed a ~400x spread between kernels (masked_sum at
+822 GB/s vs scatter_group_sum at 0.7 GB/s) that was only visible inside
+bench.py.  ISSUE 11 makes achieved-vs-peak a per-query number, which
+needs ONE per-process answer to "what does this device's memory system
+sustain": a tiny jitted element-wise pass (read + write the whole
+buffer — the streaming-bandwidth shape XLA cannot avoid moving bytes
+for), timed amortized, best of a few repeats.
+
+The probe is LAZY and cached per process:
+
+- ``PINOT_TPU_HBM_PEAK_GBPS`` overrides it entirely (no device work) —
+  the bench/tests knob, and the operator's escape hatch on boxes where
+  the probe is unrepresentative;
+- the first caller of :func:`hbm_peak_gbps` pays the measurement once
+  (~tens of ms: one trivial jit compile + a few iterations over a 16 MB
+  buffer); every later call is a dict read;
+- :func:`peak_if_probed` never triggers the measurement — scrape-time
+  consumers (the server's ``hbmPeakGbps`` gauge) must not spend device
+  time inside a metrics poll, and jax-free processes (ingest workers,
+  plain brokers) must not import jax through this module.
+
+Import cost: numpy only.  jax loads inside the measurement, so merely
+importing this module from a jax-free process stays jax-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("pinot_tpu.ops.roofline")
+
+# probe working-set size: big enough to spill any cache tier the device
+# backend models, small enough that the one-off measurement stays in the
+# tens of milliseconds even on a 2-core CPU backend
+PROBE_BYTES = int(os.environ.get("PINOT_TPU_HBM_PROBE_BYTES", 16 << 20))
+_PROBE_REPEATS = 5
+
+_lock = threading.Lock()
+_peak_gbps: Optional[float] = None
+
+
+def _env_peak() -> Optional[float]:
+    v = os.environ.get("PINOT_TPU_HBM_PEAK_GBPS")
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def reset_probe() -> None:
+    """Forget the cached measurement (tests)."""
+    global _peak_gbps
+    with _lock:
+        _peak_gbps = None
+
+
+def peak_if_probed() -> Optional[float]:
+    """The cached peak (or the env override) WITHOUT triggering a
+    measurement — None when nothing measured yet.  The scrape-safe and
+    jax-free-process-safe read."""
+    env = _env_peak()
+    if env is not None:
+        return env
+    return _peak_gbps
+
+
+def hbm_peak_gbps() -> float:
+    """Per-process HBM/memcpy peak in GB/s (read+write bytes counted),
+    measured once and cached.  Returns 0.0 when the probe cannot run
+    (no jax backend) — consumers must treat <= 0 as "peak unknown" and
+    skip the %-of-peak annotation rather than divide by it."""
+    global _peak_gbps
+    env = _env_peak()
+    if env is not None:
+        return env
+    with _lock:
+        if _peak_gbps is None:
+            try:
+                _peak_gbps = _measure()
+            except Exception:  # noqa: BLE001 — accounting must never fail a query
+                log.exception("HBM peak probe failed; roofline %% disabled")
+                _peak_gbps = 0.0
+        return _peak_gbps
+
+
+def _measure() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    n = max(1 << 16, PROBE_BYTES // 4)
+    x = jnp.zeros(n, dtype=jnp.float32)
+    f = jax.jit(lambda a: a + jnp.float32(1))
+    jax.block_until_ready(f(x))  # compile + first-touch
+    bytes_moved = 2 * n * 4  # one read + one write of the buffer
+    best = 0.0
+    for _ in range(_PROBE_REPEATS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        dt = time.perf_counter() - t0
+        best = max(best, bytes_moved / max(dt, 1e-9) / 1e9)
+    log.info("HBM peak probe: %.2f GB/s over %d MB (%s backend)",
+             best, (n * 4) >> 20, jax.default_backend())
+    return best
+
+
+def pct_of_peak(gbps: Optional[float],
+                peak: Optional[float] = None) -> Optional[float]:
+    """``gbps`` as a percentage of ``peak`` (default: the cached probe),
+    or None when either side is unknown."""
+    if gbps is None:
+        return None
+    if peak is None:
+        peak = peak_if_probed()
+    if not peak or peak <= 0:
+        return None
+    return round(100.0 * gbps / peak, 3)

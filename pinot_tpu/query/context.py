@@ -318,6 +318,8 @@ class QueryContext:
     offset: int = 0
     options: tuple = ()        # tuple[(key, value), ...] from SET statements
     explain: bool = False
+    # EXPLAIN ANALYZE (ISSUE 11): execute for real + annotate the plan
+    analyze: bool = False
 
     # ---- derived ---------------------------------------------------------
     def aggregations(self) -> list[Expression]:

@@ -116,6 +116,7 @@ class MultiStagePlan:
     # demotes to SHUFFLE there instead of replicating a huge build table)
     strategy_forced: bool = False
     explain: bool = False
+    analyze: bool = False  # EXPLAIN ANALYZE (ISSUE 11)
 
     @property
     def probe(self) -> TableSource:
@@ -257,6 +258,7 @@ def compile_plan(stmt: SqlSelect,
         offset=stmt.offset,
         options=tuple(sorted(stmt.options.items())),
         explain=stmt.explain,
+        analyze=stmt.analyze,
     )
 
     opts_ci = stage2.options_ci()
@@ -266,7 +268,7 @@ def compile_plan(stmt: SqlSelect,
         post_filter=_and_all(post), windows=windows, stage2=stage2,
         strategy=strategy,
         strategy_forced="joinstrategy" in opts_ci,
-        explain=stmt.explain)
+        explain=stmt.explain, analyze=stmt.analyze)
 
 
 def _pick_strategy(opts: dict, builds) -> str:

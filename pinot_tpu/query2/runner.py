@@ -729,6 +729,7 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
     n = len(next(iter(left_cols.values()))) if left_cols else 0
 
     strategies = []
+    roofline_recs = []
     for step in plan.joins:
         build_cols = {f"{step.build.alias}.{c}": np.asarray(v)
                       for c, v in table_rows[step.build.alias].items()}
@@ -739,9 +740,20 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
             # a heuristic BROADCAST must not replicate a huge build table
             # to every device; SET joinStrategy='broadcast' overrides
             strat = "SHUFFLE"
+        bytes_in = sum(int(v.nbytes) for v in left_cols.values()) \
+            + sum(int(v.nbytes) for v in build_cols.values())
+        t_join = time.perf_counter()
         left_cols, n = execute_join_step(
             left_cols, n, step, build_cols, device, mesh, strat)
+        join_ms = (time.perf_counter() - t_join) * 1e3
         strategies.append(strat)
+        # roofline record for the join step (ISSUE 11): probe+build
+        # bytes in, expanded pairs out, over the step's wall — a coarser
+        # model than the leaf-scan kernels' (host glue is inside the
+        # wall), but it makes EXPLAIN ANALYZE on a join render the same
+        # per-kernel GB/s line the single-stage path gets
+        roofline_recs.append(_join_roofline_record(
+            step, strat, bytes_in, left_cols, join_ms, device))
 
     if plan.post_filter is not None and n:
         m = _expr_mask(left_cols, plan.post_filter, None, n)
@@ -761,21 +773,61 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
         "numJoinedRows": n,
         "backend": "device" if device is not None else "host",
         "mesh": mesh is not None,
+        "roofline": roofline_recs,
     }
     return result, meta
+
+
+def _join_roofline_record(step, strat: str, bytes_in: int, out_cols: dict,
+                          join_ms: float, device) -> dict:
+    """Roofline flight record for one executed join step."""
+    import sys
+
+    from pinot_tpu.ops import roofline as rl
+
+    bytes_out = sum(int(v.nbytes) for v in out_cols.values())
+    bytes_moved = bytes_in + bytes_out
+    rec = {"kernel": f"join_{step.kind.lower()}+{strat.lower()}",
+           "bytesMoved": bytes_moved, "bytesFetched": bytes_out,
+           "kernelMs": round(join_ms, 3), "linkMs": 0.0,
+           "cacheHit": False}
+    if join_ms > 0:
+        gbps = bytes_moved / (join_ms / 1e3) / 1e9
+        rec["gbps"] = round(gbps, 3)
+        # only probe when a device executor is attached or jax is already
+        # resident — a jax-free broker process must stay jax-free
+        peak = rl.hbm_peak_gbps() \
+            if (device is not None or "jax" in sys.modules) \
+            else (rl.peak_if_probed() or 0.0)
+        pct = rl.pct_of_peak(gbps, peak)
+        if pct is not None:
+            rec["peakGbps"] = round(peak, 1)
+            rec["pctOfPeak"] = pct
+    return rec
 
 
 def run_local(engine, plan: MultiStagePlan):
     """Embedded / server-local execution: stage-1 scans over the engine's
     hosted segments, then the shared plan runner."""
+    from pinot_tpu.common.trace import span
+
     stats = ExecutionStats()
     need = needed_columns(plan)
     table_rows = {}
+    # spans are no-ops untraced; under EXPLAIN ANALYZE's thread-local
+    # tracer they fill the embedded waterfall (scan_local_rows drives
+    # SegmentEvaluator directly, below the engine's instrumented paths)
     for src in plan.sources:
-        table_rows[src.alias] = scan_local_rows(
-            engine, src.table, plan.pushdown.get(src.alias),
-            need[src.alias], stats)
-    result, meta = run_plan(plan, table_rows, device=engine.device)
+        with span("host_scan"):
+            table_rows[src.alias] = scan_local_rows(
+                engine, src.table, plan.pushdown.get(src.alias),
+                need[src.alias], stats)
+    with span("stage2"):
+        result, meta = run_plan(plan, table_rows, device=engine.device)
+    meta["leafRows"] = {
+        alias: (len(next(iter(cols.values()))) if cols else 0)
+        for alias, cols in table_rows.items()
+    }
     return result, stats, meta
 
 
@@ -796,11 +848,27 @@ def execute_multistage(engine, stmt, t0: Optional[float] = None) -> dict:
         return cols, bool(getattr(tdm, "is_dim_table", False))
 
     plan = compile_plan(stmt, catalog)
-    if plan.explain:
+    analyze = plan.explain and getattr(plan, "analyze", False)
+    if plan.explain and not analyze:
         from pinot_tpu.engine.explain import explain_multistage
 
         return explain_multistage(engine, plan)
-    result, stats, meta = run_local(engine, plan)
+    tracer = None
+    if analyze:
+        # EXPLAIN ANALYZE needs the phase waterfall: install a
+        # thread-local tracer so the leaf scans' span() sites (same
+        # thread on the embedded path) fill the ladder — matching the
+        # broker EA paths, which force SET trace = true
+        from pinot_tpu.common import trace as _trace
+
+        tracer = _trace.start_trace("analyze")
+    try:
+        result, stats, meta = run_local(engine, plan)
+    finally:
+        if tracer is not None:
+            from pinot_tpu.common import trace as _trace
+
+            _trace.end_trace()
     resp = result.to_json()
     resp.update({
         "exceptions": [],
@@ -816,8 +884,25 @@ def execute_multistage(engine, stmt, t0: Optional[float] = None) -> dict:
         "totalDocs": stats.total_docs,
         "numStages": meta["numStages"],
         "numJoinedRows": meta["numJoinedRows"],
+        "leafRows": meta.get("leafRows") or {},
         "timeUsedMs": round((time.time() - t0) * 1000, 3),
     })
+    if meta.get("roofline"):
+        resp["roofline"] = meta["roofline"]
     if meta["joinStrategy"]:
         resp["joinStrategy"] = meta["joinStrategy"]
+    if analyze:
+        # EXPLAIN ANALYZE (ISSUE 11): the plan ran for real above —
+        # annotate the static tree with its actuals; the executed
+        # response rides as analyzedResponse (bit-identical contract)
+        from pinot_tpu.engine.explain import (
+            annotate_analyze,
+            explain_multistage,
+        )
+
+        if tracer is not None and tracer.spans:
+            resp["traceInfo"] = {"server": tracer.to_json()}
+        out = annotate_analyze(explain_multistage(engine, plan), resp)
+        out["analyzedResponse"] = resp
+        return out
     return resp

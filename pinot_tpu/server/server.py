@@ -71,6 +71,14 @@ def _apply_request_overrides(q, req: dict):
     return q
 
 
+def _hbm_peak_if_probed():
+    """Scrape-safe HBM-peak gauge (ops/roofline.py): the cached probe
+    value or None — never triggers the measurement from a metrics poll."""
+    from pinot_tpu.ops import roofline
+
+    return roofline.peak_if_probed()
+
+
 class ServerInstance:
     def __init__(self, instance_id: str, registry: ClusterRegistry,
                  data_dir: str, host: str = "127.0.0.1", port: int = 0,
@@ -127,6 +135,20 @@ class ServerInstance:
         # from the (faster) segment-sync tick — see _sync_loop
         self.heartbeat_interval_s = conf.get_float(
             "pinot.server.heartbeat.interval.ms", 2_000.0) / 1e3
+        # per-segment access-temperature telemetry (ISSUE 11,
+        # server/heat.py): decayed access/bytes counters updated on every
+        # query, piggybacked in the heartbeat like scheduler pressure and
+        # aggregated at the controller (/tables/{t}/heat) — the input
+        # ROADMAP 3's tier promotion/demotion policy will consume
+        from pinot_tpu.server.heat import SegmentHeatTracker
+
+        self.heat = SegmentHeatTracker(
+            half_life_s=conf.get_float(
+                "pinot.server.heat.halflife.ms", 300_000.0) / 1e3,
+            max_entries=int(conf.get_float(
+                "pinot.server.heat.max.segments", 8192)))
+        self.heat_top_per_table = int(conf.get_float(
+            "pinot.server.heat.heartbeat.top.segments", 32))
         self._last_serving = None  # last published ExternalView payload
         self._shutting_down = False
         self._inflight_queries = 0
@@ -162,6 +184,12 @@ class ServerInstance:
             len(t.segments) for t in self.engine.tables.values()))
         self._register_gauge("schedulerRejected",
                              lambda: self.scheduler.num_rejected)
+        # temperature + roofline gauges (ISSUE 11): tracked segments and
+        # the per-process HBM peak (None until the first accounted device
+        # flight probes it — a metrics scrape never spends device time)
+        self._register_gauge("heatTrackedSegments",
+                             lambda: self.heat.size())
+        self._register_gauge("hbmPeakGbps", _hbm_peak_if_probed)
         # HBM / batch-LRU accounting (DeviceExecutor.hbm_stats): resident
         # bytes, cache traffic, and bytes the width planning saved — the
         # operational view of ISSUE 5's narrowing (a shrinking
@@ -539,6 +567,18 @@ class ServerInstance:
                 merged.stats.server_inflight = self._inflight_queries
                 merged.stats.table_epoch = epoch_at_start
                 self.queries_served += 1
+                # segment-temperature telemetry (ISSUE 11): every routed
+                # segment of this query heats up — bytes are the
+                # rows x referenced-columns x 4 admission-cost proxy
+                try:
+                    ncols = max(1, len(q.columns()))
+                    for s in segments:
+                        self.heat.note(
+                            q.table_name, s.name,
+                            bytes_scanned=int(
+                                getattr(s, "n_docs", 0)) * ncols * 4)
+                except Exception:  # noqa: BLE001 — telemetry never fails a query
+                    log.exception("segment heat accounting failed")
                 if tracer is not None:
                     # encode itself can't appear in the trace: the spans
                     # are serialized INTO the payload encode produces.
@@ -669,6 +709,15 @@ class ServerInstance:
             last.server_inflight = self._inflight_queries
             last.table_epoch = epoch_at_start
             self.queries_served += 1
+            try:
+                ncols = max(1, len(q.columns()))
+                for s in segments:
+                    self.heat.note(
+                        q.table_name, s.name,
+                        bytes_scanned=int(
+                            getattr(s, "n_docs", 0)) * ncols * 4)
+            except Exception:  # noqa: BLE001 — telemetry never fails a query
+                log.exception("segment heat accounting failed")
             return [encode(b) for b in blocks]
         finally:
             if tdm is not None:
@@ -726,7 +775,12 @@ class ServerInstance:
                     # servers writing it every 200ms serialize on the lock.
                     self.registry.heartbeat(
                         self.instance_id, pressure=self.scheduler.pressure(),
-                        table_epochs=freshness.snapshot())
+                        table_epochs=freshness.snapshot(),
+                        # per-segment temperature snapshot (ISSUE 11),
+                        # hottest-N per table so the payload stays
+                        # bounded at million-segment scale
+                        heat=self.heat.snapshot(
+                            top_per_table=self.heat_top_per_table))
                     last_hb = now
             except Exception:
                 log.exception("segment sync failed")

@@ -120,6 +120,7 @@ def compile_select(stmt: SqlSelect) -> QueryContext:
         offset=stmt.offset,
         options=tuple(sorted(stmt.options.items())),
         explain=stmt.explain,
+        analyze=stmt.analyze,
     )
 
 

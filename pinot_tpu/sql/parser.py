@@ -117,6 +117,9 @@ class SqlSelect:
     offset: int = 0
     options: dict = dataclasses.field(default_factory=dict)
     explain: bool = False
+    # EXPLAIN ANALYZE (ISSUE 11): execute the query for real and render
+    # the plan annotated with per-node actuals; implies explain
+    analyze: bool = False
 
 
 _RESERVED_STOP = {
@@ -209,14 +212,22 @@ class Parser:
             self.expect_op(";")
 
         explain = False
+        analyze = False
         if self.accept_kw("EXPLAIN"):
-            self.expect_kw("PLAN")
-            self.expect_kw("FOR")
+            # EXPLAIN PLAN FOR <select> renders the static plan;
+            # EXPLAIN ANALYZE <select> executes it and annotates the plan
+            # with per-node actuals (ISSUE 11)
+            if self.accept_kw("ANALYZE"):
+                analyze = True
+            else:
+                self.expect_kw("PLAN")
+                self.expect_kw("FOR")
             explain = True
 
         stmt = self.parse_select()
         stmt.options = options
         stmt.explain = explain
+        stmt.analyze = analyze
         self.accept_op(";")
         t = self.peek()
         if t.kind != "eof":
@@ -595,3 +606,16 @@ def _unquote(t: Token) -> str:
 
 def parse_sql(sql: str) -> SqlSelect:
     return Parser(sql).parse()
+
+
+# EXPLAIN ANALYZE executes the UNDERLYING statement through the normal
+# path (broker scatter-gather / multi-stage leaves): the keyword pair is
+# stripped from the raw SQL once, preserving any leading SET statements.
+_EXPLAIN_ANALYZE_RE = re.compile(r"\bEXPLAIN\s+ANALYZE\s+", re.IGNORECASE)
+
+
+def strip_explain_analyze(sql: str) -> str:
+    """The SQL with its first ``EXPLAIN ANALYZE`` removed (the executable
+    form the broker re-runs); unchanged input when the keywords are
+    absent — callers use equality as the "did anything strip" guard."""
+    return _EXPLAIN_ANALYZE_RE.sub("", sql, count=1)

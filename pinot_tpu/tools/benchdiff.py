@@ -27,7 +27,11 @@ Compared metric families (direction-aware):
   when BOTH rounds carry a ``detail.cluster`` section,
 - the phase waterfall (``observability.phase_p50_ms.*`` — lower is
   better; informational by default since queue/link phases are noisy,
-  gated only under ``--gate-phases``).
+  gated only under ``--gate-phases``),
+- the per-kernel roofline (``roofline.kernels.*.gbps`` — higher is
+  better — ISSUE 11's achieved-GB/s-vs-HBM-peak accounting), compared
+  when both rounds carry a ``detail.roofline`` section (or the copy
+  nested under ``observability``).
 """
 
 from __future__ import annotations
@@ -39,7 +43,7 @@ import sys
 # sections brace-matched out of a truncated driver-wrapper tail
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
-                  "cluster", "breakdown")
+                  "cluster", "breakdown", "roofline")
 
 
 def _brace_match(text: str, key: str):
@@ -156,6 +160,20 @@ def extract_metrics(detail: dict) -> dict:
                 v = _num(v)
                 if v is not None:
                     out[f"phase.{pname}.p50_ms"] = (v, "lower")
+    # per-kernel roofline (ISSUE 11): achieved GB/s per pipeline label —
+    # higher is better; compared only when BOTH rounds carry the section
+    # (falls back to the copy nested under observability for rounds that
+    # predate the top-level promotion)
+    roof = detail.get("roofline")
+    if not isinstance(roof, dict):
+        obs_sec = detail.get("observability")
+        roof = obs_sec.get("roofline") if isinstance(obs_sec, dict) else None
+    if isinstance(roof, dict):
+        for kname, entry in (roof.get("kernels") or {}).items():
+            if isinstance(entry, dict):
+                g = _num(entry.get("gbps"))
+                if g is not None:
+                    out[f"roofline.{kname}.gbps"] = (g, "higher")
     clu = detail.get("cluster")
     if isinstance(clu, dict):
         servers = clu.get("servers")

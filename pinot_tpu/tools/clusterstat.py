@@ -1,0 +1,93 @@
+"""Cluster temperature CLI: ``python -m pinot_tpu.tools.clusterstat URL``.
+
+Renders the controller's segment-temperature aggregation (ISSUE 11 —
+``GET /tables/{t}/heat``, fed by the servers' heartbeat-piggybacked
+heat snapshots): per table, the hottest segments with their decayed
+access/bytes rates, lifetime totals, and reporting-instance counts —
+the operator's view of what ROADMAP 3's tier lifecycle would promote
+or demote next.
+
+Options:
+    --table T      one table (default: every table the controller lists)
+    --top N        segments to print per table (default 10)
+    --user u:p     basic auth for an ACL'd controller
+    --json         machine-readable output (one dict)
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(base_url: str, path: str, user: str = None) -> dict:
+    req = urllib.request.Request(base_url.rstrip("/") + path)
+    if user:
+        token = base64.b64encode(user.encode()).decode()
+        req.add_header("Authorization", f"Basic {token}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def gather(base_url: str, table: str = None, user: str = None) -> dict:
+    """{table: heat dict} from the controller REST."""
+    if table:
+        tables = [table]
+    else:
+        tables = _get(base_url, "/tables", user).get("tables", [])
+    return {t: _get(base_url, f"/tables/{t}/heat", user) for t in tables}
+
+
+def render(heat_by_table: dict, top: int = 10, now: float = None) -> str:
+    now = time.time() if now is None else now
+    lines = []
+    for table, heat in sorted(heat_by_table.items()):
+        segs = heat.get("segments") or {}
+        lines.append(
+            f"table {table}: {len(segs)} segment(s) reporting heat "
+            f"across {heat.get('instancesReporting', 0)} instance(s)")
+        for name, rec in list(segs.items())[:max(1, top)]:
+            last = rec.get("lastAccessTs") or 0
+            ago = f"{max(0.0, now - last):.0f}s ago" if last else "never"
+            lines.append(
+                f"  {name}: rate={rec.get('rate')} "
+                f"bytesRate={rec.get('bytesRate')} "
+                f"accesses={rec.get('accesses')} bytes={rec.get('bytes')} "
+                f"replicas={rec.get('instances')} last={ago}")
+        if not segs:
+            lines.append("  (no heat reported yet — servers heartbeat "
+                         "their snapshots every few seconds)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pinot_tpu.tools.clusterstat",
+        description="segment-temperature view from a pinot-tpu controller")
+    ap.add_argument("controller", help="controller base URL "
+                                       "(e.g. http://127.0.0.1:9000)")
+    ap.add_argument("--table", default=None)
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--user", default=None, help="basic auth user:pass")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+    try:
+        heat = gather(args.controller, table=args.table, user=args.user)
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"cannot reach controller {args.controller}: {e}",
+              file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(heat, indent=2))
+    else:
+        print(render(heat, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,14 @@ PHASE_FULL_NAMES = {
     "server.compile": "compile",
     "server.trim": "reduce",
     "broker.reduce": "reduce",
+    # the broker's scatter wall (the span behind the broker.scatterMs
+    # timer) — previously missing, so the waterfall under-reported the
+    # broker's share of every distributed query (ISSUE 11 satellite)
+    "broker.scatter_gather": "scatter",
+    "broker.route": "route",
+    # embedded multistage execution (query2/runner.py run_local): the
+    # broker-local join/window stage
+    "stage2": "stage2",
 }
 PHASE_LAST_SEGMENTS = {
     "gather": "gather",
@@ -56,14 +64,28 @@ def _percentile(sorted_vals: list, q: float) -> float:
 
 
 def phase_breakdown(entry: dict) -> dict:
-    """Per-phase ms for one log entry, summed across its servers."""
+    """Per-phase ms for one log entry, summed across its servers.
+
+    traceInfo values are span lists for single-stage queries, but the
+    multistage path nests a whole per-leaf traceInfo DICT under each
+    ``leaf:<alias>`` key ({instance: [spans], "broker": [spans]}) —
+    recurse through dicts so join/window entries (and EXPLAIN ANALYZE on
+    them) sum the same waterfall instead of crashing on string keys."""
     out: dict = {}
-    info = entry.get("traceInfo") or {}
-    for spans in info.values():
-        for s in spans or ():
+
+    def _walk(spans_or_nested):
+        if isinstance(spans_or_nested, dict):
+            for v in spans_or_nested.values():
+                _walk(v)
+            return
+        for s in spans_or_nested or ():
+            if not isinstance(s, dict):
+                continue
             bucket = _phase_bucket(s.get("phase", ""))
             if bucket is not None:
                 out[bucket] = out.get(bucket, 0.0) + s["durationMs"]
+
+    _walk(entry.get("traceInfo") or {})
     return out
 
 
@@ -105,16 +127,26 @@ def summarize(entries: list, top: int = 5,
     if per_template:
         by_tpl: dict = {}
         for e in entries:
-            hit = (e.get("counters") or {}).get("partialsCacheHit")
+            counters = e.get("counters") or {}
             by_tpl.setdefault(e.get("template") or "?", []).append(
-                (e.get("timeUsedMs", 0.0), bool(hit)))
+                (e.get("timeUsedMs", 0.0),
+                 bool(counters.get("partialsCacheHit")),
+                 bool(counters.get("resultCacheHit"))))
         summary["templates"] = {
             t: {"queries": len(v),
-                "p50Ms": round(_percentile(sorted(x for x, _ in v), 0.5), 2),
+                "p50Ms": round(
+                    _percentile(sorted(x for x, _, _ in v), 0.5), 2),
                 # device partials-cache hit rate for this literal-free
                 # template — the repeat-dashboard-query signal the cache
                 # exists to serve
-                "cacheHitRate": round(sum(1 for _, h in v if h) / len(v), 3)}
+                "cacheHitRate": round(
+                    sum(1 for _, h, _ in v if h) / len(v), 3),
+                # broker result-cache hit rate (PR 10's resultCacheHit):
+                # hits answer with NO scatter at all, so a template whose
+                # latency looks great may simply be cache-hot — the two
+                # rates disambiguate (ISSUE 11 satellite)
+                "resultCacheHitRate": round(
+                    sum(1 for _, _, h in v if h) / len(v), 3)}
             for t, v in sorted(by_tpl.items())
         }
     slowest = sorted(entries, key=lambda e: e.get("timeUsedMs", 0.0),
@@ -180,7 +212,9 @@ def main(argv=None) -> int:
               f"p90={row['p90Ms']}ms")
     if "templates" in summary:
         for t, row in summary["templates"].items():
-            print(f"  template {t}: n={row['queries']} p50={row['p50Ms']}ms")
+            print(f"  template {t}: n={row['queries']} p50={row['p50Ms']}ms "
+                  f"partialsCache={row['cacheHitRate']:.1%} "
+                  f"resultCache={row['resultCacheHitRate']:.1%}")
     print(f"top {len(summary['slowest'])} slowest:")
     for e in summary["slowest"]:
         phases = " ".join(f"{k}={v}" for k, v in (e["phases"] or {}).items())

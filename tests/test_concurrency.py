@@ -34,6 +34,12 @@ def canonical(resp: dict) -> dict:
     out = dict(resp)
     out.pop("timeUsedMs", None)
     out.pop("partialsCacheHit", None)
+    # roofline accounting (ISSUE 11) is measurement, not results: kernel
+    # wall and modeled bytes differ run to run (cohort members attribute
+    # the shared kernel to the leader; cache hits move zero bytes)
+    for k in ("deviceBytesMoved", "deviceKernelMs", "deviceLinkMs",
+              "roofline"):
+        out.pop(k, None)
     return out
 
 
