@@ -2293,6 +2293,263 @@ def bench_cluster(n_queries: int = 160, threads: int = 8):
     return detail, violations
 
 
+def bench_tiering(n_segments: int = 16, rows: int = 120_000,
+                  iters: int = 12):
+    """detail.tiering: the tiered-lifecycle phase (ISSUE 12,
+    server/tiering.py). One server + broker over real gRPC serve a table
+    whose modeled (ColPlan-width) bytes are >=10x the device batch-cache
+    budget (env-scaled: the budget is set to table/12 so the ratio holds
+    on any box), under a zipf-skewed per-segment workload.
+
+    Gates (standalone: ``python -m bench --phase tiering`` exits 9, after
+    cluster=8):
+      - capacity: table_plan_bytes >= 10x the effective cache budget AND
+        device resident bytes stay within 1.5x budget after the workload
+        (peak RSS delta reported; loose 512MB backstop);
+      - lifecycle: the tick demotes the cold tail (hot set fits the
+        budget), a forced cold demotion serves an honest partial
+        (numSegmentsCold >= 1, partialResult) and CONVERGES to the full
+        answer once the touch-triggered hydration lands;
+      - parity: the full-table aggregate answers identically all-hot,
+        mixed hot/warm, and after the cold round trip (integer aggs —
+        exact);
+      - placement: a forced temperature flip through the tier-aware
+        replica-group rebalance moves ONLY the flipped segment (registry
+        simulation, 4 instances x R=2).
+
+    Reported: per-tier p50/p99 (hot = device batch, warm = lazy-mmap host
+    scan, cold = first-touch partial + hydration latency), tier counts,
+    TierManager counters."""
+    import resource
+    import shutil
+    import tempfile
+
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import (
+        ClusterRegistry,
+        InstanceInfo,
+        Role,
+        SegmentRecord,
+    )
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller, SegmentAssigner
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.server.tiering import Tier, segment_plan_bytes
+    from pinot_tpu.storage.creator import build_segment
+    from pinot_tpu.storage.segment import ImmutableSegment
+
+    detail: dict = {}
+    violations: list = []
+    base = tempfile.mkdtemp(prefix="pinot_tpu_tiering_")
+    rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    server = broker = None
+    try:
+        schema = Schema.build(
+            name="tiered",
+            dimensions=[("sk", DataType.INT), ("tag", DataType.STRING)],
+            metrics=[("v", DataType.INT)],
+        )
+        cfg = TableConfig(table_name="tiered")
+        rng = np.random.default_rng(17)
+        registry = ClusterRegistry()
+        controller = Controller(registry, os.path.join(base, "deep"))
+        controller.add_table(cfg, schema)
+        expected_total = 0
+        seg_names = []
+        plan_total = 0
+        dirs = []
+        t_build = time.time()
+        for i in range(n_segments):
+            cols = {
+                # sk is CONSTANT per segment: the broker's value pruner
+                # routes "WHERE sk = i" to exactly one segment, so the
+                # workload's skew reaches per-segment heat
+                "sk": np.full(rows, i, dtype=np.int32),
+                "tag": np.array([f"t{j}" for j in range(64)])[
+                    rng.integers(0, 64, rows)],
+                "v": rng.integers(0, 10_000, rows).astype(np.int32),
+            }
+            expected_total += int(cols["v"].sum())
+            d = os.path.join(base, f"up{i}")
+            build_segment(schema, cols, d, cfg, f"tiered_s{i}")
+            plan_total += segment_plan_bytes(ImmutableSegment(d))
+            dirs.append(d)
+            seg_names.append(f"tiered_s{i}")
+        detail["build_s"] = round(time.time() - t_build, 1)
+        # env-scaled capacity squeeze: the batch-cache budget is 1/12 of
+        # the table's modeled bytes — the acceptance "table >= 10x
+        # MAX_CACHED_BYTES" holds whatever the box
+        budget = max(1, plan_total // 12)
+        server = ServerInstance(
+            "srv_tiering", registry, os.path.join(base, "srv"),
+            tier_overrides={
+                "pinot.server.tier.enabled": True,
+                # ticks run explicitly below, not on the sync cadence
+                "pinot.server.tier.interval.ms": 3_600_000,
+                "pinot.server.tier.hot.bytes": budget,
+                "pinot.server.tier.hot.min.rate": 0.05,
+            })
+        dev = getattr(server.engine, "device", None)
+        if dev is not None:
+            dev.MAX_CACHED_BYTES = budget
+        server.start()
+        for d in dirs:
+            controller.upload_segment("tiered", d)
+        broker = Broker(registry, timeout_s=30.0)
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            tdm = server.engine.tables.get("tiered_OFFLINE")
+            if tdm is not None and len(tdm.segments) == n_segments:
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("segments never loaded")
+        detail["table_plan_bytes"] = plan_total
+        detail["cache_budget_bytes"] = budget
+        detail["table_over_budget"] = round(plan_total / budget, 1)
+        if plan_total < 10 * budget:
+            violations.append(
+                f"table {plan_total}B < 10x budget {budget}B")
+
+        def q_seg(i):
+            return broker.execute(
+                f"SELECT COUNT(*), SUM(v) FROM tiered WHERE sk = {i}")
+
+        full_sql = "SELECT COUNT(*), SUM(v) FROM tiered"
+        r_all_hot = broker.execute(full_sql)
+        if r_all_hot.get("exceptions"):
+            raise RuntimeError(f"baseline failed: {r_all_hot}")
+        rows_all_hot = r_all_hot["resultTable"]["rows"]
+        if rows_all_hot[0][1] != expected_total:
+            violations.append("all-hot SUM != expected")
+
+        # skewed workload: hammer a 3-segment hot set, touch the rest
+        # once — then tick so the lifecycle ranks and demotes
+        hot_set = [0, 1, 2]
+        for _ in range(4):
+            for i in hot_set:
+                q_seg(i)
+        for i in range(n_segments):
+            q_seg(i)
+        server.tiers.tick()
+        snap = server.tiers.snapshot().get("tiered_OFFLINE", {})
+        n_hot = sum(1 for t in snap.values() if t == Tier.HOT)
+        n_warm = sum(1 for t in snap.values() if t == Tier.WARM)
+        detail["tiers_after_tick"] = {"hot": n_hot, "warm": n_warm,
+                                      "cold": len(snap) - n_hot - n_warm}
+        if dev is not None and n_warm == 0:
+            violations.append(
+                "tick demoted nothing under a 12x-over-budget table")
+
+        # per-tier latency: hot (device batch resident) vs warm (lazy
+        # mmap host scan)
+        def p50_p99(fn):
+            lat = []
+            for _ in range(iters):
+                t = time.perf_counter()
+                r = fn()
+                lat.append(time.perf_counter() - t)
+                if r.get("exceptions"):
+                    raise RuntimeError(str(r["exceptions"]))
+            return (round(float(np.percentile(lat, 50)) * 1e3, 2),
+                    round(float(np.percentile(lat, 99)) * 1e3, 2))
+
+        hot_seg = next((int(n.rsplit("s", 1)[1]) for n, t in snap.items()
+                        if t == Tier.HOT), hot_set[0])
+        warm_seg = next((int(n.rsplit("s", 1)[1]) for n, t in snap.items()
+                         if t == Tier.WARM), n_segments - 1)
+        hot_p50, hot_p99 = p50_p99(lambda: q_seg(hot_seg))
+        warm_p50, warm_p99 = p50_p99(lambda: q_seg(warm_seg))
+        r_mixed = broker.execute(full_sql)
+        if r_mixed["resultTable"]["rows"] != rows_all_hot:
+            violations.append("mixed hot/warm parity violated")
+
+        # forced cold flip: demote, observe the honest partial, converge
+        cold_i = n_segments - 2
+        cold_name = f"tiered_s{cold_i}"
+        if not server.tiers.demote_to_cold("tiered_OFFLINE", cold_name):
+            violations.append("forced cold demotion refused")
+        t_cold = time.perf_counter()
+        r_cold = broker.execute(full_sql)
+        cold_first_ms = round((time.perf_counter() - t_cold) * 1e3, 2)
+        if not r_cold.get("numSegmentsCold"):
+            violations.append("cold query reported numSegmentsCold == 0")
+        if not r_cold.get("partialResult"):
+            violations.append("cold partial not flagged partialResult")
+        hydrated = server.tiers.wait_hydrated(
+            "tiered_OFFLINE", cold_name, 60)
+        hydrate_ms = round((time.perf_counter() - t_cold) * 1e3, 2)
+        if not hydrated:
+            violations.append("hydration never landed")
+        r_back = broker.execute(full_sql)
+        if r_back["resultTable"]["rows"] != rows_all_hot \
+                or r_back.get("numSegmentsCold"):
+            violations.append("post-hydration parity violated")
+        detail["per_tier"] = {
+            "hot": {"p50_ms": hot_p50, "p99_ms": hot_p99},
+            "warm": {"p50_ms": warm_p50, "p99_ms": warm_p99},
+            "cold": {"first_touch_ms": cold_first_ms,
+                     "hydrate_ms": hydrate_ms},
+        }
+        detail["tier_manager"] = server.tiers.stats()
+        detail["num_segments_cold_seen"] = int(
+            r_cold.get("numSegmentsCold", 0))
+
+        # bounded memory: device residency within 1.5x the budget; RSS
+        # delta is reported (loose backstop — the table is env-scaled
+        # small, so the real capacity claim is the residency bound)
+        if dev is not None:
+            resident = dev.resident_bytes()
+            detail["device_resident_bytes"] = int(resident)
+            if resident > budget * 1.5:
+                violations.append(
+                    f"device resident {resident}B > 1.5x budget {budget}B")
+        rss_delta_mb = (resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss - rss0) / 1024.0
+        detail["peak_rss_delta_mb"] = round(rss_delta_mb, 1)
+        if rss_delta_mb > 512:
+            violations.append(
+                f"peak RSS grew {rss_delta_mb:.0f}MB > 512MB backstop")
+
+        # tier-aware rebalance: a temperature flip moves ONLY the
+        # flipped segment (registry simulation, 4 instances x R=2)
+        sim = ClusterRegistry()
+        for j in range(4):
+            sim.register_instance(
+                InstanceInfo(f"sim{j}", Role.SERVER, grpc_port=7000 + j))
+        sim.add_table(TableConfig(table_name="sim", replication=2),
+                      schema, key="sim_OFFLINE")
+        for n in seg_names:
+            sim.add_segment(
+                SegmentRecord(name=n, table="sim_OFFLINE", n_docs=rows),
+                [])
+        assigner = SegmentAssigner(sim)
+        before = assigner.rebalance_replica_groups("sim_OFFLINE", 2)
+        flipped = seg_names[3]
+        after = assigner.rebalance_tiered(
+            "sim_OFFLINE", 2, {flipped: Tier.COLD})
+        moved = sorted(n for n in before
+                       if sorted(before[n]) != sorted(after.get(n, ())))
+        detail["rebalance_moved"] = moved
+        if moved != [flipped]:
+            violations.append(
+                f"temperature flip moved {moved}, expected [{flipped}]")
+        if len(after[flipped]) != 1 or after[flipped][0] not in before[flipped]:
+            violations.append("cold segment not trimmed to a current "
+                              "single replica")
+    finally:
+        try:
+            if broker is not None:
+                broker.close()
+            if server is not None:
+                server.stop()
+        finally:
+            shutil.rmtree(base, ignore_errors=True)
+    return detail, violations
+
+
 def bench_observability(n_queries: int = 24):
     """detail.observability: the flight-recorder phase (ISSUE 7). A
     2-server in-process cluster serves a device group-by; the phase runs
@@ -2639,12 +2896,21 @@ def main():
     ap.add_argument(
         "--phase",
         choices=("full", "faults", "observability", "join", "subrtt",
-                 "cluster"),
+                 "cluster", "tiering"),
         default="full",
         help="'faults' / 'observability' / 'join' / 'subrtt' / 'cluster' "
-             "run ONLY that phase (no dataset build) so CI can gate on "
-             "each standalone")
+             "/ 'tiering' run ONLY that phase (no dataset build) so CI "
+             "can gate on each standalone")
     args = ap.parse_args()
+    if args.phase == "tiering":
+        detail, violations = bench_tiering()
+        print(json.dumps({"metric": "tiering-phase standalone",
+                          "detail": {"tiering": detail}}))
+        if violations:
+            print(f"tiering gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(9)
+        return
     if args.phase == "cluster":
         detail, violations = bench_cluster()
         print(json.dumps({"metric": "cluster-phase standalone",
@@ -2744,6 +3010,7 @@ def main():
     # the multi-server scaling ladder self-guards on the core count (a
     # 2-core container runs the 1- and 2-server widths only)
     cluster_detail, cluster_violations = bench_cluster()
+    tiering_detail, tiering_violations = bench_tiering()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -2808,6 +3075,7 @@ def main():
                     "join": join_detail,
                     "subrtt": subrtt_detail,
                     "cluster": cluster_detail,
+                    "tiering": tiering_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -2885,6 +3153,10 @@ def main():
         print(f"cluster gate FAILED: {json.dumps(cluster_violations)}",
               file=sys.stderr)
         sys.exit(8)
+    if tiering_violations:
+        print(f"tiering gate FAILED: {json.dumps(tiering_violations)}",
+              file=sys.stderr)
+        sys.exit(9)
 
 
 if __name__ == "__main__":

@@ -1149,7 +1149,9 @@ class Broker:
 
         counters = {"numDocsScanned": 0, "numSegmentsQueried": 0,
                     "numServersQueried": 0, "numServersResponded": 0,
-                    "numRetries": 0, "numHedges": 0, "totalDocs": 0}
+                    "numRetries": 0, "numHedges": 0, "totalDocs": 0,
+                    "numSegmentsCold": 0}
+        leaf_partial = False
         trace_info: dict = {}
         table_rows = {}
         leaf_rows: dict = {}       # alias -> stage-1 row count (ANALYZE)
@@ -1191,6 +1193,11 @@ class Broker:
                 if r.get("partialResult"):
                     resp["partialResult"] = True
                 return self._log_query(sql, plan, resp, t0)
+            # a cold-tier leaf partial has NO exception (honest rows +
+            # numSegmentsCold) — the join result built on it is partial
+            # too, and must say so
+            if r.get("partialResult"):
+                leaf_partial = True
             for k in counters:
                 counters[k] += int(r.get(k) or 0)
             rows = r["resultTable"]["rows"]
@@ -1224,6 +1231,7 @@ class Broker:
         resp.update(counters)
         resp.update({
             "exceptions": [],
+            "partialResult": leaf_partial,
             "requestId": f"{self.broker_id}_{next(self._request_id)}",
             "numStages": meta["numStages"],
             "numJoinedRows": meta["numJoinedRows"],
@@ -1929,7 +1937,11 @@ class Broker:
         resp.update(
             {
                 "exceptions": exceptions,
-                "partialResult": bool(exceptions),
+                # a cold-tier segment answered as an in-flight partial:
+                # the rows are honest-but-incomplete, so the response is
+                # partial (which also keeps it OUT of the result cache)
+                "partialResult": bool(exceptions)
+                or stats.num_segments_cold > 0,
                 # queried counts every instance the broker dispatched to
                 # (primary fan-out + retries + hedges); responded counts
                 # the instances whose answers the reduce actually used
@@ -1948,6 +1960,10 @@ class Broker:
                 "numSegmentsPrunedByValue": num_pruned_value,
                 "numSegmentsPrunedByServer": stats.num_segments_pruned,
                 "numBlocksPruned": stats.num_blocks_pruned,
+                # cold-tier segments served as honest in-flight partials
+                # while their deep-store hydration proceeds (ISSUE 12) —
+                # non-zero means a repeat of this query will cover more
+                "numSegmentsCold": stats.num_segments_cold,
                 "numSegmentsProcessed": stats.num_segments_processed,
                 "numSegmentsMatched": stats.num_segments_matched,
                 "totalDocs": stats.total_docs,

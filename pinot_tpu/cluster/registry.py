@@ -43,6 +43,39 @@ class Role:
     MINION = "MINION"
 
 
+class UnresolvableSegmentLocation(ValueError):
+    """``SegmentRecord.location`` names a URI scheme no registered
+    PinotFS plugin resolves (ISSUE 12 satellite): raised at
+    ``add_segment`` time so a bad deep-store URI fails at registration —
+    not at the first cold-tier download, hours later on a different
+    host."""
+
+
+def _validate_location(location: str) -> None:
+    """Scheme-resolvability check against the PinotFS plugin registry.
+    Bare paths and ``file://`` always resolve (LocalFS is built in);
+    anything else must have a registered ``fs`` factory. The registry
+    lookup is a lock + dict probe after the one-time plugin bootstrap,
+    so this is cheap enough for the ingest-path add_segment callers."""
+    if not location:
+        return  # consuming segments register location-less
+    from urllib.parse import urlparse
+
+    scheme = urlparse(location).scheme
+    if scheme in ("", "file") or "://" not in location:
+        # absolute/relative paths (a lone drive-letter-style colon parses
+        # as a scheme but is still a path) and the built-in file scheme
+        return
+    from pinot_tpu.common.plugins import plugin_registry
+
+    try:
+        plugin_registry.load("fs", scheme)
+    except KeyError as e:
+        raise UnresolvableSegmentLocation(
+            f"segment location {location!r}: no PinotFS plugin registered "
+            f"for scheme {scheme!r} ({e})") from None
+
+
 class SegmentState:
     ONLINE = "ONLINE"
     CONSUMING = "CONSUMING"
@@ -71,6 +104,11 @@ class InstanceInfo:
     # table) — the controller aggregates it behind /tables/{t}/heat,
     # the input ROADMAP 3's tier promotion/demotion will consume
     heat: dict = dataclasses.field(default_factory=dict)
+    # per-segment tier map (ISSUE 12, server/tiering.py TierManager
+    # .snapshot(): {table: {segment: "hot"|"warm"|"cold"}}) — the
+    # controller's tier-aware replica-group assignment reads it
+    # (controller.py aggregate_tiers / rebalance_tiered)
+    tiers: dict = dataclasses.field(default_factory=dict)
 
     @property
     def endpoint(self) -> str:
@@ -211,13 +249,14 @@ class ClusterRegistry:
         self._tx(lambda s: s["instances"].__setitem__(info.instance_id, info))
 
     def heartbeat(self, instance_id: str, pressure: float = None,
-                  table_epochs: dict = None, heat: dict = None) -> None:
+                  table_epochs: dict = None, heat: dict = None,
+                  tiers: dict = None) -> None:
         """Liveness tick, optionally carrying the instance's current load
-        (scheduler pressure), per-table freshness epochs, and the
-        per-segment heat snapshot (ISSUE 11) — the passive half of the
-        broker's load/staleness view (the active half rides piggybacked
-        in every DataTable response) and the controller's temperature
-        aggregation input."""
+        (scheduler pressure), per-table freshness epochs, the per-segment
+        heat snapshot (ISSUE 11), and the per-segment tier map (ISSUE 12)
+        — the passive half of the broker's load/staleness view (the
+        active half rides piggybacked in every DataTable response) and
+        the controller's temperature/tier aggregation input."""
 
         def fn(s):
             info = s["instances"].get(instance_id)
@@ -229,6 +268,8 @@ class ClusterRegistry:
                     info.table_epochs = dict(table_epochs)
                 if heat is not None:
                     info.heat = dict(heat)
+                if tiers is not None:
+                    info.tiers = dict(tiers)
 
         self._tx(fn)
 
@@ -413,6 +454,9 @@ class ClusterRegistry:
         make the last publisher the only replica, silently dropping
         replication to 1 (the reference instead has the controller write
         the full ideal-state replica set once at commit)."""
+        # deep-store URI must resolve NOW (typed error), not at the first
+        # cold-tier download (ISSUE 12 satellite)
+        _validate_location(record.location)
         record.push_time_ms = record.push_time_ms or int(time.time() * 1000)
 
         def fn(s):

@@ -76,6 +76,43 @@ def aggregate_heat(registry: ClusterRegistry, table: str) -> dict:
     }
 
 
+_TIER_RANK = {"hot": 0, "warm": 1, "cold": 2}
+
+
+def aggregate_tiers(registry: ClusterRegistry, table: str) -> dict:
+    """Cluster-wide per-segment tier view for one table (ISSUE 12):
+    merges every server heartbeat's piggybacked tier map
+    (server/tiering.py TierManager.snapshot()) across instances and the
+    table's physical variants. A segment's cluster tier is the HOTTEST
+    any replica reports — one hot replica means the cluster still pays
+    (and benefits from) hot-tier serving, and the tier-aware assignment
+    must not strip it. The payload behind ``GET /tables/{t}/tiers`` and
+    ``tools/clusterstat.py --tiers``."""
+    candidates = {table, f"{table}_OFFLINE", f"{table}_REALTIME"}
+    segs: dict = {}
+    reporting = 0
+    for info in registry.instances(Role.SERVER):
+        tiers = getattr(info, "tiers", None) or {}
+        seen = False
+        for t in candidates:
+            per = tiers.get(t)
+            if not per:
+                continue
+            seen = True
+            for seg, tier in per.items():
+                ent = segs.setdefault(seg, {"tier": tier, "instances": {}})
+                ent["instances"][info.instance_id] = tier
+                if _TIER_RANK.get(tier, 1) < _TIER_RANK.get(ent["tier"], 1):
+                    ent["tier"] = tier
+        if seen:
+            reporting += 1
+    return {
+        "table": table,
+        "instancesReporting": reporting,
+        "segments": segs,
+    }
+
+
 def _column_stats_fields(meta) -> dict:
     """Per-column min/max from segment metadata, JSON-plain, for the
     SegmentRecord the broker prunes on (SegmentZKMetadata's column
@@ -243,19 +280,16 @@ class SegmentAssigner:
             groups[small].append(groups[big].pop())
         return groups
 
-    def rebalance_replica_groups(self, table: str, replication: int) -> dict:
-        """(Re)build groups + per-group segment placement; writes both the
-        group map and the assignment. Movement is minimal: membership
-        keeps survivors in place, and unpartitioned segments move only to
-        fix replication or to fill a joined server up to its fair share
-        (ceil(n_segments / group size)). Partitioned segments place
-        DETERMINISTICALLY by partition id — co-partitioned segments land
-        on the same member, so a partition-EQ query (which the broker
-        prunes with the same common/pruning.py algebra the server uses)
-        touches exactly one instance per group."""
+    def _plan_replica_group_assignment(self, table: str,
+                                       replication: int) -> tuple:
+        """Pure planning half of the replica-group rebalance: the
+        (groups, assignment) a rebalance WOULD write, computed without
+        touching the registry — tier-aware callers (rebalance_tiered)
+        post-process the plan and publish only real changes, so a
+        steady-state periodic pass never churns the routing generation."""
         groups = self.build_replica_groups(table, replication)
         if not groups:
-            return {}
+            return {}, {}
         records = self.registry.segments(table)
         current = self.registry.assignment(table)
         seg_names = sorted(set(records) | set(current))
@@ -289,8 +323,57 @@ class SegmentAssigner:
                 counts[pick] += 1
             for seg, pick in placed.items():
                 new.setdefault(seg, []).append(pick)
+        return groups, new
+
+    def rebalance_replica_groups(self, table: str, replication: int) -> dict:
+        """(Re)build groups + per-group segment placement; writes both the
+        group map and the assignment. Movement is minimal: membership
+        keeps survivors in place, and unpartitioned segments move only to
+        fix replication or to fill a joined server up to its fair share
+        (ceil(n_segments / group size)). Partitioned segments place
+        DETERMINISTICALLY by partition id — co-partitioned segments land
+        on the same member, so a partition-EQ query (which the broker
+        prunes with the same common/pruning.py algebra the server uses)
+        touches exactly one instance per group."""
+        groups, new = self._plan_replica_group_assignment(table, replication)
+        if not groups:
+            return {}
         self.registry.set_replica_groups(table, groups)
         self.registry.set_assignment(table, new)
+        return new
+
+    def rebalance_tiered(self, table: str, replication: int,
+                         tiers: dict) -> dict:
+        """Tier-aware replica-group assignment (ISSUE 12): hot/warm
+        segments keep the full R-way replica-group placement (device- and
+        host-backed serving capacity chases the hot set); COLD segments
+        trim to a SINGLE copy — the object store is their durability, so
+        extra replicas only burn disk and sync traffic. ``tiers`` maps
+        segment → tier (or → the aggregate_tiers per-segment dict).
+
+        Movement is minimal twice over: the underlying plan is PR-10's
+        sticky rebalance (unflipped segments keep their placement), a
+        cold segment keeps its first surviving current replica (the copy
+        already on disk somewhere), and NOTHING is published unless the
+        plan actually differs from the registry — a steady-state periodic
+        pass bumps no routing generation and blows no broker caches. A
+        temperature flip therefore moves exactly the flipped segments."""
+        groups, new = self._plan_replica_group_assignment(table, replication)
+        if not groups:
+            return {}
+        current = self.registry.assignment(table)
+        for seg, tinfo in tiers.items():
+            tier = tinfo.get("tier") if isinstance(tinfo, dict) else tinfo
+            if tier != "cold" or seg not in new:
+                continue
+            keep = [i for i in current.get(seg, ()) if i in new[seg]][:1] \
+                or new[seg][:1]
+            new[seg] = keep
+        if groups != self.registry.replica_groups(table):
+            self.registry.set_replica_groups(table, groups)
+        if {k: sorted(v) for k, v in new.items()} != \
+                {k: sorted(v) for k, v in current.items()}:
+            self.registry.set_assignment(table, new)
         return new
 
     def assign_with_groups(self, table: str, rec) -> Optional[list]:
@@ -350,6 +433,41 @@ class Controller:
         """Aggregated per-segment access temperature for ``table``
         (ISSUE 11) — the GET /tables/{t}/heat payload."""
         return aggregate_heat(self.registry, table)
+
+    def table_tiers(self, table: str) -> dict:
+        """Aggregated per-segment tier view for ``table`` (ISSUE 12) —
+        the GET /tables/{t}/tiers payload."""
+        return aggregate_tiers(self.registry, table)
+
+    def run_tier_rebalance(self) -> dict:
+        """Tier-aware placement pass (ISSUE 12): replica-group tables
+        whose servers report per-segment tiers re-place so COLD segments
+        hold a single copy and hot/warm segments keep full replication.
+        Publishes nothing when the plan matches the registry (see
+        rebalance_tiered), so running it every periodic tick is free in
+        the steady state. Returns {table: [segments whose replica set
+        changed]}."""
+        changed: dict = {}
+        for table in self.registry.tables():
+            if not self.is_lead_for(table):
+                continue  # another controller leads this table (HA)
+            if not self.registry.replica_groups(table):
+                continue  # tier-aware placement rides replica groups
+            tiers = aggregate_tiers(self.registry, table).get("segments", {})
+            if not tiers:
+                continue  # no server reports tiering for this table
+            cfg = self.registry.table_config(table)
+            if cfg is None:
+                continue
+            before = self.registry.assignment(table)
+            after = self.assigner.rebalance_tiered(
+                table, self._table_replication(cfg), tiers)
+            moved = sorted(
+                seg for seg in set(before) | set(after)
+                if sorted(before.get(seg, ())) != sorted(after.get(seg, ())))
+            if moved:
+                changed[table] = moved
+        return changed
 
     # ---- HA: lease-based leader election + lead-controller partitioning --
     # The reference runs N controllers with Helix leader election and
@@ -845,7 +963,8 @@ class Controller:
                 steps = [self.run_retention, self.run_realtime_repair,
                          self.run_dim_table_replication,
                          self.run_replica_group_repair,
-                         self.run_segment_relocation]
+                         self.run_segment_relocation,
+                         self.run_tier_rebalance]
                 if self._leads_global():
                     steps += [self.run_task_generation, self.run_task_repair]
                 for step in steps:

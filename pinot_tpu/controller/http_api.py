@@ -71,11 +71,34 @@ class ControllerHttpServer:
                             principal, tables)
                     self._send(200, {"tables": sorted(tables)})
                     return
-                heat_name = None
+                heat_name = tier_name = None
                 if self.path.startswith("/tables/") \
                         and self.path.rstrip("/").endswith("/heat"):
                     heat_name = self.path[len("/tables/"):].rstrip("/")
                     heat_name = heat_name[: -len("/heat")].strip("/")
+                if self.path.startswith("/tables/") \
+                        and self.path.rstrip("/").endswith("/tiers"):
+                    tier_name = self.path[len("/tables/"):].rstrip("/")
+                    tier_name = tier_name[: -len("/tiers")].strip("/")
+                if tier_name:
+                    # GET /tables/{t}/tiers (ISSUE 12): per-segment tier
+                    # map aggregated from the servers' heartbeat tier
+                    # snapshots — what the tier-aware assignment places
+                    # by and clusterstat --tiers renders. Same non-empty-
+                    # segment rule as /heat (a table literally named
+                    # "tiers" keeps its metadata route).
+                    if outer._access is not None and \
+                            not outer._access.allows(principal, tier_name):
+                        self._send(403, {"error": f"Permission denied on "
+                                                  f"table {tier_name!r}"})
+                        return
+                    from pinot_tpu.controller.controller import (
+                        aggregate_tiers,
+                    )
+
+                    self._send(200,
+                               aggregate_tiers(outer.registry, tier_name))
+                    return
                 if heat_name:
                     # GET /tables/{t}/heat (ISSUE 11): cluster-aggregated
                     # per-segment access temperature from the servers'

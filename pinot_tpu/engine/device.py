@@ -100,9 +100,16 @@ def segment_device_eligible(seg) -> bool:
     this check (immutable, mask None while clean) and join the batch LRU +
     in-flight refcounting like any sealed segment — an upsert invalidation
     inside a block flips its mask non-None, failing this check back to the
-    host path."""
+    host path.
+
+    Tiering (ISSUE 12, server/tiering.py): segments demoted below the
+    hot tier route to the host too — warm segments scan their lazily
+    mmap'd planes without ever occupying HBM, and cold placeholders are
+    split out by the engine before this check matters. Segments without
+    a tier attribute (every pre-tiering caller) are hot."""
     return not getattr(seg, "is_mutable", False) and \
-        getattr(seg, "valid_docs_mask", None) is None
+        getattr(seg, "valid_docs_mask", None) is None and \
+        (getattr(seg, "tier", None) or "hot") == "hot"
 
 
 # ---------------------------------------------------------------------------
@@ -1366,6 +1373,17 @@ class DeviceExecutor:
         with self._lock:
             self._pipeline_failures.clear()
             self._quarantined.clear()
+
+    def evict_segment_dir(self, seg_dir: str) -> int:
+        """Evict every cached batch whose key contains ``seg_dir`` — the
+        tier-demotion hook (server/tiering.py): a segment leaving the hot
+        tier must free its HBM blocks NOW, not at LRU depth. Batches a
+        dispatched launch still pins defer to _release_launch via the
+        poisoned set, exactly like the device-failure eviction path.
+        Returns the number of batches dropped immediately."""
+        with self._lock:
+            keys = [k for k in self._batches if seg_dir in k]
+        return sum(1 for k in keys if self._evict_batch(k))
 
     def _evict_batch(self, key) -> bool:
         """Drop the implicated BatchContext after a device failure so a

@@ -114,6 +114,22 @@ class TableDataManager:
             self.generation += 1
             self._doomed.pop(seg.name, None)  # re-add wins over unload
 
+    def replace_if_idle(self, name: str, seg) -> bool:
+        """Atomically swap the hosted object for ``name`` when NO query
+        holds a reference (tier transitions, server/tiering.py): an
+        in-flight scan must never lose its mmaps mid-query, so a held
+        reference refuses the swap (False — the caller retries next
+        tick). The doomed map is untouched: a swap is not an unload."""
+        with self._lock:
+            if name not in self.segments or self._refs.get(name, 0) > 0:
+                return False
+            if self.host_name is not None \
+                    and getattr(seg, "host_name", None) is None:
+                seg.host_name = self.host_name
+            self.segments[name] = seg
+            self.generation += 1
+            return True
+
     def remove_segment(self, name: str) -> None:
         with self._lock:
             seg = self.segments.pop(name, None)
@@ -224,6 +240,9 @@ class QueryEngine:
                 "numSegmentsMatched": stats.num_segments_matched,
                 "numSegmentsPrunedByServer": stats.num_segments_pruned,
                 "numBlocksPruned": stats.num_blocks_pruned,
+                # cold-tier segments that answered as in-flight partials
+                # while their deep-store download proceeds (ISSUE 12)
+                "numSegmentsCold": stats.num_segments_cold,
                 "numGroupsLimitReached": stats.num_groups_limit_reached,
                 "partialsCacheHit": stats.partials_cache_hit,
                 "totalDocs": stats.total_docs,
@@ -298,6 +317,16 @@ class QueryEngine:
         QueryTimeout (releasing every still-pinned in-flight launch)
         instead of finishing work the client already abandoned.
 
+        TIER SPLIT (ISSUE 12, server/tiering.py): cold segments
+        (``is_cold`` placeholders whose planes live only in the deep
+        store) are split out FIRST — each counts as ``numSegmentsCold``
+        in the merged stats and its ``touch()`` enqueues an asynchronous
+        hydration, so the query returns an honest in-flight partial
+        instead of blocking its scheduler slot on a download. Warm
+        segments fail ``segment_device_eligible`` and take the host
+        scan path over their lazily-mmap'd planes; hot segments ride
+        the device batch exactly as before.
+
         Everything CPU-bound runs here — pruning, star-tree/metadata fast
         paths, the device template build + NON-BLOCKING dispatch
         (DeviceExecutor.launch), and the host scan partials (which overlap
@@ -311,7 +340,16 @@ class QueryEngine:
         server can put the heavy host scan back under scheduler admission
         — the fetch phase itself runs slot-free by design, and without
         the gate a fallback storm would escape the concurrency cap."""
-        q = self._expand_star(q, segments[0])
+        all_segments = segments
+        cold_refs = [s for s in segments if getattr(s, "is_cold", False)]
+        if cold_refs:
+            segments = [s for s in segments
+                        if not getattr(s, "is_cold", False)]
+            for s in cold_refs:
+                touch = getattr(s, "touch", None)
+                if touch is not None:
+                    touch()  # async hydration; never blocks this query
+        q = self._expand_star(q, (segments or cold_refs)[0])
 
         from pinot_tpu.common.trace import span
         from pinot_tpu.engine.device import DeviceUnsupported, \
@@ -521,10 +559,22 @@ class QueryEngine:
                 ran = [s for s in ran if id(s) not in dropped]
             res.extend(host_results)
             if not res:
-                # everything pruned: empty result over first segment's schema
-                ran = [segments[0]]
-                res.append(self.host.execute_segment(
-                    _impossible(q), segments[0]))
+                if segments:
+                    # everything pruned: empty result over first segment's
+                    # schema
+                    ran = [segments[0]]
+                    res.append(self.host.execute_segment(
+                        _impossible(q), segments[0]))
+                else:
+                    # EVERY routed segment is cold: honest empty partial
+                    # shaped by the cold metadata's zero-doc view (its
+                    # stats zero out — the cold docs count below)
+                    ran = []
+                    empty = self.host.execute_segment(
+                        _impossible(q), cold_refs[0].empty_view())
+                    empty.stats.num_segments_processed = 0
+                    empty.stats.num_segments_queried = 0
+                    res.append(empty)
 
             with span("merge", tracer):
                 merged = merge_intermediates(q, res)
@@ -538,11 +588,15 @@ class QueryEngine:
             # device partials carry their own launch-level pruned counts
             # (alive-masked batch members); add the segments dropped here
             merged.stats.num_segments_pruned += pruned + len(fallback_pruned)
-            merged.stats.num_segments_queried = len(segments)
+            merged.stats.num_segments_queried = len(all_segments)
+            # cold segments answered nothing this execution: the partial
+            # is honest about it (numSegmentsCold) and their docs still
+            # count toward totalDocs below like any unexecuted segment
+            merged.stats.num_segments_cold += len(cold_refs)
             # pruned segments still count toward totalDocs (reference
             # semantics)
             executed_ids = {id(s) for s in ran}
-            for s in segments:
+            for s in all_segments:
                 if id(s) not in executed_ids:
                     merged.stats.total_docs += s.n_docs
             return merged

@@ -29,6 +29,11 @@ class ExecutionStats:
     # zone-map blocks the device block-skip path never gathered
     # (engine/device.py; 0 when the dense path ran or pruning was off)
     num_blocks_pruned: int = 0
+    # cold-tier segments (ISSUE 12, server/tiering.py) this execution
+    # routed but could not scan: their planes live only in the deep
+    # store, the touch scheduled an async hydration, and the result is
+    # an honest in-flight partial (numSegmentsCold in responses)
+    num_segments_cold: int = 0
     total_docs: int = 0
     time_used_ms: float = 0.0
     # per-query resource accounting (reference: DataTable V3 metadata
@@ -71,6 +76,7 @@ class ExecutionStats:
         self.num_segments_matched += other.num_segments_matched
         self.num_segments_pruned += other.num_segments_pruned
         self.num_blocks_pruned += other.num_blocks_pruned
+        self.num_segments_cold += other.num_segments_cold
         self.total_docs += other.total_docs
         self.thread_cpu_time_ns += other.thread_cpu_time_ns
         self.scheduler_wait_ms += other.scheduler_wait_ms

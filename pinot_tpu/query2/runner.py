@@ -205,6 +205,17 @@ def scan_local_rows(engine, table: str, filter_expr: Optional[Expression],
         parts: dict[str, list] = {c: [] for c in need_cols}
         total = 0
         for seg in segments:
+            if getattr(seg, "is_cold", False):
+                # cold tier (server/tiering.py): planes live only in the
+                # deep store — honest in-flight partial, the touch
+                # schedules the async hydration
+                stats.num_segments_queried += 1
+                stats.num_segments_cold += 1
+                stats.total_docs += seg.n_docs
+                touch = getattr(seg, "touch", None)
+                if touch is not None:
+                    touch()
+                continue
             ev = SegmentEvaluator(
                 seg, lookup_resolver=getattr(engine.host, "lookup_resolver",
                                              None))
@@ -880,6 +891,10 @@ def execute_multistage(engine, stmt, t0: Optional[float] = None) -> dict:
         "numSegmentsMatched": stats.num_segments_matched,
         "numSegmentsPrunedByServer": stats.num_segments_pruned,
         "numBlocksPruned": stats.num_blocks_pruned,
+        "numSegmentsCold": stats.num_segments_cold,
+        # cold leaves answered honestly-partial rows: the joined result
+        # is partial too (matches the broker multistage path)
+        "partialResult": stats.num_segments_cold > 0,
         "numGroupsLimitReached": stats.num_groups_limit_reached,
         "totalDocs": stats.total_docs,
         "numStages": meta["numStages"],

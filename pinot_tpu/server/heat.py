@@ -77,31 +77,49 @@ class SegmentHeatTracker:
         with self._lock:
             self._entries.clear()
 
-    def snapshot(self, top_per_table: int = 32,
+    def iter_all(self, now: Optional[float] = None):
+        """Full-iteration export (ISSUE 12): yields ``(table, segment,
+        record)`` for EVERY tracked entry with decay applied as of
+        ``now`` — the TierManager's demotion input. ``snapshot``'s
+        top-N cap exists for the bounded heartbeat payload; demotion
+        decisions need exactly the cold tail it drops (a table with >32
+        segments would otherwise never see its coldest ones ranked)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            items = [(t, s, list(e)) for (t, s), e in self._entries.items()]
+        for t, s, (rate, brate, acc, byt, last) in items:
+            dt = now - last
+            yield t, s, {
+                "rate": self._decay(rate, dt),
+                "bytesRate": self._decay(brate, dt),
+                "accesses": acc,
+                "bytes": byt,
+                "lastAccessTs": last,
+            }
+
+    def snapshot(self, top_per_table: Optional[int] = 32,
                  now: Optional[float] = None) -> dict:
         """{table: {segment: {...}}} with decay applied as of ``now``,
         capped at the ``top_per_table`` hottest segments per table (the
         heartbeat payload must stay bounded at million-segment scale —
         cold segments are exactly the ones whose absence means "cold").
+        ``top_per_table=None`` disables the cap (the full-export form for
+        in-process consumers; heartbeats keep the capped default).
 
         ``rate`` / ``bytesRate`` are decayed half-life accumulators, NOT
         per-second rates: comparable across segments under one half
         life, which is all the promotion policy ranks on."""
         now = time.time() if now is None else now
-        with self._lock:
-            items = [(t, s, list(e)) for (t, s), e in self._entries.items()]
         per_table: dict = {}
-        for t, s, (rate, brate, acc, byt, last) in items:
-            dt = now - last
-            per_table.setdefault(t, {})[s] = {
-                "rate": round(self._decay(rate, dt), 4),
-                "bytesRate": round(self._decay(brate, dt), 1),
-                "accesses": acc,
-                "bytes": byt,
-                "lastAccessTs": round(last, 3),
-            }
+        for t, s, rec in self.iter_all(now=now):
+            rec["rate"] = round(rec["rate"], 4)
+            rec["bytesRate"] = round(rec["bytesRate"], 1)
+            rec["lastAccessTs"] = round(rec["lastAccessTs"], 3)
+            per_table.setdefault(t, {})[s] = rec
         out = {}
         for t, segs in per_table.items():
             ranked = sorted(segs.items(), key=lambda kv: -kv[1]["rate"])
-            out[t] = dict(ranked[:max(1, top_per_table)])
+            if top_per_table is not None:
+                ranked = ranked[:max(1, top_per_table)]
+            out[t] = dict(ranked)
         return out

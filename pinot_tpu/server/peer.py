@@ -35,7 +35,10 @@ def serve_segment_tar(server, request: bytes):
     acquired = tdm.acquire()
     try:
         seg = next((s for s in acquired if s.name == name), None)
-        if seg is None or getattr(seg, "is_mutable", False):
+        if seg is None or getattr(seg, "is_mutable", False) \
+                or getattr(seg, "is_cold", False):
+            # a cold-tier placeholder has no plane files to serve — a
+            # peer must fall through to a replica that still holds them
             raise KeyError(f"segment {name!r} not served here")
         # spool to a temp FILE, not RAM: a multi-GB segment tar held on
         # heap while also serving queries is an OOM hazard exactly when

@@ -85,7 +85,8 @@ class ServerInstance:
                  sync_interval_s: float = 0.2, device_executor="auto",
                  max_concurrent_queries: int = 8, max_queued_queries: int = 32,
                  group_trim_size: int = 5000, scheduler_name: str = None,
-                 tls="auto", tags=(), compile_concurrency: int = None):
+                 tls="auto", tags=(), compile_concurrency: int = None,
+                 tier_overrides: dict = None):
         self.instance_id = instance_id
         self.registry = registry
         self.data_dir = data_dir
@@ -149,6 +150,15 @@ class ServerInstance:
                 "pinot.server.heat.max.segments", 8192)))
         self.heat_top_per_table = int(conf.get_float(
             "pinot.server.heat.heartbeat.top.segments", 32))
+        # tiered segment lifecycle (ISSUE 12, server/tiering.py): the
+        # TierManager consumes the heat tracker's UNCAPPED iter_all plus
+        # the device batch hit/miss counters and drives hot/warm/cold
+        # transitions from the sync loop; opt-in
+        # (pinot.server.tier.enabled) so tier-less deployments keep the
+        # all-hot behavior byte-for-byte
+        from pinot_tpu.server.tiering import TierManager
+
+        self.tiers = TierManager(self, overrides=tier_overrides)
         self._last_serving = None  # last published ExternalView payload
         self._shutting_down = False
         self._inflight_queries = 0
@@ -190,6 +200,19 @@ class ServerInstance:
         self._register_gauge("heatTrackedSegments",
                              lambda: self.heat.size())
         self._register_gauge("hbmPeakGbps", _hbm_peak_if_probed)
+        if self.tiers.enabled:
+            # tier lifecycle visibility (registered only on tiering
+            # servers — same no-churn rule as the result-cache gauges)
+            self._register_gauge(
+                "tierColdSegments",
+                (lambda _t=self.tiers: _t.stats()["cold_segments"]))
+            self._register_gauge(
+                "tierHydrations",
+                (lambda _t=self.tiers: _t.hydrations))
+            self._register_gauge(
+                "tierDemotions",
+                (lambda _t=self.tiers: _t.demotions_warm
+                 + _t.demotions_cold))
         # HBM / batch-LRU accounting (DeviceExecutor.hbm_stats): resident
         # bytes, cache traffic, and bytes the width planning saved — the
         # operational view of ISSUE 5's narrowing (a shrinking
@@ -292,6 +315,7 @@ class ServerInstance:
         self._registered_gauges = []
         if self._sync_thread is not None:
             self._sync_thread.join(5)
+        self.tiers.stop()
         for mgr in self._realtime_managers.values():
             mgr.stop(commit_remaining=False)
         self.transport.stop()
@@ -676,12 +700,23 @@ class ServerInstance:
             budget = q.offset + q.limit
             produced = 0
             pruned = 0
+            cold = 0
             unexecuted_docs = 0  # pruned/budget-skipped: count toward totalDocs
             remaining = list(segments)
             while remaining:
                 if deadline is not None:
                     deadline.check("streaming segment scan")
                 seg = remaining.pop(0)
+                if getattr(seg, "is_cold", False):
+                    # cold tier (ISSUE 12): honest in-flight partial —
+                    # the touch schedules the deep-store hydration, the
+                    # stream never blocks on a download
+                    cold += 1
+                    unexecuted_docs += seg.n_docs
+                    touch = getattr(seg, "touch", None)
+                    if touch is not None:
+                        touch()
+                    continue
                 if self.engine.pruner.prune(q, seg):
                     pruned += 1
                     unexecuted_docs += seg.n_docs
@@ -695,14 +730,23 @@ class ServerInstance:
             if not blocks:
                 from pinot_tpu.engine.engine import _impossible
 
-                blocks.append(self.engine.host.execute_segment(
-                    _impossible(q), segments[0]))
+                base = next((s for s in segments
+                             if not getattr(s, "is_cold", False)), None)
+                empty = self.engine.host.execute_segment(
+                    _impossible(q),
+                    base if base is not None
+                    else segments[0].empty_view())  # every segment cold
+                if base is None:
+                    empty.stats.num_segments_processed = 0
+                    empty.stats.num_segments_queried = 0
+                blocks.append(empty)
             # same stats contract as execute_segments: every requested
             # segment counts toward numSegmentsQueried and totalDocs, even
             # when pruning or the row budget skipped its execution
             last = blocks[-1].stats
             last.num_segments_queried = len(segments)
             last.num_segments_pruned = pruned
+            last.num_segments_cold = cold
             last.total_docs += unexecuted_docs + sum(
                 s.n_docs for s in remaining)
             last.server_pressure = self.scheduler.pressure()
@@ -780,8 +824,16 @@ class ServerInstance:
                         # hottest-N per table so the payload stays
                         # bounded at million-segment scale
                         heat=self.heat.snapshot(
-                            top_per_table=self.heat_top_per_table))
+                            top_per_table=self.heat_top_per_table),
+                        # per-segment tier map (ISSUE 12): the
+                        # controller's tier-aware replica-group
+                        # assignment reads it
+                        tiers=(self.tiers.snapshot()
+                               if self.tiers.enabled else None))
                     last_hb = now
+                # tier lifecycle pass (interval-gated internally): heat
+                # ranking, hot-budget admission, cold demotion
+                self.tiers.maybe_tick(now)
             except Exception:
                 log.exception("segment sync failed")
             self._stop.wait(self.sync_interval_s)

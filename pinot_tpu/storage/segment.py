@@ -163,6 +163,13 @@ class ImmutableSegment:
     # manager (realtime/upsert.py); None for non-upsert tables
     valid_docs_mask = None
 
+    # plane-load observation seam (ISSUE 12): called with the plane file
+    # name the FIRST time it is actually mapped/decoded. The warm tier's
+    # LazySegmentView (server/tiering.py) counts through it to assert the
+    # mapFile contract — a query touching 2 of 20 columns maps only those
+    # planes. None (the default) costs one attribute read per cold load.
+    plane_load_hook = None
+
     def __init__(self, segment_dir: str):
         self.dir = segment_dir
         with open(os.path.join(segment_dir, METADATA_FILE)) as f:
@@ -190,11 +197,17 @@ class ImmutableSegment:
     def _path(self, fname: str) -> str:
         return os.path.join(self.dir, fname)
 
+    def _note_plane(self, fname: str) -> None:
+        h = self.plane_load_hook
+        if h is not None:
+            h(fname)
+
     # ---- index readers --------------------------------------------------
     def dictionary(self, col: str) -> Optional[Dictionary]:
         if col not in self._dict_cache:
             meta = self.column_metadata(col)
             if meta.has_dictionary:
+                self._note_plane(f"{col}.dict.npy")
                 self._dict_cache[col] = Dictionary.load(self._path(f"{col}.dict.npy"))
             else:
                 self._dict_cache[col] = None
@@ -210,6 +223,7 @@ class ImmutableSegment:
             if meta.compression is not None:
                 from pinot_tpu import native
 
+                self._note_plane(f"{col}.fwdz.bin")
                 blob = np.fromfile(self._path(f"{col}.fwdz.bin"),
                                    dtype=np.uint8)
                 offs = np.load(self._path(f"{col}.fwdz.off.npy"),
@@ -223,6 +237,7 @@ class ImmutableSegment:
             elif meta.packed_bits is not None:
                 from pinot_tpu import native
 
+                self._note_plane(f"{col}.fwdpacked.bin")
                 buf = np.fromfile(self._path(f"{col}.fwdpacked.bin"),
                                   dtype=np.uint8)
                 n = (self.n_docs if meta.single_value
@@ -237,6 +252,7 @@ class ImmutableSegment:
                     )
                 self._fwd_cache[col] = native.unpack(buf, n, meta.packed_bits)
             else:
+                self._note_plane(f"{col}.fwd.npy")
                 self._fwd_cache[col] = np.load(
                     self._path(f"{col}.fwd.npy"), mmap_mode="r",
                     allow_pickle=False,
@@ -246,12 +262,14 @@ class ImmutableSegment:
     def mv_offsets(self, col: str) -> Optional[np.ndarray]:
         if self.column_metadata(col).single_value:
             return None
+        self._note_plane(f"{col}.mvoff.npy")
         return np.load(self._path(f"{col}.mvoff.npy"), mmap_mode="r", allow_pickle=False)
 
     def inverted(self, col: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """(concat_sorted_doc_ids, offsets[card+1]) or None."""
         if not self.column_metadata(col).has_inverted:
             return None
+        self._note_plane(f"{col}.inv.docs.npy")
         docs = np.load(self._path(f"{col}.inv.docs.npy"), mmap_mode="r", allow_pickle=False)
         off = np.load(self._path(f"{col}.inv.off.npy"), mmap_mode="r", allow_pickle=False)
         return docs, off
@@ -259,6 +277,7 @@ class ImmutableSegment:
     def bloom(self, col: str) -> Optional[np.ndarray]:
         if not self.column_metadata(col).has_bloom:
             return None
+        self._note_plane(f"{col}.bloom.npy")
         return np.load(self._path(f"{col}.bloom.npy"), mmap_mode="r", allow_pickle=False)
 
     def zone_map(self, col: str) -> Optional[np.ndarray]:
@@ -270,6 +289,7 @@ class ImmutableSegment:
         path = self._path(f"{col}.zmap.npy")
         if not os.path.isfile(path):
             return None
+        self._note_plane(f"{col}.zmap.npy")
         return np.load(path, mmap_mode="r", allow_pickle=False)
 
     def range_index(self, col: str) -> Optional[tuple[np.ndarray, np.ndarray]]:
@@ -281,6 +301,7 @@ class ImmutableSegment:
         docs_path = self._path(f"{col}.range.docs.npy")
         if not os.path.isfile(docs_path):
             return None
+        self._note_plane(f"{col}.range.docs.npy")
         docs = np.load(docs_path, mmap_mode="r", allow_pickle=False)
         vals = np.load(self._path(f"{col}.range.vals.npy"), mmap_mode="r",
                        allow_pickle=False)
@@ -343,6 +364,7 @@ class ImmutableSegment:
         (NullValueVectorReader analog; absent file == empty bitmap)."""
         if not self.column_metadata(col).has_null_vector:
             return None
+        self._note_plane(f"{col}.nullvec.npy")
         return np.load(self._path(f"{col}.nullvec.npy"), mmap_mode="r",
                        allow_pickle=False)
 

@@ -31,7 +31,11 @@ Compared metric families (direction-aware):
 - the per-kernel roofline (``roofline.kernels.*.gbps`` — higher is
   better — ISSUE 11's achieved-GB/s-vs-HBM-peak accounting), compared
   when both rounds carry a ``detail.roofline`` section (or the copy
-  nested under ``observability``).
+  nested under ``observability``),
+- the tiered-lifecycle phase (``tiering.per_tier.{hot,warm}.p50_ms`` +
+  ``tiering.cold.hydrate_ms`` — lower is better — and
+  ``tiering.peak_rss_delta_mb`` — lower is better — ISSUE 12), compared
+  only when BOTH rounds carry a ``detail.tiering`` section.
 """
 
 from __future__ import annotations
@@ -43,7 +47,7 @@ import sys
 # sections brace-matched out of a truncated driver-wrapper tail
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
-                  "cluster", "breakdown", "roofline")
+                  "cluster", "breakdown", "roofline", "tiering")
 
 
 def _brace_match(text: str, key: str):
@@ -191,6 +195,26 @@ def extract_metrics(detail: dict) -> dict:
             p50 = _num(rc.get("hit_p50_ms"))
             if p50 is not None:
                 out["cluster.result_cache.hit_p50_ms"] = (p50, "lower")
+    # tiered lifecycle (ISSUE 12): per-tier p50s, hydration latency, and
+    # the peak-RSS backstop — compared only when both rounds ran the phase
+    tier = detail.get("tiering")
+    if isinstance(tier, dict):
+        per_tier = tier.get("per_tier")
+        if isinstance(per_tier, dict):
+            for tname in ("hot", "warm"):
+                entry = per_tier.get(tname)
+                if isinstance(entry, dict):
+                    v = _num(entry.get("p50_ms"))
+                    if v is not None:
+                        out[f"tiering.{tname}.p50_ms"] = (v, "lower")
+            cold = per_tier.get("cold")
+            if isinstance(cold, dict):
+                v = _num(cold.get("hydrate_ms"))
+                if v is not None:
+                    out["tiering.cold.hydrate_ms"] = (v, "lower")
+        v = _num(tier.get("peak_rss_delta_mb"))
+        if v is not None:
+            out["tiering.peak_rss_delta_mb"] = (v, "lower")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of

@@ -10,6 +10,8 @@ or demote next.
 Options:
     --table T      one table (default: every table the controller lists)
     --top N        segments to print per table (default 10)
+    --tiers        also fetch /tables/{t}/tiers and print each segment's
+                   tier (hot/warm/cold, ISSUE 12) next to its heat
     --user u:p     basic auth for an ACL'd controller
     --json         machine-readable output (one dict)
 """
@@ -34,28 +36,49 @@ def _get(base_url: str, path: str, user: str = None) -> dict:
         return json.loads(resp.read().decode("utf-8"))
 
 
-def gather(base_url: str, table: str = None, user: str = None) -> dict:
-    """{table: heat dict} from the controller REST."""
+def gather(base_url: str, table: str = None, user: str = None,
+           tiers: bool = False) -> dict:
+    """{table: heat dict} from the controller REST; with ``tiers=True``
+    each heat dict also carries a ``tiers`` section
+    (``/tables/{t}/tiers``, ISSUE 12)."""
     if table:
         tables = [table]
     else:
         tables = _get(base_url, "/tables", user).get("tables", [])
-    return {t: _get(base_url, f"/tables/{t}/heat", user) for t in tables}
+    out = {}
+    for t in tables:
+        doc = _get(base_url, f"/tables/{t}/heat", user)
+        if tiers:
+            doc["tiers"] = _get(base_url, f"/tables/{t}/tiers", user)
+        out[t] = doc
+    return out
 
 
-def render(heat_by_table: dict, top: int = 10, now: float = None) -> str:
+def render(heat_by_table: dict, top: int = 10, now: float = None,
+           tiers: bool = False) -> str:
     now = time.time() if now is None else now
     lines = []
     for table, heat in sorted(heat_by_table.items()):
         segs = heat.get("segments") or {}
+        tier_segs = (heat.get("tiers") or {}).get("segments") or {}
         lines.append(
             f"table {table}: {len(segs)} segment(s) reporting heat "
             f"across {heat.get('instancesReporting', 0)} instance(s)")
-        for name, rec in list(segs.items())[:max(1, top)]:
+        names = list(segs)[:max(1, top)]
+        if tiers:
+            # tiered-but-cold segments fall out of the heat top-N by
+            # construction; list them too so the operator sees the
+            # lifecycle's other end
+            names += [n for n in tier_segs if n not in segs][:max(1, top)]
+        for name in names:
+            rec = segs.get(name, {})
             last = rec.get("lastAccessTs") or 0
             ago = f"{max(0.0, now - last):.0f}s ago" if last else "never"
+            tier_txt = ""
+            if tiers:
+                tier_txt = f"tier={tier_segs.get(name, {}).get('tier', '?')} "
             lines.append(
-                f"  {name}: rate={rec.get('rate')} "
+                f"  {name}: {tier_txt}rate={rec.get('rate')} "
                 f"bytesRate={rec.get('bytesRate')} "
                 f"accesses={rec.get('accesses')} bytes={rec.get('bytes')} "
                 f"replicas={rec.get('instances')} last={ago}")
@@ -73,11 +96,15 @@ def main(argv=None) -> int:
                                        "(e.g. http://127.0.0.1:9000)")
     ap.add_argument("--table", default=None)
     ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--tiers", action="store_true",
+                    help="show each segment's hot/warm/cold tier next to "
+                         "its heat (ISSUE 12 lifecycle view)")
     ap.add_argument("--user", default=None, help="basic auth user:pass")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     try:
-        heat = gather(args.controller, table=args.table, user=args.user)
+        heat = gather(args.controller, table=args.table, user=args.user,
+                      tiers=args.tiers)
     except (urllib.error.URLError, OSError, ValueError) as e:
         print(f"cannot reach controller {args.controller}: {e}",
               file=sys.stderr)
@@ -85,7 +112,7 @@ def main(argv=None) -> int:
     if args.as_json:
         print(json.dumps(heat, indent=2))
     else:
-        print(render(heat, top=args.top))
+        print(render(heat, top=args.top, tiers=args.tiers))
     return 0
 
 

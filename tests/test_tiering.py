@@ -1,0 +1,582 @@
+"""Tiered segment lifecycle (ISSUE 12, server/tiering.py).
+
+The contracts under test:
+
+1. WARM LAZINESS — a query touching 2 of 20 columns maps only those
+   planes (asserted through the plane-load hook counters), matching
+   ``PinotDataBuffer.mapFile`` semantics.
+2. TIER PARITY — hot == warm == host bit-exact, solo AND on the 8-dev
+   mesh, for sealed segments and alongside chunklet-promoted consuming
+   segments; cold segments answer honestly-partial and converge to the
+   full answer once hydrated.
+3. COLD LIFECYCLE — demotion evicts local planes (metadata stays, the
+   segment stays routable), ``numSegmentsCold`` surfaces in responses,
+   the touch-triggered hydration restores full coverage via the PinotFS
+   download (deadline-bounded, peer fallback).
+4. POLICY — heat-ranked hot admission charges NARROW (ColPlan-modeled)
+   bytes against the budget; idle+cold-rate segments demote to cold only
+   when a durable deep-store copy exists.
+5. SATELLITES — heat ``iter_all``/uncapped snapshot, typed
+   ``UnresolvableSegmentLocation`` at ``add_segment``, and the
+   controller's tier-aware replica-group rebalance moving ONLY
+   temperature-flipped segments.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.cluster.registry import (
+    ClusterRegistry,
+    InstanceInfo,
+    Role,
+    SegmentRecord,
+    UnresolvableSegmentLocation,
+)
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import TableConfig
+from pinot_tpu.controller.controller import (
+    Controller,
+    SegmentAssigner,
+    aggregate_tiers,
+)
+from pinot_tpu.engine.engine import QueryEngine
+from pinot_tpu.server.heat import SegmentHeatTracker
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.server.tiering import (
+    ColdSegmentRef,
+    LazySegmentView,
+    Tier,
+    segment_plan_bytes,
+)
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.storage.segment import ImmutableSegment
+
+ROWS = 4096
+
+
+def _build(base, n_segs=2, rows=ROWS, seed=7):
+    rng = np.random.default_rng(seed)
+    schema = Schema.build(
+        name="tiers",
+        dimensions=[("tag", DataType.STRING), ("mid", DataType.INT)],
+        metrics=[("m", DataType.INT), ("f", DataType.DOUBLE)],
+    )
+    cfg = TableConfig(table_name="tiers")
+    segs, all_cols = [], []
+    for i in range(n_segs):
+        cols = {
+            "tag": np.array(["a", "b", "c"])[rng.integers(0, 3, rows)],
+            "mid": rng.integers(0, 300, rows).astype(np.int32),
+            "m": rng.integers(0, 10_000, rows).astype(np.int32),
+            "f": np.round(rng.uniform(0, 100, rows), 3),
+        }
+        all_cols.append(cols)
+        d = str(base / f"s{i}")
+        build_segment(schema, cols, d, cfg, f"s{i}")
+        segs.append(ImmutableSegment(d))
+    return schema, cfg, segs, all_cols
+
+
+@pytest.fixture(scope="module")
+def table(tmp_path_factory):
+    return _build(tmp_path_factory.mktemp("tiering"))
+
+
+def _engine(segs, device="auto", table="tiers"):
+    eng = QueryEngine() if device == "auto" \
+        else QueryEngine(device_executor=device)
+    for s in segs:
+        eng.add_segment(table, s)
+    return eng
+
+
+PARITY_QUERIES = [
+    "SELECT COUNT(*), SUM(m), MIN(m), MAX(m) FROM tiers WHERE tag = 'b'",
+    "SELECT COUNT(*), AVG(m) FROM tiers WHERE mid IN (5, 250, 299)",
+    "SELECT tag, COUNT(*), SUM(m) FROM tiers GROUP BY tag ORDER BY tag",
+    "SELECT mid, COUNT(*), SUM(f) FROM tiers WHERE tag = 'c' "
+    "GROUP BY mid ORDER BY mid LIMIT 10",
+    "SELECT COUNT(*), DISTINCTCOUNT(tag) FROM tiers WHERE m > 2000",
+]
+
+
+def _rows_close(a, b):
+    """Row-set equality with float tolerance (device f32 partial sums vs
+    the host's f64 — the same comparison the narrow suite uses; integer
+    and string cells must match exactly)."""
+    if len(a) != len(b):
+        return False
+    for ra, rb in zip(a, b):
+        if len(ra) != len(rb):
+            return False
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) or isinstance(vb, float):
+                if not np.isclose(float(va), float(vb),
+                                  rtol=1e-5, atol=1e-6):
+                    return False
+            elif va != vb:
+                return False
+    return True
+
+
+class TestWarmLaziness:
+    def test_query_maps_only_touched_planes(self, tmp_path):
+        # 20 columns; a query touching 2 must map exactly those planes
+        rng = np.random.default_rng(3)
+        names = [f"c{i:02d}" for i in range(20)]
+        schema = Schema.build(
+            name="wide20", dimensions=[],
+            metrics=[(n, DataType.INT) for n in names])
+        cfg = TableConfig(table_name="wide20")
+        cols = {n: rng.integers(0, 1000, 2048).astype(np.int32)
+                for n in names}
+        d = str(tmp_path / "w")
+        build_segment(schema, cols, d, cfg, "w0")
+        view = LazySegmentView(d)
+        assert view.tier == Tier.WARM
+        assert view.plane_loads == 0  # construction maps NO planes
+        eng = _engine([view], device=None, table="wide20")
+        r = eng.execute("SELECT SUM(c03) FROM wide20 WHERE c11 > 0")
+        assert not r.get("exceptions"), r
+        touched = {f.split(".")[0] for f in view.planes_loaded}
+        assert touched <= {"c03", "c11"}, view.planes_loaded
+        assert {"c03", "c11"} & touched
+        # the other 18 columns were never mapped
+        assert not touched & (set(names) - {"c03", "c11"})
+
+    def test_release_planes_drops_caches(self, table):
+        _, _, segs, _ = table
+        view = LazySegmentView(segs[0].dir)
+        eng = _engine([view], device=None)
+        eng.execute("SELECT SUM(m) FROM tiers")
+        assert view._fwd_cache
+        view.release_planes()
+        assert not view._fwd_cache and not view._dict_cache
+        # still queryable after release (planes re-map on demand)
+        r = eng.execute("SELECT COUNT(*) FROM tiers")
+        assert r["resultTable"]["rows"][0][0] == ROWS
+
+    def test_plan_bytes_narrow_aware(self, table):
+        _, _, segs, _ = table
+        cost = segment_plan_bytes(segs[0])
+        # tag: card 3 -> 1B; mid: card<=300 -> 2B; m: range<2^16 -> 2B;
+        # f: device f32 -> 4B. The legacy logical widths would be 4+4+4+8.
+        assert cost == ROWS * (1 + 2 + 2 + 4)
+        wide = ROWS * (4 + 4 + 4 + 8)
+        assert cost * 2 < wide  # the narrow-aware charge admits >2x more
+
+
+class TestHeatFullIteration:
+    def test_iter_all_uncapped(self):
+        t = SegmentHeatTracker(half_life_s=60)
+        now = time.time()
+        for i in range(40):
+            t.note("tab", f"seg{i}", bytes_scanned=10, now=now - i)
+        capped = t.snapshot(now=now)
+        assert len(capped["tab"]) == 32  # heartbeat form stays bounded
+        full = t.snapshot(top_per_table=None, now=now)
+        assert len(full["tab"]) == 40
+        seen = {(tt, s) for tt, s, _ in t.iter_all(now=now)}
+        assert len(seen) == 40
+        # decayed view is consistent between the two exports
+        for tt, s, rec in t.iter_all(now=now):
+            assert rec["rate"] == pytest.approx(
+                full[tt][s]["rate"], abs=1e-3)
+
+
+class TestLocationValidation:
+    def test_unknown_scheme_typed_error(self):
+        reg = ClusterRegistry()
+        with pytest.raises(UnresolvableSegmentLocation):
+            reg.add_segment(SegmentRecord(
+                name="x", table="t", location="bogus://b/k"), [])
+
+    def test_known_and_bare_locations_pass(self, tmp_path):
+        reg = ClusterRegistry()
+        for loc in ("", str(tmp_path / "d"), f"file://{tmp_path}/d",
+                    "s3://bucket/seg", "gs://bucket/seg",
+                    "hdfs://nn:8020/seg"):
+            reg.add_segment(SegmentRecord(
+                name=f"x{hash(loc) & 0xffff}", table="t", location=loc), [])
+
+
+class TestTierParity:
+    @pytest.mark.parametrize("sql", PARITY_QUERIES)
+    def test_hot_equals_warm_equals_host(self, table, sql):
+        _, _, segs, _ = table
+        hot = _engine(segs)
+        warm = _engine([LazySegmentView(s.dir) for s in segs])
+        host = _engine(segs, device=None)
+        rh, rw, ro = hot.execute(sql), warm.execute(sql), host.execute(sql)
+        for r in (rh, rw, ro):
+            assert not r.get("exceptions"), r
+        # warm and host are both host scans: EXACT; hot (device) floats
+        # compare at the f32-partial tolerance like the narrow suite
+        assert rw["resultTable"]["rows"] == ro["resultTable"]["rows"]
+        assert _rows_close(rh["resultTable"]["rows"],
+                           ro["resultTable"]["rows"])
+
+    def test_mixed_hot_warm_batch(self, table):
+        # one hot + one warm segment of the SAME table: device batch for
+        # the hot one, host scan for the warm one, merged partials
+        _, _, segs, all_cols = table
+        mixed = _engine([segs[0], LazySegmentView(segs[1].dir)])
+        host = _engine(segs, device=None)
+        for sql in PARITY_QUERIES:
+            rm, ro = mixed.execute(sql), host.execute(sql)
+            assert _rows_close(rm["resultTable"]["rows"],
+                               ro["resultTable"]["rows"]), sql
+
+    def test_mesh_parity(self, table):
+        from pinot_tpu.engine.device import DeviceExecutor
+        from pinot_tpu.parallel.mesh import make_mesh
+
+        _, _, segs, _ = table
+        mesh_hot = _engine(segs, DeviceExecutor(mesh=make_mesh(8)))
+        mesh_mixed = _engine(
+            [segs[0], LazySegmentView(segs[1].dir)],
+            DeviceExecutor(mesh=make_mesh(8)))
+        host = _engine(segs, device=None)
+        for sql in PARITY_QUERIES[:3]:
+            r1 = mesh_hot.execute(sql)
+            r2 = mesh_mixed.execute(sql)
+            ro = host.execute(sql)
+            assert _rows_close(r1["resultTable"]["rows"],
+                               ro["resultTable"]["rows"]), sql
+            assert _rows_close(r2["resultTable"]["rows"],
+                               ro["resultTable"]["rows"]), sql
+
+    def test_warm_alongside_chunklet_promoted_consuming(self, table):
+        # a warm sealed segment + a consuming segment with promoted
+        # chunklets: the tier routing must not disturb the chunklet split
+        from pinot_tpu.common.table_config import ChunkletConfig
+        from pinot_tpu.storage.mutable import MutableSegment
+
+        schema = Schema.build(
+            name="tiers",
+            dimensions=[("tag", DataType.STRING),
+                        ("mid", DataType.INT)],
+            metrics=[("m", DataType.INT), ("f", DataType.DOUBLE)],
+        )
+        cfg = TableConfig(
+            table_name="tiers",
+            chunklets=ChunkletConfig(enabled=True, rows_per_chunklet=1024,
+                                     device_min_rows=0))
+        rng = np.random.default_rng(5)
+        mseg = MutableSegment(schema, "consuming0", cfg)
+        rows = [{"tag": ["a", "b", "c"][int(rng.integers(0, 3))],
+                 "mid": int(rng.integers(0, 300)),
+                 "m": int(rng.integers(0, 10_000)),
+                 "f": float(np.round(rng.uniform(0, 100), 3))}
+                for _ in range(3000)]
+        mseg.index_batch(rows)
+        mseg.chunklet_index.promote()
+        _, _, segs, _ = table
+        warm = LazySegmentView(segs[0].dir)
+        tiered = _engine([warm, mseg])
+        plain = _engine([segs[0], mseg], device=None)
+        for sql in PARITY_QUERIES[:3]:
+            rt, rp = tiered.execute(sql), plain.execute(sql)
+            assert _rows_close(rt["resultTable"]["rows"],
+                               rp["resultTable"]["rows"]), sql
+
+    def test_multistage_over_cold_segment(self, table, tmp_path):
+        # stage-1 leaf scans skip cold segments honestly too (query2)
+        schema_d = Schema.build(
+            name="dimt", dimensions=[("tag", DataType.STRING),
+                                     ("label", DataType.STRING)],
+            metrics=[])
+        cfg_d = TableConfig(table_name="dimt", is_dim_table=True)
+        dd = str(tmp_path / "dim")
+        build_segment(schema_d, {
+            "tag": np.array(["a", "b", "c"]),
+            "label": np.array(["A", "B", "C"])}, dd, cfg_d, "d0")
+        _, _, segs, _ = table
+        eng = QueryEngine()
+        eng.add_segment("tiers", segs[0])
+        eng.add_segment("tiers",
+                        ColdSegmentRef("tiers", segs[1].metadata,
+                                       segs[1].dir))
+        eng.add_segment("dimt", ImmutableSegment(dd))
+        eng.table("dimt").is_dim_table = True
+        r = eng.execute(
+            "SELECT d.label, SUM(t.m) FROM tiers t JOIN dimt d "
+            "ON t.tag = d.tag GROUP BY d.label ORDER BY d.label")
+        assert not r.get("exceptions"), r
+        assert r["numSegmentsCold"] == 1
+        # rows cover the one live segment only
+        warm_only = QueryEngine(device_executor=None)
+        warm_only.add_segment("tiers", segs[0])
+        warm_only.add_segment("dimt", ImmutableSegment(dd))
+        warm_only.table("dimt").is_dim_table = True
+        ref = warm_only.execute(
+            "SELECT d.label, SUM(t.m) FROM tiers t JOIN dimt d "
+            "ON t.tag = d.tag GROUP BY d.label ORDER BY d.label")
+        assert r["resultTable"]["rows"] == ref["resultTable"]["rows"]
+
+    def test_all_cold_honest_empty(self, table):
+        _, _, segs, _ = table
+        refs = [ColdSegmentRef("tiers", s.metadata, s.dir) for s in segs]
+        eng = _engine(refs)
+        r = eng.execute("SELECT COUNT(*), SUM(m) FROM tiers")
+        assert not r.get("exceptions"), r
+        assert r["numSegmentsCold"] == len(segs)
+        assert r["resultTable"]["rows"][0][0] == 0
+        assert r["totalDocs"] == sum(s.n_docs for s in segs)
+        # group-by + distinct shapes synthesize empty too
+        for sql in ("SELECT tag, COUNT(*) FROM tiers GROUP BY tag",
+                    "SELECT DISTINCT tag FROM tiers"):
+            r = eng.execute(sql)
+            assert not r.get("exceptions"), (sql, r)
+            assert r["resultTable"]["rows"] == []
+
+
+def _wait(cond, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestColdLifecycle:
+    @pytest.fixture()
+    def cluster(self, tmp_path):
+        registry = ClusterRegistry()
+        controller = Controller(registry, str(tmp_path / "deep"))
+        server = ServerInstance(
+            "srv_tier", registry, str(tmp_path / "srv"),
+            device_executor=None,
+            tier_overrides={"pinot.server.tier.enabled": True,
+                            # ticks only run when we call them
+                            "pinot.server.tier.interval.ms": 3_600_000})
+        server.start()
+        from pinot_tpu.broker.broker import Broker
+
+        broker = Broker(registry, timeout_s=10.0)
+        yield registry, controller, server, broker
+        broker.close()
+        server.stop()
+
+    def _push(self, tmp_path, controller, n=3, rows=2000):
+        schema = Schema.build(
+            name="sales", dimensions=[("k", DataType.STRING)],
+            metrics=[("v", DataType.INT)])
+        cfg = TableConfig(table_name="sales")
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(1)
+        total = 0
+        for i in range(n):
+            cols = {"k": np.array(["x", "y"])[rng.integers(0, 2, rows)],
+                    "v": rng.integers(0, 100, rows).astype(np.int32)}
+            total += int(cols["v"].sum())
+            d = str(tmp_path / f"up{i}")
+            build_segment(schema, cols, d, cfg, f"sales_s{i}")
+            controller.upload_segment("sales", d)
+        return total
+
+    def test_cold_demote_query_hydrate(self, cluster, tmp_path):
+        registry, controller, server, broker = cluster
+        total = self._push(tmp_path, controller)
+        assert _wait(lambda: len(getattr(
+            server.engine.tables.get("sales_OFFLINE"), "segments", ()))
+            == 3)
+        r = broker.execute("SELECT SUM(v) FROM sales")
+        assert r["resultTable"]["rows"][0][0] == total
+        assert r["numSegmentsCold"] == 0
+
+        tdm = server.engine.tables["sales_OFFLINE"]
+        name = sorted(tdm.segments)[0]
+        assert server.tiers.demote_to_cold("sales_OFFLINE", name)
+        seg_dir = tdm.segments[name].dir
+        # planes evicted, metadata kept, segment still hosted + routable
+        assert sorted(os.listdir(seg_dir)) == [
+            "creation.meta.json", "metadata.json"]
+        assert name in tdm.segments
+        assert getattr(tdm.segments[name], "is_cold", False)
+
+        r2 = broker.execute("SELECT SUM(v) FROM sales")
+        assert r2["numSegmentsCold"] == 1
+        assert r2["partialResult"] is True
+        assert r2["resultTable"]["rows"][0][0] < total  # honest partial
+        assert r2["totalDocs"] == 6000  # cold docs still counted
+
+        # the touch scheduled hydration: converges to the full answer
+        assert server.tiers.wait_hydrated("sales_OFFLINE", name, 15)
+        r3 = broker.execute("SELECT SUM(v) FROM sales")
+        assert r3["numSegmentsCold"] == 0
+        assert r3["resultTable"]["rows"][0][0] == total
+        assert server.tiers.hydrations == 1
+        # hydrated segments land WARM (lazily mmap'd)
+        assert tdm.segments[name].tier == Tier.WARM
+
+    def test_demote_refuses_without_durable_copy(self, cluster, tmp_path):
+        registry, controller, server, broker = cluster
+        self._push(tmp_path, controller, n=1)
+        assert _wait(lambda: len(getattr(
+            server.engine.tables.get("sales_OFFLINE"), "segments", ()))
+            == 1)
+        tdm = server.engine.tables["sales_OFFLINE"]
+        name = sorted(tdm.segments)[0]
+        # blank out the record's location: demotion must refuse rather
+        # than evict the only copy
+        recs = registry.segments("sales_OFFLINE")
+        rec = recs[name]
+        rec.location = ""
+        registry.add_segment(rec, [server.instance_id],
+                             merge_instances=True)
+        assert not server.tiers.demote_to_cold("sales_OFFLINE", name)
+        assert not getattr(tdm.segments[name], "is_cold", False)
+
+    def test_tick_policy_hot_admission_and_cold_idle(self, cluster,
+                                                     tmp_path):
+        registry, controller, server, broker = cluster
+        self._push(tmp_path, controller, n=3)
+        assert _wait(lambda: len(getattr(
+            server.engine.tables.get("sales_OFFLINE"), "segments", ()))
+            == 3)
+        tiers = server.tiers
+        tiers.cold_idle_s = 30.0
+        tiers.cold_max_rate = 0.5
+        now = time.time()
+        names = sorted(server.engine.tables["sales_OFFLINE"].segments)
+        # a first tick an hour ago establishes the first-seen baseline
+        # (a segment idles from its LOAD, not from the epoch)
+        tiers.tick(now=now - 3600)
+        # hot-rate access for names[0]; one stale access for names[1];
+        # one recentish access for names[2] (rate above the cold cut)
+        for _ in range(10):
+            server.heat.note("sales_OFFLINE", names[0], 1000, now=now)
+        server.heat.note("sales_OFFLINE", names[1], 1000, now=now - 3600)
+        server.heat.note("sales_OFFLINE", names[2], 1000, now=now - 60)
+        applied = tiers.tick(now=now)
+        snap = tiers.snapshot()["sales_OFFLINE"]
+        # no device on this server -> hot budget 0: even the hottest
+        # segment serves warm; the hour-stale one went cold; the
+        # recently-touched one keeps enough decayed rate to stay warm
+        assert snap[names[0]] == Tier.WARM
+        assert snap[names[1]] == Tier.COLD
+        assert snap[names[2]] == Tier.WARM
+        assert names[1] in applied["to_cold"]
+
+    def test_demote_refuses_file_uri_self_copy(self, cluster, tmp_path):
+        # review hardening: a file:// URI pointing at the server's own
+        # working copy must refuse demotion like a bare path does
+        registry, controller, server, broker = cluster
+        self._push(tmp_path, controller, n=1)
+        assert _wait(lambda: len(getattr(
+            server.engine.tables.get("sales_OFFLINE"), "segments", ()))
+            == 1)
+        tdm = server.engine.tables["sales_OFFLINE"]
+        name = sorted(tdm.segments)[0]
+        rec = registry.segments("sales_OFFLINE")[name]
+        rec.location = "file://" + tdm.segments[name].dir
+        registry.add_segment(rec, [server.instance_id],
+                             merge_instances=True)
+        assert not server.tiers.demote_to_cold("sales_OFFLINE", name)
+        assert not getattr(tdm.segments[name], "is_cold", False)
+
+    def test_budget_scale_recovers_under_hit_dominated_churn(
+            self, cluster, tmp_path):
+        # review hardening: a trickle of natural misses must not pin the
+        # effective budget at the 0.25x floor forever
+        registry, controller, server, broker = cluster
+        tiers = server.tiers
+
+        class FakeDev:
+            MAX_CACHED_BYTES = 1000
+            batch_hits = 0
+            batch_misses = 0
+
+        dev = FakeDev()
+        server.engine.device = dev
+        tiers.hot_budget_bytes = 1000
+        tiers._budget_scale = 0.25
+        dev.batch_hits, dev.batch_misses = 100, 1  # hit-dominated
+        tiers._last_hits = tiers._last_misses = 0
+        b1 = tiers._effective_budget()
+        assert b1 > 250  # recovered past the floor
+        dev.batch_hits, dev.batch_misses = 110, 30  # miss-heavy-ish but
+        b2 = tiers._effective_budget()               # dm(29) < dh(10)? no:
+        # dh=10, dm=29 -> contraction
+        assert b2 < b1
+
+    def test_heartbeat_carries_tiers_and_controller_aggregates(
+            self, cluster, tmp_path):
+        registry, controller, server, broker = cluster
+        self._push(tmp_path, controller, n=2)
+        assert _wait(lambda: len(getattr(
+            server.engine.tables.get("sales_OFFLINE"), "segments", ()))
+            == 2)
+        name = sorted(server.engine.tables["sales_OFFLINE"].segments)[0]
+        assert server.tiers.demote_to_cold("sales_OFFLINE", name)
+        server.registry.heartbeat(
+            server.instance_id, tiers=server.tiers.snapshot())
+        agg = controller.table_tiers("sales")
+        assert agg["segments"][name]["tier"] == Tier.COLD
+        assert agg["instancesReporting"] == 1
+
+
+class TestTieredRebalance:
+    def _registry_with_table(self, n_servers=4, n_segments=8,
+                             replication=2):
+        reg = ClusterRegistry()
+        for i in range(n_servers):
+            reg.register_instance(
+                InstanceInfo(f"s{i}", Role.SERVER, grpc_port=9000 + i))
+        schema = Schema.build(name="t",
+                              dimensions=[("k", DataType.STRING)],
+                              metrics=[])
+        cfg = TableConfig(table_name="t", replication=replication)
+        reg.add_table(cfg, schema, key="t_OFFLINE")
+        for i in range(n_segments):
+            reg.add_segment(
+                SegmentRecord(name=f"seg{i}", table="t_OFFLINE",
+                              n_docs=10), [])
+        return reg
+
+    def test_cold_flip_moves_only_flipped_segment(self):
+        reg = self._registry_with_table()
+        assigner = SegmentAssigner(reg)
+        base = assigner.rebalance_replica_groups("t_OFFLINE", 2)
+        assert all(len(v) == 2 for v in base.values())
+
+        # steady state: all-hot tiered pass publishes NOTHING
+        gen0 = reg.routing_generation()
+        same = assigner.rebalance_tiered(
+            "t_OFFLINE", 2, {f"seg{i}": Tier.HOT for i in range(8)})
+        assert {k: sorted(v) for k, v in same.items()} == \
+               {k: sorted(v) for k, v in base.items()}
+        assert reg.routing_generation() == gen0
+
+        # cold flip: exactly the flipped segment trims, keeping a
+        # current replica (the copy already on disk)
+        after = assigner.rebalance_tiered("t_OFFLINE", 2,
+                                          {"seg3": Tier.COLD})
+        moved = [s for s in base
+                 if sorted(base[s]) != sorted(after.get(s, ()))]
+        assert moved == ["seg3"]
+        assert len(after["seg3"]) == 1
+        assert after["seg3"][0] in base["seg3"]
+
+        # flip back: only it re-expands
+        restored = assigner.rebalance_tiered("t_OFFLINE", 2,
+                                             {"seg3": Tier.HOT})
+        moved = [s for s in after
+                 if sorted(after[s]) != sorted(restored.get(s, ()))]
+        assert moved == ["seg3"]
+        assert len(restored["seg3"]) == 2
+
+    def test_aggregate_tiers_hottest_replica_wins(self):
+        reg = self._registry_with_table(n_servers=2)
+        reg.heartbeat("s0", tiers={"t_OFFLINE": {"seg0": Tier.COLD}})
+        reg.heartbeat("s1", tiers={"t_OFFLINE": {"seg0": Tier.HOT}})
+        agg = aggregate_tiers(reg, "t_OFFLINE")
+        assert agg["segments"]["seg0"]["tier"] == Tier.HOT
+        assert agg["segments"]["seg0"]["instances"] == {
+            "s0": Tier.COLD, "s1": Tier.HOT}
