@@ -2550,6 +2550,402 @@ def bench_tiering(n_segments: int = 16, rows: int = 120_000,
     return detail, violations
 
 
+def bench_overload(knee_window_s: float = 2.0, spike_window_s: float = 4.0):
+    """detail.overload: the closed-loop overload-survival phase
+    (ISSUE 14). An in-process 2-server / replication-2 cluster behind an
+    admission-enabled broker runs three sub-phases:
+
+    1. **Knee search** — an OPEN-MODEL arrival-rate ladder (queries fire
+       on a wall-clock schedule, not a closed loop): rates double until
+       p99 blows past 4x the base p50 or errors appear; the knee is the
+       last sustainable rung.
+    2. **Tenant spike at 2x the knee** — tenant A's arrival rate jumps
+       10x (total offered load ~2x knee) while tenant B keeps its steady
+       dashboard cadence. Gates: tenant-B p99 moves <25% vs the same
+       harness without the spike, tenant B sees ZERO hard errors, and
+       every shed/degraded response is TYPED (sheddingReason /
+       servedStale + retryAfterSeconds — never silent).
+    3. **Autoscaler cycle** — a fresh 2-server cluster under sustained
+       closed-loop pressure must scale to 4 servers (controller
+       autoscaler, replica groups growing via the minimal-movement
+       repair) and drain back to 2 when the load stops, with zero
+       errors on a background query trickle through both transitions.
+
+    Standalone: ``python -m bench --phase overload`` exits 10 on gate
+    violation (after tiering=9)."""
+    import shutil
+    import threading as _threading
+    from concurrent import futures as _futures
+
+    from pinot_tpu.broker.admission import TenantAdmissionController
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import ClusterRegistry, Role
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.storage.creator import build_segment
+
+    detail: dict = {}
+    violations: list = []
+    base = tempfile.mkdtemp(prefix="pinot_tpu_overload_")
+    # fast heartbeats so piggybacked pressure reaches the controller
+    # autoscaler within its tick cadence
+    os.environ["PINOT_TPU_PINOT_SERVER_HEARTBEAT_INTERVAL_MS"] = "300"
+
+    schema = Schema.build(
+        name="mt", dimensions=[("region", DataType.STRING)],
+        metrics=[("amount", DataType.INT)])
+    cfg = TableConfig(table_name="mt", replication=2)
+    rng = np.random.default_rng(14)
+    seg_dirs = []
+    for i in range(4):
+        rows = 60_000
+        cols = {
+            "region": np.array(["na", "eu", "apac", "latam"])[
+                rng.integers(0, 4, rows)],
+            "amount": rng.integers(1, 500, rows).astype(np.int32),
+        }
+        d = os.path.join(base, f"seg{i}")
+        build_segment(schema, cols, d, cfg, f"mt_s{i}")
+        seg_dirs.append(d)
+
+    def start_cluster(n_servers, admission=None, result_cache=False,
+                      tag=""):
+        registry = ClusterRegistry()
+        controller = Controller(registry, os.path.join(base, f"ds{tag}"))
+        servers = [
+            ServerInstance(f"osrv_{tag}{i}", registry,
+                           os.path.join(base, f"s{tag}{i}"),
+                           device_executor=None,
+                           scheduler_name="tokenbucket",
+                           max_concurrent_queries=2)
+            for i in range(n_servers)]
+        for s in servers:
+            s.start()
+        controller.add_table(cfg, schema)
+        for d in seg_dirs:
+            controller.upload_segment("mt", d)
+        controller.setup_replica_groups("mt")
+        t_end = time.time() + 30
+        while time.time() < t_end:
+            ev = registry.external_view("mt_OFFLINE")
+            if len(ev) == len(seg_dirs) and \
+                    all(len(v) >= min(2, n_servers) for v in ev.values()):
+                break
+            time.sleep(0.05)
+        else:
+            raise RuntimeError("overload phase: segments never loaded")
+        broker = Broker(registry, timeout_s=10.0, admission=admission,
+                        result_cache=result_cache)
+        return registry, controller, servers, broker
+
+    def scan_sql(k: int) -> str:
+        """One scan query; distinct ``k`` = distinct literal digest (a
+        COLD query the result cache cannot queue-jump), stable
+        selectivity either way."""
+        return (f"SELECT region, COUNT(*), SUM(amount) FROM mt "
+                f"WHERE amount < 480 AND amount != {100000 + k} "
+                f"GROUP BY region ORDER BY region")
+
+    # tenant B is a dashboard: a small REPEATING panel set (these are
+    # exactly the queries the cache + queue-jumping protect)
+    b_pool = [scan_sql(-(j + 1)) for j in range(4)]
+    sweep = [scan_sql(k) for k in range(32)]
+
+    def open_model(broker, arrivals, pool):
+        """Fire (delay_s, sql, bucket) arrivals on the wall clock; each
+        result appends (latency_ms, resp) to its bucket list."""
+        t0 = time.perf_counter()
+        futs = []
+        for delay, sql, bucket in arrivals:
+            now = time.perf_counter() - t0
+            if delay > now:
+                time.sleep(delay - now)
+
+            def run(sql=sql, bucket=bucket):
+                q0 = time.perf_counter()
+                r = broker.execute(sql)
+                bucket.append(((time.perf_counter() - q0) * 1e3, r))
+
+            futs.append(pool.submit(run))
+        for f in futs:
+            f.result()
+
+    def ladder_arrivals(rate, window_s, tenant, bucket, sql_fn):
+        n = max(4, int(rate * window_s))
+        return [(i / rate, f"SET workloadName='{tenant}'; {sql_fn(i)}",
+                 bucket)
+                for i in range(n)]
+
+    def p(lats, q):
+        return float(np.percentile(np.asarray(lats), q)) if lats else 0.0
+
+    # ---- sub-phase 1: open-model knee search -----------------------------
+    registry, controller, servers, broker = start_cluster(
+        2, admission=None, tag="k")
+    try:
+        warm = broker.execute(sweep[0])
+        if warm.get("exceptions"):
+            raise RuntimeError(f"overload warmup failed: "
+                               f"{warm['exceptions']}")
+        pool = _futures.ThreadPoolExecutor(max_workers=32)
+        rungs = {}
+        knee = 0.0
+        base_p50 = None
+        rate = 16.0
+        while rate <= 512.0:
+            bucket: list = []
+            open_model(broker, ladder_arrivals(
+                rate, knee_window_s, "probe", bucket,
+                lambda i: sweep[i % len(sweep)]), pool)
+            lats = [entry[0] for entry in bucket]
+            errs = sum(1 for _l, r in bucket if r.get("exceptions"))
+            p50, p99 = p(lats, 50), p(lats, 99)
+            if base_p50 is None:
+                base_p50 = p50
+            rungs[f"r{int(rate)}"] = {
+                "offered_qps": rate, "p50_ms": round(p50, 2),
+                "p99_ms": round(p99, 2), "errors": errs}
+            if errs or p99 > 4 * max(base_p50, 1.0):
+                break
+            knee = rate
+            rate *= 2
+        pool.shutdown(wait=True)
+        detail["ladder"] = rungs
+        detail["knee_qps"] = knee
+        if knee <= 0:
+            violations.append("open-model ladder never found a "
+                              "sustainable knee rung")
+    finally:
+        broker.close()
+        for s in servers:
+            s.stop(drain_timeout_s=0.5)
+
+    # ---- sub-phase 2: 10x tenant-A spike at 2x the knee ------------------
+    if knee > 0:
+        a_base = max(2.0, knee / 5.0)
+        a_spike = 10.0 * a_base          # total offered ~2x knee
+        b_rate = min(10.0, max(4.0, knee / 8.0))
+        adm = TenantAdmissionController(
+            rate_qps=a_base, burst=2 * a_base,
+            tenant_overrides={"tenantB": {"rate": 1000.0, "burst": 100.0}})
+        registry, controller, servers, broker = start_cluster(
+            2, admission=adm, result_cache=True, tag="m")
+        try:
+            # prewarm tenant B's dashboard pool: baseline and spike runs
+            # then compare warm-cache against warm-cache, so the delta
+            # measures the SPIKE's effect, not a first-touch cold scan
+            for sql in b_pool:
+                broker.execute(f"SET workloadName='tenantB'; {sql}")
+            offset = [0]
+
+            def run_mix(a_rate):
+                pool = _futures.ThreadPoolExecutor(max_workers=48)
+                a_bucket: list = []
+                b_bucket: list = []
+                base_k = offset[0]
+                # tenant A's queries carry DISTINCT literals (cold scans
+                # across both runs — the spike the caches can't absorb);
+                # tenant B cycles its fixed panel pool
+                arrivals = sorted(
+                    ladder_arrivals(
+                        a_rate, spike_window_s, "tenantA", a_bucket,
+                        lambda i: scan_sql(base_k + i))
+                    + ladder_arrivals(
+                        b_rate, spike_window_s, "tenantB", b_bucket,
+                        lambda i: b_pool[i % len(b_pool)]),
+                    key=lambda e: e[0])
+                open_model(broker, arrivals, pool)
+                pool.shutdown(wait=True)
+                offset[0] += int(a_rate * spike_window_s) + 16
+                return a_bucket, b_bucket
+
+            _a0, b0 = run_mix(a_base)          # baseline: A at normal rate
+            a1, b1 = run_mix(a_spike)          # the 10x spike
+            b0_lats = [entry[0] for entry in b0]
+            b1_lats = [entry[0] for entry in b1]
+            b0_p99, b1_p99 = p(b0_lats, 99), p(b1_lats, 99)
+            delta_pct = ((b1_p99 - b0_p99) / b0_p99 * 100) if b0_p99 else 0.0
+            b_hard_errors = sum(
+                1 for _l, r in b1 if r.get("exceptions"))
+            shed = sum(1 for _l, r in a1
+                       if r.get("sheddingReason") and r.get("exceptions"))
+            stale = sum(1 for _l, r in a1 if r.get("servedStale"))
+            admitted_lats = [entry[0] for entry in (a1 + b1)
+                             if not entry[1].get("exceptions")
+                             and not entry[1].get("servedStale")]
+            silent = 0
+            for _l, r in a1 + b1:
+                excs = r.get("exceptions") or []
+                if excs and excs[0].get("errorCode") == 429 and (
+                        not r.get("sheddingReason")
+                        or r.get("retryAfterSeconds") is None):
+                    silent += 1
+                if r.get("servedStale") and (
+                        r.get("staleAgeMs") is None
+                        or not r.get("sheddingReason")):
+                    silent += 1
+            detail["p99_at_2x_knee_ms"] = round(p(admitted_lats, 99), 2)
+            detail["tenant_b"] = {
+                "baseline_p99_ms": round(b0_p99, 2),
+                "spike_p99_ms": round(b1_p99, 2),
+                "delta_pct": round(delta_pct, 1),
+                "hard_errors": b_hard_errors,
+            }
+            detail["shed"] = {
+                "rejected_429": shed, "served_stale": stale,
+                "untyped_responses": silent,
+                "spike_offered_qps": round(a_spike + b_rate, 1),
+            }
+            if b_hard_errors:
+                violations.append(
+                    f"tenant B saw {b_hard_errors} hard errors under the "
+                    f"tenant-A spike (bar: 0)")
+            if delta_pct >= 25.0:
+                violations.append(
+                    f"tenant-B p99 moved {delta_pct:.1f}% under the spike "
+                    f"({b0_p99:.2f} -> {b1_p99:.2f} ms; bar: <25%)")
+            if shed == 0:
+                violations.append(
+                    "the 10x spike was never shed (admission idle?)")
+            if silent:
+                violations.append(
+                    f"{silent} shed/degraded responses lacked typed "
+                    f"sheddingReason/servedStale fields")
+        finally:
+            broker.close()
+            for s in servers:
+                s.stop(drain_timeout_s=0.5)
+
+    # ---- sub-phase 3: autoscaler 2 -> 4 -> 2 -----------------------------
+    registry, controller, servers, broker = start_cluster(2, tag="a")
+    scaled_servers: list = []
+    counter = [2]
+    try:
+        def spawn():
+            i = counter[0]
+            counter[0] += 1
+            s = ServerInstance(f"osrv_a{i}", registry,
+                               os.path.join(base, f"sa{i}"),
+                               device_executor=None,
+                               scheduler_name="tokenbucket",
+                               max_concurrent_queries=2)
+            s.start()
+            scaled_servers.append(s)
+            return s.instance_id
+
+        def drain(inst):
+            for s in servers + scaled_servers:
+                if s.instance_id == inst:
+                    s.stop(drain_timeout_s=5.0)
+                    return True
+            return False
+
+        controller.attach_autoscaler(
+            spawn, drain, min_servers=2, max_servers=4,
+            high_water=2.0, low_water=0.25, sustain_ticks=2,
+            cooldown_ticks=1)
+        assign_before = dict(registry.assignment("mt_OFFLINE"))
+
+        trickle_errors = [0]
+        trickle_n = [0]
+        stop_trickle = _threading.Event()
+
+        def trickle():
+            i = 0
+            while not stop_trickle.is_set():
+                r = broker.execute(sweep[i % len(sweep)])
+                trickle_n[0] += 1
+                if r.get("exceptions"):
+                    trickle_errors[0] += 1
+                i += 1
+                time.sleep(0.05)
+
+        trickle_thread = _threading.Thread(target=trickle, daemon=True)
+        trickle_thread.start()
+
+        stop_load = _threading.Event()
+
+        def loader():
+            i = 0
+            while not stop_load.is_set():
+                broker.execute(sweep[i % len(sweep)])
+                i += 1
+
+        loaders = [_threading.Thread(target=loader, daemon=True)
+                   for _ in range(8)]
+        for t in loaders:
+            t.start()
+        live = lambda: len(registry.instances(  # noqa: E731
+            Role.SERVER, live_ttl_ms=3000))
+        t_end = time.time() + 60
+        while time.time() < t_end and live() < 4:
+            controller.run_autoscale()
+            time.sleep(0.25)
+        scaled_to = live()
+        assign_mid = dict(registry.assignment("mt_OFFLINE"))
+        stop_load.set()
+        for t in loaders:
+            t.join(3)
+        t_end = time.time() + 90
+        while time.time() < t_end and live() > 2:
+            controller.run_autoscale()
+            time.sleep(0.25)
+        drained_to = live()
+        stop_trickle.set()
+        trickle_thread.join(5)
+        moved_out = sorted(
+            seg for seg in assign_mid
+            if sorted(assign_mid.get(seg, ())) !=
+            sorted(assign_before.get(seg, ())))
+        # minimal movement: a segment moved at scale-out only when its
+        # replica-group membership actually changed — i.e. it gained a
+        # replica on a NEW server; none may merely shuffle between the
+        # original two
+        shuffled = [
+            seg for seg in moved_out
+            if not (set(assign_mid.get(seg, ()))
+                    - set(assign_before.get(seg, ())))]
+        detail["autoscaler"] = {
+            "scaled_to": scaled_to, "drained_to": drained_to,
+            "trickle_queries": trickle_n[0],
+            "trickle_errors": trickle_errors[0],
+            "segments_moved_at_scale_out": len(moved_out),
+            "segments_shuffled_needlessly": len(shuffled),
+            "actions": list(controller.autoscaler.actions),
+            "state": registry.autoscaler_state(),
+        }
+        if scaled_to < 4:
+            violations.append(
+                f"autoscaler reached {scaled_to} servers under sustained "
+                f"pressure (bar: 4)")
+        if drained_to > 2:
+            violations.append(
+                f"autoscaler drained back to {drained_to} servers "
+                f"(bar: 2)")
+        if trickle_errors[0]:
+            violations.append(
+                f"{trickle_errors[0]} query errors during scale "
+                f"transitions (bar: 0)")
+        if shuffled:
+            violations.append(
+                f"{len(shuffled)} segments moved without a replica-group "
+                f"membership change (rebalance not minimal)")
+    finally:
+        broker.close()
+        for s in servers + scaled_servers:
+            try:
+                s.stop(drain_timeout_s=0.5)
+            except Exception:  # noqa: BLE001 — already drained by scaler
+                pass
+        os.environ.pop("PINOT_TPU_PINOT_SERVER_HEARTBEAT_INTERVAL_MS",
+                       None)
+        shutil.rmtree(base, ignore_errors=True)
+    return detail, violations
+
+
 def bench_observability(n_queries: int = 24):
     """detail.observability: the flight-recorder phase (ISSUE 7). A
     2-server in-process cluster serves a device group-by; the phase runs
@@ -2896,12 +3292,21 @@ def main():
     ap.add_argument(
         "--phase",
         choices=("full", "faults", "observability", "join", "subrtt",
-                 "cluster", "tiering"),
+                 "cluster", "tiering", "overload"),
         default="full",
         help="'faults' / 'observability' / 'join' / 'subrtt' / 'cluster' "
-             "/ 'tiering' run ONLY that phase (no dataset build) so CI "
-             "can gate on each standalone")
+             "/ 'tiering' / 'overload' run ONLY that phase (no dataset "
+             "build) so CI can gate on each standalone")
     args = ap.parse_args()
+    if args.phase == "overload":
+        detail, violations = bench_overload()
+        print(json.dumps({"metric": "overload-phase standalone",
+                          "detail": {"overload": detail}}))
+        if violations:
+            print(f"overload gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(10)
+        return
     if args.phase == "tiering":
         detail, violations = bench_tiering()
         print(json.dumps({"metric": "tiering-phase standalone",
@@ -3011,6 +3416,7 @@ def main():
     # 2-core container runs the 1- and 2-server widths only)
     cluster_detail, cluster_violations = bench_cluster()
     tiering_detail, tiering_violations = bench_tiering()
+    overload_detail, overload_violations = bench_overload()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -3076,6 +3482,7 @@ def main():
                     "subrtt": subrtt_detail,
                     "cluster": cluster_detail,
                     "tiering": tiering_detail,
+                    "overload": overload_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -3157,6 +3564,10 @@ def main():
         print(f"tiering gate FAILED: {json.dumps(tiering_violations)}",
               file=sys.stderr)
         sys.exit(9)
+    if overload_violations:
+        print(f"overload gate FAILED: {json.dumps(overload_violations)}",
+              file=sys.stderr)
+        sys.exit(10)
 
 
 if __name__ == "__main__":

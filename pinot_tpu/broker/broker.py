@@ -20,7 +20,12 @@ from concurrent import futures
 from typing import Optional
 
 from pinot_tpu.broker.segment_pruner import prune_segments
-from pinot_tpu.cluster.registry import ClusterRegistry, Role, SegmentState
+from pinot_tpu.cluster.registry import (
+    HB_STALE_S,
+    ClusterRegistry,
+    Role,
+    SegmentState,
+)
 from pinot_tpu.common import faults
 from pinot_tpu.common.deadline import Deadline
 from pinot_tpu.engine.datatable import decode
@@ -273,6 +278,13 @@ class LoadTracker:
 
     DECAY_S = 10.0
     STALE_S = 30.0
+    # heartbeat-staleness cut (ISSUE 14 satellite, single-sourced in
+    # cluster/registry.py): an instance that missed 3 heartbeat
+    # intervals is presumed crashed/wedged — its last pressure sample
+    # must DECAY OUT of scoring entirely, not sit there exponentially
+    # decaying toward 0 and making a dead server look like the
+    # cluster's idlest pick
+    HB_STALE_S = HB_STALE_S
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -294,6 +306,20 @@ class LoadTracker:
                 return  # a fresher (piggybacked) observation already landed
             decayed = cur[0] * math.exp(-(now - cur[1]) / self.DECAY_S)
             self._obs[instance_id] = [0.5 * decayed + 0.5 * load, now]
+
+    def expire_if_stale(self, instance_id: str, max_age_s: float) -> None:
+        """Drop an instance's observation when the observation ITSELF is
+        older than ``max_age_s`` — the heartbeat-stale fix: a crashed
+        server stops both heartbeating and piggybacking, so its frozen
+        sample would otherwise decay toward 0 and read as 'idle' to the
+        least-loaded pick for the full STALE_S window. A fresher
+        piggybacked observation (server alive, registry heartbeat merely
+        delayed) keeps the entry."""
+        now = time.monotonic()
+        with self._lock:
+            cur = self._obs.get(instance_id)
+            if cur is not None and now - cur[1] > max_age_s:
+                self._obs.pop(instance_id, None)
 
     def note_dispatch(self, instance_id: str) -> None:
         with self._lock:
@@ -440,10 +466,18 @@ class RoutingManager:
                      else self.registry.instances(Role.SERVER))
         for i in instances:
             age_s = max(0.0, (now_ms - i.last_heartbeat_ms) / 1e3)
-            if age_s <= LoadTracker.STALE_S:
+            if age_s <= LoadTracker.HB_STALE_S:
                 self.loads.observe(i.instance_id,
                                    getattr(i, "pressure", 0.0),
                                    ts=now - age_s)
+            else:
+                # no heartbeat within 3 intervals: the instance is
+                # presumed down — expire its load sample (unless a
+                # fresher piggybacked response observation proves it
+                # alive) so the least-loaded pick stops seeing a
+                # crashed server as permanently idle
+                self.loads.expire_if_stale(i.instance_id,
+                                           LoadTracker.HB_STALE_S)
 
     # ---- query-time selection --------------------------------------------
     def release(self, instances) -> None:
@@ -591,7 +625,8 @@ class _NoEngine:
 
 class Broker:
     def __init__(self, registry: ClusterRegistry, broker_id: str = "broker_0",
-                 timeout_s: float = 10.0, tls="auto", result_cache=None):
+                 timeout_s: float = 10.0, tls="auto", result_cache=None,
+                 admission=None):
         self.registry = registry
         self.broker_id = broker_id
         self.timeout_s = timeout_s
@@ -649,7 +684,30 @@ class Broker:
             max_entries=int(conf.get_float(
                 "pinot.broker.resultcache.max.entries", 512)),
             max_bytes=int(conf.get_float(
-                "pinot.broker.resultcache.max.bytes", float(32 << 20))))
+                "pinot.broker.resultcache.max.bytes", float(32 << 20))),
+            stale_retention_s=conf.get_float(
+                "pinot.broker.resultcache.stale.retention.s", 30.0))
+        # per-tenant priority admission + load shedding (ISSUE 14,
+        # broker/admission.py): OFF by default — every existing
+        # single-tenant deployment and test keeps its exact semantics
+        # unless the operator opts in (pinot.broker.admission.enabled /
+        # the constructor). ``admission`` may be a ready controller, a
+        # truthy flag (config-built controller), or None (config decides).
+        from pinot_tpu.broker.admission import TenantAdmissionController
+
+        if isinstance(admission, TenantAdmissionController):
+            self.admission: Optional[TenantAdmissionController] = admission
+        elif (admission if admission is not None
+              else conf.get_bool("pinot.broker.admission.enabled", False)):
+            self.admission = TenantAdmissionController.from_config(conf)
+        else:
+            self.admission = None
+        # bounded-staleness degradation default (SET maxStalenessMs
+        # overrides per query): how old a result-cache entry a SHED query
+        # may be served instead of a 429. 0 = degrade only when the query
+        # explicitly opts in.
+        self.shed_max_staleness_ms = conf.get_float(
+            "pinot.broker.shed.max.staleness.ms", 0.0)
         # per-table {instance: freshness epoch} observed piggybacked in
         # responses (merged with heartbeat epochs at validation time)
         self._epoch_obs: dict = {}
@@ -843,11 +901,15 @@ class Broker:
             # the next response)
             per[instance_id] = (epoch, time.monotonic())
 
-    def _result_cache_key(self, q, for_explain: bool = False):
+    def _result_cache_key(self, q, for_explain: bool = False,
+                          precomputed=None):
         """Cache key for this query, or None when the query must not ride
         the cache (disabled, traced, chaos-armed, or explicitly opted
         out). ``for_explain`` keys the underlying query of an EXPLAIN so
-        the plan can render CACHED_RESULT."""
+        the plan can render CACHED_RESULT. ``precomputed``: a key the
+        caller already derived via ``key_for`` (the admission path's
+        adm_key) — reused so the template walk + digest run once per
+        query."""
         opts = q.options_ci()
         use = opts.get("useresultcache")
         if use is None:
@@ -866,14 +928,81 @@ class Broker:
             return None
         if opts.get("trace") or opts.get("faultinject"):
             return None
+        if precomputed is not None:
+            return precomputed
         from pinot_tpu.broker.querylog import template_key
 
         return self.result_cache.key_for(q, template_key(q))
 
+    def _max_load_score(self):
+        """Broker-wide overload signal: the worst decayed LoadTracker
+        score across known servers (None when every score is stale — the
+        shed ladder then stands down rather than shedding blind)."""
+        scores = (self.routing.loads.score(i)
+                  for i in self._server_instances())
+        vals = [s for s in scores if s is not None]
+        return max(vals) if vals else None
+
+    def _shed_response(self, sql: str, q, decision, adm_key,
+                       t0: float) -> dict:
+        """Load-shedding with graceful degradation (ISSUE 14): a query
+        admission refused is first offered a BOUNDED-STALENESS result-
+        cache read — ``SET maxStalenessMs`` (or the broker's configured
+        default) caps how old an entry may serve; the response is flagged
+        ``servedStale`` with the entry's age and a typed
+        ``sheddingReason``, never silently degraded. Only when no
+        eligible entry exists does the broker answer 429 — with
+        ``retryAfterSeconds`` computed from the TENANT's actual bucket
+        refill time (capped at 5 s), and the tenant + priority class in
+        the response and the query log."""
+        self.metrics.count("queriesShed")
+        opts = q.options_ci()
+        max_stale_ms = opts.get("maxstalenessms")
+        if max_stale_ms is None:
+            max_stale_ms = self.shed_max_staleness_ms
+        try:
+            max_stale_ms = float(max_stale_ms)
+        except (TypeError, ValueError):
+            max_stale_ms = 0.0
+        if max_stale_ms > 0 and adm_key is not None:
+            stale, age_s = self.result_cache.get_stale(
+                adm_key, max_stale_ms / 1e3)
+            if stale is not None:
+                self.metrics.count("queriesShedStaleServed")
+                self.admission.num_shed_stale_served += 1
+                resp = dict(stale)
+                resp.pop("__epochView__", None)
+                resp["servedStale"] = True
+                resp["staleAgeMs"] = round(age_s * 1e3, 1)
+                resp["sheddingReason"] = decision.reason
+                resp["tenant"] = decision.tenant
+                resp["priorityClass"] = decision.priority
+                resp["requestId"] = next(self._request_id)
+                resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
+                self.metrics.time_ms("query", resp["timeUsedMs"])
+                return self._log_query(sql, q, resp, t0)
+        self.metrics.count("queriesAdmissionRejected")
+        retry_s = max(0.05, float(decision.retry_after_s))
+        return self._log_query(sql, q, {
+            "exceptions": [{
+                "errorCode": 429,
+                "message": f"admission rejected for tenant "
+                           f"{decision.tenant!r} "
+                           f"(priority {decision.priority}): "
+                           f"{decision.reason}"}],
+            "retryAfterSeconds": round(retry_s, 3),
+            "sheddingReason": decision.reason,
+            "tenant": decision.tenant,
+            "priorityClass": decision.priority,
+        }, t0)
+
     # ---- request handling ------------------------------------------------
-    def execute(self, sql: str) -> dict:
+    def execute(self, sql: str, principal: str = None) -> dict:
         """HTTP POST /query/sql equivalent (PinotClientRequest →
-        BaseBrokerRequestHandler.handleRequest)."""
+        BaseBrokerRequestHandler.handleRequest). ``principal``: the
+        authenticated identity (broker HTTP basic auth) — the tenant key
+        for priority admission when enabled (ISSUE 14); queries may also
+        self-identify via ``SET workloadName``."""
         from pinot_tpu.common import trace
 
         t0 = time.time()
@@ -911,8 +1040,10 @@ class Broker:
             if is_multistage(stmt):
                 # join / window query: two-stage execution — stage-1 leaf
                 # scans ride the ordinary scatter-gather below (recursive
-                # single-stage queries), stage 2 runs broker-local
-                return self._execute_multistage(stmt, sql, t0)
+                # single-stage queries, each debiting admission/quota as
+                # its own first-class query), stage 2 runs broker-local
+                return self._execute_multistage(stmt, sql, t0,
+                                                principal=principal)
             q = optimize_query(compile_select(stmt))
             # ONE routing-generation read serves this whole query: quota
             # rate memo, table-name fold, physical split, routing snapshot
@@ -940,18 +1071,21 @@ class Broker:
                     plan["resultTable"]["rows"] = [
                         [ln, i, i - 1] for i, ln in enumerate(lines)]
                 return plan
-            if not self.quota.acquire(q.table_name, gen):
-                # quota rejection before any fan-out
-                # (BaseBrokerRequestHandler's quota check placement)
-                self.metrics.count("queriesQuotaExceeded")
-                return self._log_query(sql, q, {"exceptions": [{
-                    "errorCode": 429,
-                    "message": f"query quota exceeded for table "
-                               f"{q.table_name!r}"}],
-                    # pacing hint for clients (Retry-After analog): the
-                    # token bucket refills within about a second
-                    "retryAfterSeconds": 0.5}, t0)
-            cache_key = self._result_cache_key(q)
+            # tenant + priority resolution (ISSUE 14): the authenticated
+            # principal wins, then SET workloadName, then the shared
+            # 'default' bucket; ``adm_key`` is the literal digest the
+            # sub-RTT queue-jump memo and the bounded-staleness shed
+            # path key on (computed regardless of the fresh cache's
+            # trace/chaos gating — shedding must find entries even when
+            # the FRESH path is opted out)
+            tenant = pclass = None
+            adm_key = None
+            if self.admission is not None:
+                from pinot_tpu.broker.querylog import template_key
+
+                tenant, pclass = self.admission.resolve(q, principal)
+                adm_key = self.result_cache.key_for(q, template_key(q))
+            cache_key = self._result_cache_key(q, precomputed=adm_key)
             cache_gen = None
             cache_view = None
             if cache_key is not None:
@@ -964,17 +1098,49 @@ class Broker:
                 cached = self.result_cache.get(
                     cache_key, cache_view, cache_gen)
                 if cached is not None:
+                    # queue jumping (ISSUE 14): a fresh result-cache hit
+                    # costs no server work, so it bypasses BOTH tenant
+                    # admission and the table quota — sub-RTT serving
+                    # never waits behind (or is starved by) cold scans —
+                    # and marks this literal digest sub-RTT so its
+                    # repeats admit at a fraction of a token
                     self.metrics.count("resultCacheHits")
                     resp = dict(cached)
                     resp["resultCacheHit"] = True
+                    if self.admission is not None:
+                        self.admission.note_sub_rtt(adm_key)
+                        resp["tenant"] = tenant
+                        resp["priorityClass"] = pclass
                     resp["requestId"] = next(self._request_id)
                     resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
                     self.metrics.time_ms("query", resp["timeUsedMs"])
                     return self._log_query(sql, q, resp, t0)
                 self.metrics.count("resultCacheMisses")
+            if self.admission is not None:
+                decision = self.admission.try_admit(
+                    tenant, pclass, load_score=self._max_load_score(),
+                    sub_rtt=self.admission.is_sub_rtt(adm_key))
+                if not decision.admitted:
+                    # degrade before rejecting: bounded-staleness cache
+                    # read (SET maxStalenessMs), else a typed 429 whose
+                    # Retry-After is THIS tenant's actual refill time
+                    return self._shed_response(sql, q, decision,
+                                               adm_key, t0)
+            if not self.quota.acquire(q.table_name, gen):
+                # quota rejection before any fan-out
+                # (BaseBrokerRequestHandler's quota check placement)
+                self.metrics.count("queriesQuotaExceeded")
+                return self._log_query(sql, q, {"exceptions": [{
+                    "errorCode": 429,
+                    "message": f"query quota exceeded for table "
+                               f"{q.table_name!r}"}],
+                    # pacing hint for clients (Retry-After analog): the
+                    # token bucket refills within about a second
+                    "retryAfterSeconds": 0.5}, t0)
             if q.options_ci().get("trace"):
                 tracer = trace.start_trace()
-            resp = self._scatter_gather(q, sql, gen)
+            resp = self._scatter_gather(q, sql, gen, tenant=tenant,
+                                        priority=pclass)
             if tracer is not None:
                 resp.setdefault("traceInfo", {})["broker"] = tracer.to_json()
                 if tracer.trace_id:
@@ -990,6 +1156,13 @@ class Broker:
         own_epochs = resp.pop("__epochView__", None)
         resp["timeUsedMs"] = round((time.time() - t0) * 1000, 3)
         self.metrics.time_ms("query", resp["timeUsedMs"])
+        if self.admission is not None:
+            resp["tenant"] = tenant
+            resp["priorityClass"] = pclass
+            if resp.get("partialsCacheHit"):
+                # a server answered from its device partials cache: this
+                # literal digest is sub-RTT — its repeats queue-jump
+                self.admission.note_sub_rtt(adm_key)
         if cache_key is not None:
             resp["resultCacheHit"] = False
             if not resp.get("exceptions") and not resp.get("partialResult"):
@@ -1037,7 +1210,8 @@ class Broker:
         out["analyzedResponse"] = inner
         return out
 
-    def _execute_multistage(self, stmt, sql: str, t0: float) -> dict:
+    def _execute_multistage(self, stmt, sql: str, t0: float,
+                            principal: str = None) -> dict:
         """Two-stage (join / window) execution at the broker. Stage-1 leaf
         scans are plain single-stage SELECT queries issued through
         ``self.execute`` — so routing, replica retry, hedging, the failure
@@ -1171,7 +1345,7 @@ class Broker:
             # cap + 1 so an exact-cap row set is distinguishable from a
             # truncated one (the embedded path's strict > check)
             leaf += f" LIMIT {MAX_STAGE1_ROWS + 1}"
-            r = self.execute(leaf)
+            r = self.execute(leaf, principal=principal)
             if r.get("traceInfo"):
                 trace_info[f"leaf:{src.alias}"] = r["traceInfo"]
             for rec in r.get("roofline") or ():
@@ -1387,19 +1561,25 @@ class Broker:
             raise KeyError(f"table {raw!r} not found")
         return out
 
-    def _scatter_gather(self, q: QueryContext, sql: str, gen=None) -> dict:
+    def _scatter_gather(self, q: QueryContext, sql: str, gen=None,
+                        tenant: str = None, priority: str = None) -> dict:
         """Thin reservation bracket around the scatter body: routing
         reserves the picked instances' outstanding counts atomically with
         the pick (concurrent queries balance instead of herding), and the
-        release is guaranteed here however the query settles."""
+        release is guaranteed here however the query settles.
+        ``tenant``/``priority`` (ISSUE 14) stamp every instance request
+        so the servers' weighted-fair schedulers isolate tenants."""
         reserved: list = []
         try:
-            return self._scatter_gather_inner(q, sql, reserved, gen)
+            return self._scatter_gather_inner(q, sql, reserved, gen,
+                                              tenant, priority)
         finally:
             self.routing.release(reserved)
 
     def _scatter_gather_inner(self, q: QueryContext, sql: str,
-                              reserved: list, gen=None) -> dict:
+                              reserved: list, gen=None,
+                              tenant: str = None,
+                              priority: str = None) -> dict:
         from pinot_tpu.common.trace import active, span
 
         q = self._expand_star(q)
@@ -1535,6 +1715,9 @@ class Broker:
                 # every attempt ships the trace flag + id, tagged with its
                 # kind, so a retried/hedged query still traces end to end
                 trace=trace_on, trace_id=trace_id, attempt=attempt,
+                # tenant + priority class (ISSUE 14): the server's
+                # weighted-fair scheduler groups slots by tenant
+                workload=tenant, priority=priority,
             )
             # small grace past the shipped budget: the server's own
             # deadline fires first; the RPC deadline is the backstop

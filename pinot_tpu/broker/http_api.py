@@ -145,7 +145,11 @@ class BrokerHttpServer:
                                        f"{denied!r} for principal "
                                        f"{principal!r}"}]})
                         return
-                    resp = outer.broker.execute(sql)
+                    # the authenticated principal is the tenant key for
+                    # priority admission (ISSUE 14); "" (auth disabled)
+                    # falls back to SET workloadName / 'default'
+                    resp = outer.broker.execute(sql,
+                                                principal=principal or None)
                     excs = resp.get("exceptions") or []
                     if excs and all(x.get("errorCode") == 429 for x in excs):
                         # over-quota: a real 429 status + Retry-After so

@@ -142,7 +142,10 @@ class QueryLogger:
         keep decision, so the default-policy hot path (healthy fast
         queries, dropped) never pays the template-key tree walk."""
         excs = resp.get("exceptions") or []
-        abnormal = bool(excs) or bool(resp.get("partialResult"))
+        # shed/degraded responses are always-log abnormal (ISSUE 14):
+        # the typed sheddingReason contract includes the query log
+        abnormal = bool(excs) or bool(resp.get("partialResult")) \
+            or bool(resp.get("sheddingReason"))
         if not self.should_log(time_used_ms, abnormal):
             return None
         if callable(template):
@@ -170,6 +173,11 @@ class QueryLogger:
                     # the broker result cache answered without a scatter
                     "numReplicaGroupsQueried", "replicaGroup",
                     "loadScore", "resultCacheHit",
+                    # multi-tenant admission (ISSUE 14): who asked, at
+                    # what priority, and whether the overload loop shed
+                    # or degraded the query (typed, never silent)
+                    "tenant", "priorityClass", "sheddingReason",
+                    "servedStale", "staleAgeMs",
                     # kernel roofline accounting (ISSUE 11): HBM bytes
                     # the device pipelines moved vs their kernel wall
                     "deviceBytesMoved", "deviceKernelMs", "deviceLinkMs",

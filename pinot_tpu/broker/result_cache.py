@@ -38,9 +38,16 @@ import time
 
 
 class BrokerResultCache:
-    def __init__(self, max_entries: int = 512, max_bytes: int = 32 << 20):
+    def __init__(self, max_entries: int = 512, max_bytes: int = 32 << 20,
+                 stale_retention_s: float = 30.0):
         self.max_entries = max(1, int(max_entries))
         self.max_bytes = max(1, int(max_bytes))
+        # how long a FRESHNESS-stale entry is kept for bounded-staleness
+        # load shedding (ISSUE 14): get() used to drop stale entries on
+        # sight, which would leave the shed path nothing to degrade to —
+        # now a stale entry lingers this long for get_stale() before the
+        # fresh path's drop-on-sight applies (0 restores the old drop)
+        self.stale_retention_s = float(stale_retention_s)
         self._lock = threading.Lock()
         # key -> {resp, nbytes, epoch_view, routing_gen, ts}
         self._entries: "collections.OrderedDict" = collections.OrderedDict()
@@ -49,17 +56,28 @@ class BrokerResultCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        self.stale_hits = 0
 
     # ---- keying ----------------------------------------------------------
-    @staticmethod
-    def key_for(q, template: str) -> tuple:
+    # SET options that change WHO asks / HOW the broker admits, never
+    # WHAT the rows are (ISSUE 14): stripped from the digest so tenant
+    # admission's queue-jump memo and the bounded-staleness shed path
+    # match the entry the same panel filled without them
+    _NON_SEMANTIC_OPTIONS = frozenset(
+        ("workloadname", "priorityclass", "maxstalenessms"))
+
+    @classmethod
+    def key_for(cls, q, template: str) -> tuple:
         """(table, template key, literal digest). The digest covers the
         WHOLE compiled context repr — filter literals, select/order
-        shapes, limit/offset, and SET options — so two queries share an
-        entry only when a broker would answer them identically."""
+        shapes, limit/offset, and SET options (minus the admission-only
+        options above) — so two queries share an entry only when a
+        broker would answer them identically."""
         import dataclasses
 
-        canon = dataclasses.replace(q, explain=False)
+        opts = tuple((k, v) for k, v in q.options
+                     if str(k).lower() not in cls._NON_SEMANTIC_OPTIONS)
+        canon = dataclasses.replace(q, explain=False, options=opts)
         digest = hashlib.blake2b(
             repr(canon).encode("utf-8"), digest_size=16).hexdigest()
         return (q.table_name, template, digest)
@@ -71,23 +89,51 @@ class BrokerResultCache:
 
     def get(self, key: tuple, epoch_view: dict, routing_gen: int):
         """The cached response dict, or None. A stale entry (routing or
-        epoch drift) is dropped on sight — never served."""
+        epoch drift) is never served FRESH; it lingers for
+        ``stale_retention_s`` AFTER FIRST BEING OBSERVED STALE (so the
+        shed path's bounded-staleness ``get_stale`` has something to
+        serve — an entry that was fresh for minutes before an epoch bump
+        still earns its full linger window) and is dropped past that."""
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
                 self.misses += 1
                 return None
             if not self._fresh(ent, epoch_view, routing_gen):
-                self._drop(key)
+                now = time.time()
+                stale_since = ent.setdefault("stale_since", now)
+                if now - stale_since > self.stale_retention_s:
+                    self._drop(key)
                 self.invalidations += 1
                 self.misses += 1
                 return None
+            # fresh again (e.g. the recorded epoch view re-validated):
+            # the entry is not on the stale clock anymore
+            ent.pop("stale_since", None)
             self._entries.move_to_end(key)
             self.hits += 1
             # deep copy both ways (here and in put): callers that post-
             # process a response in place (sorting rows, appending a
             # footer) must not poison the stored entry for later hits
             return copy.deepcopy(ent["resp"])
+
+    def get_stale(self, key: tuple, max_age_s: float):
+        """Bounded-staleness read for the load-shedding degradation path
+        (ISSUE 14): ``(response copy, age_s)`` when an entry exists no
+        older than ``max_age_s`` — REGARDLESS of epoch/routing freshness
+        (that's the contract: the client opted into ``maxStalenessMs``-
+        bounded data rather than a 429) — else ``(None, None)``. No LRU
+        touch: a shed query must not keep pinning the stale entry past
+        entries that still validate fresh."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None, None
+            age_s = max(0.0, time.time() - ent["ts"])
+            if age_s > max(0.0, max_age_s):
+                return None, None
+            self.stale_hits += 1
+            return copy.deepcopy(ent["resp"]), age_s
 
     def peek_fresh(self, key: tuple, epoch_view: dict,
                    routing_gen: int) -> bool:
@@ -143,4 +189,5 @@ class BrokerResultCache:
                 "hits": self.hits, "misses": self.misses,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "stale_hits": self.stale_hits,
             }

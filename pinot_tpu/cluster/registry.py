@@ -82,6 +82,14 @@ class SegmentState:
     OFFLINE = "OFFLINE"
 
 
+# heartbeat-staleness rule (ISSUE 14), single-sourced: an instance that
+# missed 3 heartbeat intervals (default 2 s cadence) is presumed
+# crashed/wedged. The broker's LoadTracker expires its load sample, the
+# controller autoscaler counts it as missing capacity, and the
+# /cluster/load endpoint renders it STALE — all off THIS constant.
+HB_STALE_S = 6.0
+
+
 @dataclasses.dataclass
 class InstanceInfo:
     instance_id: str
@@ -152,6 +160,7 @@ def _to_json(state: dict) -> dict:
         "task_metadata": state.get("task_metadata", {}),
         "segment_lineage": state.get("segment_lineage", {}),
         "replica_groups": state.get("replica_groups", {}),
+        "autoscaler": state.get("autoscaler", {}),
     }
 
 
@@ -172,6 +181,7 @@ def _from_json(d: dict) -> dict:
         "task_metadata": d.get("task_metadata", {}),
         "segment_lineage": d.get("segment_lineage", {}),
         "replica_groups": d.get("replica_groups", {}),
+        "autoscaler": d.get("autoscaler", {}),
     }
 
 
@@ -294,6 +304,19 @@ class ClusterRegistry:
             return out
 
         return self._tx_read(fn)
+
+    # ---- autoscaler state (ISSUE 14) -------------------------------------
+    def set_autoscaler_state(self, state: dict) -> None:
+        """Publish the controller autoscaler's current view (phase,
+        pressure, watermarks, last actions) so operators can read it from
+        ANY process — ``tools/clusterstat.py --load`` renders it. One
+        shared doc: a single controller leads the autoscale duty."""
+        self._tx(lambda s: (s.setdefault("autoscaler", {}).clear(),
+                            s["autoscaler"].update(dict(state))))
+
+    def autoscaler_state(self) -> dict:
+        return self._tx_read(
+            lambda s: dict(s.setdefault("autoscaler", {})))
 
     # ---- leases (controller HA: Helix leader-election role) --------------
     def try_acquire_lease(self, name: str, holder: str, ttl_ms: int) -> dict:
@@ -936,7 +959,7 @@ _SECTIONS = (
     "instances", "tables", "schemas", "segments", "assignment",
     "external_view", "partition_assignment", "segment_completion",
     "tasks", "task_metadata", "segment_lineage", "replica_groups",
-    "leases",
+    "leases", "autoscaler",
 )
 
 # sections whose change means "what a query routes to (or would read)

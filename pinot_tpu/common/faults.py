@@ -21,6 +21,15 @@ Points wired in this codebase:
     device.fetch         failure on the blocking device_get
     chunklet.promote     consuming-segment chunklet promotion failure
     peer.fetch           peer segment download failure
+    scheduler.admit      admission starvation (ISSUE 14; modes
+                         error|delay). Two seams share the point: the
+                         broker's tenant admission controller (target =
+                         tenant name) — an injected error sheds the
+                         query through the typed degrade-or-429 path —
+                         and the server's scheduler admission (target =
+                         instance id) — an injected error becomes a
+                         typed QUERY_SCHEDULING_TIMEOUT, never a hang
+                         or a transport fault
 
 Installation: programmatic (``install(Fault(...))`` — what the chaos
 suite uses), or the ``PINOT_TPU_FAULTS`` env var parsed once at first

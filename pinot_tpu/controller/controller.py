@@ -428,6 +428,10 @@ class Controller:
         self._ha_thread: Optional[threading.Thread] = None
         self._ha_stopped = False
         self._held_partitions: set = set()
+        # pressure-driven elasticity (ISSUE 14, controller/autoscaler.py)
+        # — attach_autoscaler wires it; run_autoscale rides the periodic
+        # loop as a cluster-wide (global-lead) duty
+        self.autoscaler = None
 
     def table_heat(self, table: str) -> dict:
         """Aggregated per-segment access temperature for ``table``
@@ -438,6 +442,25 @@ class Controller:
         """Aggregated per-segment tier view for ``table`` (ISSUE 12) —
         the GET /tables/{t}/tiers payload."""
         return aggregate_tiers(self.registry, table)
+
+    def attach_autoscaler(self, spawn_fn, drain_fn, **kwargs):
+        """Wire the pressure-driven autoscaler (ISSUE 14): ``spawn_fn()``
+        starts one more server (returns its instance id), ``drain_fn(id)``
+        gracefully drains one (PR 6's ServerInstance.stop contract).
+        Watermarks/sustain knobs ride ``kwargs`` — see
+        controller/autoscaler.py. Returns the attached instance."""
+        from pinot_tpu.controller.autoscaler import ControllerAutoscaler
+
+        self.autoscaler = ControllerAutoscaler(
+            self, spawn_fn, drain_fn, **kwargs)
+        return self.autoscaler
+
+    def run_autoscale(self):
+        """One autoscaler tick (periodic-loop step, global-lead only —
+        two controllers scaling the same fleet would double-spawn)."""
+        if self.autoscaler is None or not self._leads_global():
+            return None
+        return self.autoscaler.tick()
 
     def run_tier_rebalance(self) -> dict:
         """Tier-aware placement pass (ISSUE 12): replica-group tables
@@ -966,7 +989,8 @@ class Controller:
                          self.run_segment_relocation,
                          self.run_tier_rebalance]
                 if self._leads_global():
-                    steps += [self.run_task_generation, self.run_task_repair]
+                    steps += [self.run_task_generation, self.run_task_repair,
+                              self.run_autoscale]
                 for step in steps:
                     try:
                         step()

@@ -64,6 +64,44 @@ class ControllerHttpServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
+                if self.path.rstrip("/") == "/cluster/load":
+                    # per-instance pressure + heartbeat age + autoscaler
+                    # state (ISSUE 14) — the clusterstat --load payload.
+                    # Cluster-wide data: principals with table grant
+                    # lists are denied, like the broker's /metrics.
+                    if outer._access is not None and \
+                            outer._access.is_restricted(principal):
+                        self._send(403, {"error": "Permission denied: "
+                                                  "cluster load spans "
+                                                  "tables outside this "
+                                                  "principal's grants"})
+                        return
+                    import time as _time
+
+                    from pinot_tpu.cluster.registry import (
+                        HB_STALE_S,
+                        Role,
+                    )
+
+                    now_ms = _time.time() * 1000
+                    instances = {}
+                    for i in outer.registry.instances(Role.SERVER):
+                        age_ms = max(0.0, now_ms - i.last_heartbeat_ms)
+                        instances[i.instance_id] = {
+                            "pressure": float(
+                                getattr(i, "pressure", 0.0) or 0.0),
+                            "heartbeatAgeMs": round(age_ms, 1),
+                            # the shared 3-interval staleness rule
+                            # (registry HB_STALE_S — same cut the
+                            # LoadTracker and autoscaler apply)
+                            "live": age_ms <= HB_STALE_S * 1000.0,
+                            "endpoint": i.endpoint,
+                        }
+                    self._send(200, {
+                        "instances": instances,
+                        "autoscaler": outer.registry.autoscaler_state(),
+                    })
+                    return
                 if self.path == "/tables":
                     tables = outer.registry.tables()
                     if outer._access is not None:

@@ -30,6 +30,14 @@ class SchedulerSaturated(Exception):
     """Queue full: the caller should surface QUERY_SCHEDULING_TIMEOUT."""
 
 
+# priority class -> weighted-fair slot weight (ISSUE 14): one contract
+# end to end — the broker's admission controller scales tenant bucket
+# refill by these, ships the class in every instance request, and the
+# server's TokenBucketScheduler uses the same weight as the group's fair
+# slot share. interactive > dashboard > adhoc.
+PRIORITY_WEIGHTS = {"interactive": 4.0, "dashboard": 2.0, "adhoc": 1.0}
+
+
 class QueryScheduler:
     def __init__(self, max_concurrent: int = 8, max_queued: int = 32,
                  queue_timeout_s: float = 5.0):
@@ -54,14 +62,14 @@ class QueryScheduler:
             return self._running + self._waiting
 
     def run(self, fn, queue_timeout_s=None, group: str = "default",
-            stats_out=None):
+            stats_out=None, weight: float = 1.0):
         """Execute ``fn`` under the concurrency cap; raises
         SchedulerSaturated when the wait queue is full or the slot wait
         times out. ``queue_timeout_s`` lets a per-query deadline (SET
         timeoutMs) shrink the admission wait: a query whose budget elapsed
         queueing must not start and burn a worker nobody reads. ``group``
-        is ignored (FCFS); ``stats_out`` (dict) receives per-query
-        accounting: scheduler_wait_ms + thread_cpu_time_ns."""
+        and ``weight`` are ignored (FCFS); ``stats_out`` (dict) receives
+        per-query accounting: scheduler_wait_ms + thread_cpu_time_ns."""
         wait_s = self.queue_timeout_s if queue_timeout_s is None \
             else min(self.queue_timeout_s, queue_timeout_s)
         t_enq = time.perf_counter()
@@ -102,7 +110,13 @@ class QueryScheduler:
 
 
 class SchedulerGroup:
-    """One tenant's bucket (SchedulerGroup + TokenSchedulerGroup analog)."""
+    """One tenant's bucket (SchedulerGroup + TokenSchedulerGroup analog).
+
+    ``weight`` (ISSUE 14, priority classes): the group's weighted-fair
+    slot share — a weight-4 (interactive) tenant is entitled to 4x the
+    running slots of a weight-1 (adhoc) one before yielding. Updated to
+    the latest value each admission (the broker ships the query's
+    priority-class weight per request)."""
 
     def __init__(self, name: str, rate_ms_per_s: float, burst_ms: float):
         self.name = name
@@ -110,6 +124,7 @@ class SchedulerGroup:
         self.burst = burst_ms
         self.tokens = burst_ms  # start full: cold tenants get full burst
         self.last_refill = time.perf_counter()
+        self.weight = 1.0
         self.num_executed = 0
         self.num_rejected = 0
         self.cpu_ms_total = 0.0
@@ -184,11 +199,15 @@ class TokenBucketScheduler:
         return g
 
     def _my_turn(self, seq: int, name: str) -> bool:
-        """Highest-token group among waiters wins; FIFO inside a group.
+        """Weighted-fair slot pick (ISSUE 14): among waiters, the group
+        holding the smallest share of running slots RELATIVE TO ITS
+        WEIGHT goes first (running/weight — a weight-4 interactive tenant
+        may hold 4x the slots of a weight-1 adhoc one before yielding);
+        ties break by most remaining tokens, then FIFO inside a group.
         Waiters whose group is at its hard slot cap are not candidates;
         waiters whose group is overdrawn sit out until refill unless EVERY
-        remaining group is overdrawn — then plain FIFO avoids idling slots
-        the hardware could use."""
+        remaining group is overdrawn — then the weighted-fair order still
+        applies so slots the hardware could use never idle."""
         if self._running >= self.max_concurrent:
             return False
         now = time.perf_counter()
@@ -204,19 +223,28 @@ class TokenBucketScheduler:
                       if self._groups[n].tokens > 0]
         if not candidates:
             candidates = under_cap
+
+        def share(n: str) -> float:
+            g = self._groups[n]
+            return self._running_by_group.get(n, 0) / max(g.weight, 1e-9)
+
         best = min(candidates,
-                   key=lambda e: (-self._groups[e[1]].tokens, e[0]))
+                   key=lambda e: (share(e[1]),
+                                  -self._groups[e[1]].tokens, e[0]))
         return best == (seq, name)
 
     def run(self, fn, queue_timeout_s=None, group: str = "default",
-            stats_out=None):
+            stats_out=None, weight: float = 1.0):
         wait_s = self.queue_timeout_s if queue_timeout_s is None \
             else min(self.queue_timeout_s, queue_timeout_s)
         deadline = time.perf_counter() + wait_s
         with self._cond:
             # resolve to the EFFECTIVE group once (overflow sharing) so all
-            # later lookups agree
-            group = self._group(group).name
+            # later lookups agree; the query's priority-class weight
+            # becomes the group's weighted-fair share (latest wins)
+            g0 = self._group(group)
+            group = g0.name
+            g0.weight = max(float(weight), 1e-9)
             if len(self._waiters) >= self.max_queued:
                 self.num_rejected += 1
                 self._groups[group].num_rejected += 1
@@ -281,6 +309,7 @@ class TokenBucketScheduler:
                 g.refill(now)
                 out[name] = {
                     "tokens_ms": round(g.tokens, 1),
+                    "weight": g.weight,
                     "executed": g.num_executed,
                     "rejected": g.num_rejected,
                     "cpu_ms_total": round(g.cpu_ms_total, 1),

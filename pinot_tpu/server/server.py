@@ -341,18 +341,34 @@ class ServerInstance:
 
     @staticmethod
     def _scheduler_group(q, req: dict) -> str:
-        """Tenant key for token-bucket priority: the COMPILED table name
-        (TableBasedGroupMapper analog) — a regex over raw SQL would let a
-        literal containing " FROM x" misattribute the query to the wrong
-        bucket. Normalized (lowercase, physical-type suffix stripped) so
-        offline/realtime halves of one table share ONE bucket — distinct
-        raw strings would each mint a fresh full-burst group and defeat
-        fairness."""
+        """Tenant key for token-bucket priority. The broker-resolved
+        WORKLOAD (auth principal / SET workloadName — ISSUE 14) wins when
+        the instance request carries one, so the server's weighted-fair
+        slot accounting isolates TENANTS, not just tables. Fallback: the
+        COMPILED table name (TableBasedGroupMapper analog) — a regex over
+        raw SQL would let a literal containing " FROM x" misattribute the
+        query to the wrong bucket. Normalized (lowercase, physical-type
+        suffix stripped) so offline/realtime halves of one table share
+        ONE bucket — distinct raw strings would each mint a fresh
+        full-burst group and defeat fairness."""
+        wl = req.get("workload")
+        if wl:
+            return f"tenant:{str(wl).lower()}"
         name = (req.get("table") or q.table_name or "default").lower()
         for suffix in ("_offline", "_realtime"):
             if name.endswith(suffix):
                 name = name[: -len(suffix)]
         return name
+
+    @staticmethod
+    def _scheduler_weight(q, req: dict) -> float:
+        """Weighted-fair slot weight from the request's priority class
+        (broker-stamped; SET priorityClass covers direct submits).
+        Unknown/absent class = weight 1.0 — today's behavior exactly."""
+        from pinot_tpu.engine.scheduler import PRIORITY_WEIGHTS
+
+        prio = req.get("priority") or q.options_ci().get("priorityclass")
+        return PRIORITY_WEIGHTS.get(str(prio), 1.0) if prio else 1.0
 
     def _compile_admitted(self, sql: str, deadline: Deadline = None):
         """SQL compile bounded by a small semaphore (ADVICE r5): compile
@@ -439,6 +455,20 @@ class ServerInstance:
             # NOTE: the latency timer lives inside the launch/fetch pair —
             # wrapping the scheduler here would fold rejection queue-waits
             # into server.query and poison latency dashboards under load
+            if faults.ACTIVE:
+                # scheduler.admit chaos seam (ISSUE 14): starve admission
+                # deterministically — an injected error is a typed
+                # scheduling rejection (the server is healthy; the broker
+                # must see the same QUERY_SCHEDULING_TIMEOUT shape a real
+                # full queue produces, never a transport fault or a hang)
+                try:
+                    faults.inject("scheduler.admit",
+                                  target=self.instance_id,
+                                  bound_ms=None if deadline is None
+                                  else deadline.remaining_ms())
+                except faults.FaultInjected as e:
+                    raise SchedulerSaturated(
+                        f"admission starved (injected): {e}") from e
             acct: dict = {}
             finish = self.scheduler.run(
                 lambda: self._handle_submit_launch(req, q, acct, deadline,
@@ -446,7 +476,8 @@ class ServerInstance:
                 queue_timeout_s=None if deadline is None
                 else max(0.001, deadline.remaining_s()),
                 group=self._scheduler_group(q, req),
-                stats_out=acct)
+                stats_out=acct,
+                weight=self._scheduler_weight(q, req))
             # slot released: the link wait below must not hold admission
             return finish()
         except faults.FaultInjected:
@@ -558,7 +589,8 @@ class ServerInstance:
                 gate = (lambda fn: self.scheduler.run(
                     fn, queue_timeout_s=None if deadline is None
                     else max(0.001, deadline.remaining_s()),
-                    group=self._scheduler_group(q, req)))
+                    group=self._scheduler_group(q, req),
+                    weight=self._scheduler_weight(q, req)))
                 fetch_merged = self.engine.execute_segments_async(
                     q, segments, fallback_gate=gate, deadline=deadline,
                     tracer=tracer)
@@ -654,6 +686,7 @@ class ServerInstance:
                 queue_timeout_s=None if deadline is None
                 else max(0.001, deadline.remaining_s()),
                 group=self._scheduler_group(q, req),
+                weight=self._scheduler_weight(q, req),
             )
         except QueryTimeout as e:
             self.metrics.count("queryTimeouts")

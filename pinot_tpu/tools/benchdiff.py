@@ -35,7 +35,11 @@ Compared metric families (direction-aware):
 - the tiered-lifecycle phase (``tiering.per_tier.{hot,warm}.p50_ms`` +
   ``tiering.cold.hydrate_ms`` — lower is better — and
   ``tiering.peak_rss_delta_mb`` — lower is better — ISSUE 12), compared
-  only when BOTH rounds carry a ``detail.tiering`` section.
+  only when BOTH rounds carry a ``detail.tiering`` section,
+- the overload-survival phase (``overload.knee_qps`` — higher is
+  better — ``overload.p99_at_2x_knee_ms`` and
+  ``overload.tenant_b.spike_p99_ms`` — lower is better — ISSUE 14),
+  compared only when BOTH rounds carry a ``detail.overload`` section.
 """
 
 from __future__ import annotations
@@ -47,7 +51,7 @@ import sys
 # sections brace-matched out of a truncated driver-wrapper tail
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
-                  "cluster", "breakdown", "roofline", "tiering")
+                  "cluster", "breakdown", "roofline", "tiering", "overload")
 
 
 def _brace_match(text: str, key: str):
@@ -215,6 +219,24 @@ def extract_metrics(detail: dict) -> dict:
         v = _num(tier.get("peak_rss_delta_mb"))
         if v is not None:
             out["tiering.peak_rss_delta_mb"] = (v, "lower")
+    # overload-survival phase (ISSUE 14): the knee of the arrival-rate
+    # ladder (higher is better), the p99 the cluster holds at 2x that
+    # knee and the isolated tenant's p99 delta under the 10x spike
+    # (lower is better), compared only when both rounds ran the phase;
+    # shed/stale counts are load-dependent and stay informational
+    ov = detail.get("overload")
+    if isinstance(ov, dict):
+        v = _num(ov.get("knee_qps"))
+        if v is not None:
+            out["overload.knee_qps"] = (v, "higher")
+        v = _num(ov.get("p99_at_2x_knee_ms"))
+        if v is not None:
+            out["overload.p99_at_2x_knee_ms"] = (v, "lower")
+        tb = ov.get("tenant_b")
+        if isinstance(tb, dict):
+            v = _num(tb.get("spike_p99_ms"))
+            if v is not None:
+                out["overload.tenant_b.spike_p99_ms"] = (v, "lower")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of

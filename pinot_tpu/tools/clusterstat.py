@@ -12,6 +12,10 @@ Options:
     --top N        segments to print per table (default 10)
     --tiers        also fetch /tables/{t}/tiers and print each segment's
                    tier (hot/warm/cold, ISSUE 12) next to its heat
+    --load         fetch /cluster/load instead: per-instance scheduler
+                   pressure, heartbeat age/liveness, and the controller
+                   autoscaler's state (watermarks, sustain counters,
+                   last scale action — ISSUE 14)
     --user u:p     basic auth for an ACL'd controller
     --json         machine-readable output (one dict)
 """
@@ -52,6 +56,44 @@ def gather(base_url: str, table: str = None, user: str = None,
             doc["tiers"] = _get(base_url, f"/tables/{t}/tiers", user)
         out[t] = doc
     return out
+
+
+def gather_load(base_url: str, user: str = None) -> dict:
+    """The controller's /cluster/load doc (ISSUE 14): per-instance
+    pressure + heartbeat ages + autoscaler state."""
+    return _get(base_url, "/cluster/load", user)
+
+
+def render_load(doc: dict) -> str:
+    lines = []
+    insts = doc.get("instances") or {}
+    lines.append(f"{len(insts)} server instance(s):")
+    for name in sorted(insts):
+        rec = insts[name]
+        live = "live" if rec.get("live") else "STALE"
+        lines.append(
+            f"  {name}: pressure={rec.get('pressure')} "
+            f"hb={rec.get('heartbeatAgeMs')}ms [{live}] "
+            f"endpoint={rec.get('endpoint')}")
+    a = doc.get("autoscaler") or {}
+    if not a:
+        lines.append("autoscaler: not attached")
+        return "\n".join(lines)
+    lines.append(
+        f"autoscaler: {a.get('servers')} server(s) "
+        f"[{a.get('min')}..{a.get('max')}] "
+        f"meanPressure={a.get('meanPressure')} "
+        f"water={a.get('lowWater')}/{a.get('highWater')} "
+        f"sustain(above={a.get('aboveTicks')}, below={a.get('belowTicks')}, "
+        f"cooldown={a.get('cooldownTicks')}) "
+        f"scaleOuts={a.get('scaleOuts')} scaleIns={a.get('scaleIns')}")
+    last = a.get("lastAction")
+    if last:
+        lines.append(f"  last action: {last.get('action')} "
+                     f"{last.get('instance')} -> "
+                     f"{last.get('servers_after')} servers "
+                     f"(pressure {last.get('mean_pressure')})")
+    return "\n".join(lines)
 
 
 def render(heat_by_table: dict, top: int = 10, now: float = None,
@@ -99,16 +141,27 @@ def main(argv=None) -> int:
     ap.add_argument("--tiers", action="store_true",
                     help="show each segment's hot/warm/cold tier next to "
                          "its heat (ISSUE 12 lifecycle view)")
+    ap.add_argument("--load", action="store_true", dest="load",
+                    help="show per-instance pressure, heartbeat "
+                         "liveness, and autoscaler state instead of "
+                         "segment heat (ISSUE 14 overload view)")
     ap.add_argument("--user", default=None, help="basic auth user:pass")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     try:
-        heat = gather(args.controller, table=args.table, user=args.user,
-                      tiers=args.tiers)
+        if args.load:
+            doc = gather_load(args.controller, user=args.user)
+        else:
+            heat = gather(args.controller, table=args.table,
+                          user=args.user, tiers=args.tiers)
     except (urllib.error.URLError, OSError, ValueError) as e:
         print(f"cannot reach controller {args.controller}: {e}",
               file=sys.stderr)
         return 2
+    if args.load:
+        print(json.dumps(doc, indent=2) if args.as_json
+              else render_load(doc))
+        return 0
     if args.as_json:
         print(json.dumps(heat, indent=2))
     else:

@@ -34,7 +34,8 @@ def make_instance_request(sql: str, segments: list, request_id: int,
                           broker_id: str = "", trace: bool = False,
                           table: str = None, time_filter: dict = None,
                           timeout_ms: float = None, trace_id: str = None,
-                          attempt: str = "primary") -> bytes:
+                          attempt: str = "primary", workload: str = None,
+                          priority: str = None) -> bytes:
     """``table``: physical table override (hybrid split sends the same SQL to
     X_OFFLINE and X_REALTIME); ``time_filter``: {column, op le|gt, value}
     AND-ed server-side (the time-boundary predicate); ``timeout_ms``: the
@@ -47,7 +48,13 @@ def make_instance_request(sql: str, segments: list, request_id: int,
     (the reference's InstanceRequest ``enableTrace`` + requestId): when
     the query runs with SET trace=true the broker sets traceEnabled on
     EVERY attempt — primary, retry, or hedge, ``attempt`` naming which —
-    so the per-server span ladders all join one trace id."""
+    so the per-server span ladders all join one trace id.
+
+    ``workload``/``priority`` (ISSUE 14): the broker-resolved tenant and
+    priority class — the server's weighted-fair scheduler groups slots
+    by the TENANT (falling back to the table name when absent) so one
+    tenant cannot hold every server slot, and the class weight sets the
+    group's fair share."""
     return json.dumps(
         {
             "sql": sql,
@@ -60,6 +67,8 @@ def make_instance_request(sql: str, segments: list, request_id: int,
             "table": table,
             "timeFilter": time_filter,
             "timeoutMs": timeout_ms,
+            "workload": workload,
+            "priority": priority,
         }
     ).encode("utf-8")
 

@@ -668,3 +668,103 @@ class TestChunkletPromotionFault:
             seg, OneBatchConsumer(), lambda p: _json.loads(p.decode()), 0)
         assert indexed == 1024 and next_off == 1024
         assert seg.n_docs == 1024  # rows survived the failed promotion
+
+
+# ---------------------------------------------------------------------------
+# scheduler.admit: admission starvation (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionFaults:
+    """The ``scheduler.admit`` injection point (modes error|delay) starves
+    admission deterministically at BOTH seams — the broker's tenant
+    admission controller (target = tenant) and the server's scheduler
+    admission (target = instance id). Contract: typed 429 / degraded /
+    QUERY_SCHEDULING_TIMEOUT responses, bounded latency, never a hang."""
+
+    def test_broker_admission_fault_typed_429(self, cluster, tmp_path):
+        registry, controller, servers, _b = cluster
+        _push_table(tmp_path, controller, registry)
+        from pinot_tpu.broker.admission import TenantAdmissionController
+
+        broker = Broker(registry, timeout_s=10.0,
+                        admission=TenantAdmissionController())
+        try:
+            faults.install(faults.Fault(point="scheduler.admit",
+                                        target="tenantA", mode="error"))
+            t0 = time.perf_counter()
+            r = broker.execute("SET workloadName='tenantA'; " + SQL)
+            took = time.perf_counter() - t0
+            assert r["exceptions"][0]["errorCode"] == 429, r
+            assert r["sheddingReason"] == "admission_fault"
+            assert r["tenant"] == "tenantA"
+            assert 0 < r["retryAfterSeconds"] <= 5
+            assert took < 2.0, "admission fault must answer immediately"
+            # an unmatched tenant is untouched by the armed fault
+            rb = broker.execute("SET workloadName='tenantB'; " + SQL)
+            assert not rb.get("exceptions"), rb
+        finally:
+            broker.close()
+
+    def test_broker_admission_fault_degrades_to_stale(self, cluster,
+                                                      tmp_path):
+        """With ``maxStalenessMs`` allowed, a starved admission degrades
+        to a flagged stale cache read instead of a 429 — chaos proves the
+        brownout path end to end."""
+        registry, controller, servers, _b = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        from pinot_tpu.broker.admission import TenantAdmissionController
+
+        broker = Broker(registry, timeout_s=10.0, result_cache=True,
+                        admission=TenantAdmissionController())
+        try:
+            # warm the cache BEFORE arming chaos (the fresh path opts out
+            # while faults are active; the shed path must still find it)
+            warm = broker.execute("SET workloadName='tenantA'; " + SQL)
+            assert not warm.get("exceptions"), warm
+            faults.install(faults.Fault(point="scheduler.admit",
+                                        target="tenantA", mode="error"))
+            r = broker.execute("SET workloadName='tenantA'; "
+                               "SET maxStalenessMs=60000; " + SQL)
+            assert r.get("servedStale") is True, r
+            assert r["sheddingReason"] == "admission_fault"
+            assert r["resultTable"]["rows"][0] == [n_rows, total]
+            assert 0 <= r["staleAgeMs"] <= 60000
+        finally:
+            broker.close()
+
+    def test_server_admission_starved_typed_never_hangs(self, cluster,
+                                                        tmp_path):
+        """Every server's admission starved: the broker answers a typed
+        in-band scheduling error (the server is healthy — no detector
+        poisoning, no transport fault, no hang)."""
+        registry, controller, servers, broker = cluster
+        _push_table(tmp_path, controller, registry)
+        faults.install(faults.Fault(point="scheduler.admit",
+                                    target="server_", mode="error"))
+        t0 = time.perf_counter()
+        r = broker.execute(SQL)
+        took = time.perf_counter() - t0
+        excs = r.get("exceptions") or []
+        assert excs, r
+        assert "QUERY_SCHEDULING_TIMEOUT" in excs[0]["message"]
+        assert took < 5.0, "starved admission must not hang"
+        # the detector was not poisoned: the next (fault-free) query
+        # routes and completes normally
+        faults.clear()
+        ok = broker.execute(SQL)
+        assert not ok.get("exceptions"), ok
+
+    def test_server_admission_delay_slows_but_succeeds(self, cluster,
+                                                       tmp_path):
+        registry, controller, servers, broker = cluster
+        total, n_rows = _push_table(tmp_path, controller, registry)
+        faults.install(faults.Fault(point="scheduler.admit",
+                                    target="server_", mode="delay",
+                                    delay_ms=300))
+        t0 = time.perf_counter()
+        r = broker.execute(SQL)
+        took = time.perf_counter() - t0
+        assert not r.get("exceptions"), r
+        assert r["resultTable"]["rows"][0] == [n_rows, total]
+        assert took >= 0.25, "the admission delay must actually bite"
