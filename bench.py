@@ -737,6 +737,57 @@ def bench_micro():
         lambda p: jnp.sum(unpack_subbyte(p, 4) == 3, dtype=jnp.int64),
         packed_nu), N // 2)
 
+    # ---- Pallas scatter tier (ISSUE 15, ops/pallas_scatter.py) -----------
+    # the purpose-built replacements for the serialized XLA scatters
+    # above; each micro runs at the shape its scatter reference ran, so
+    # the tier's >=10x acceptance reads straight off this table
+    from pinot_tpu.ops import pallas_scatter as ps
+
+    # tiled local-accumulate group scatter at the scatter_group_sum shape
+    # (G=2000; count channel folded + 2 int byte planes)
+    def pallas_gs(g, x):
+        chans = jnp.stack(
+            [jnp.ones(N, jnp.bfloat16)]
+            + mm.int_planes(x.astype(jnp.int64), jnp.int64(0), 2))
+        return ps.plane_group_sums(g, chans, G, first_channel_ones=True)
+    rec("pallas_group_scatter", devtime(pallas_gs, gid, v, iters=3), 8 * N)
+
+    # HLL register-max scatter at the scalar-HLL shape (m = 1024 slots —
+    # the kernel's regime; group-by register spaces past
+    # ps.HLL_MAX_SLOTS stay on the sorted dedup basis)
+    def pallas_hll(hh):
+        idx, rho = hll_ops.hll_idx_rho(hh, LOG2M)
+        return ps.hll_register_max(idx, rho, m, 33 - LOG2M)
+    rec("pallas_hll_max", devtime(pallas_hll, h, iters=3), 4 * N)
+
+    # fused filter+gather+aggregate over a ~1.6% candidate block set:
+    # scalar-prefetched indices drive the DMA, so no (B, R) gather
+    # buffer ever hits HBM. Rate is rows COVERED per second (the dense
+    # scan this replaces reads all N rows), like blockskip_compact.
+    R_F = ps.FUSED_BLOCK_ROWS
+    nb_f = (N // R_F)
+    bound_f = max(1, nb_f // bs_ops.CAND_FRACTION)
+    fwidths = {"c": ("uint16", 0, False, None)}
+    fplan = ps.plan_fused(
+        ("range_raw", ("raw", "c"), "plo", "phi", True, True, True, True),
+        (("count", None, None), ("sum", ("raw", "c"), (2, 1 << 20))),
+        fwidths)
+    assert fplan is not None
+    x16 = jax.jit(lambda x: (x[: nb_f * R_F] & 0xFFFF).astype(jnp.uint16)
+                  .reshape(nb_f, R_F // 128, 128))(v)
+    cand_f = jax.jit(lambda _: (
+        jnp.arange(bound_f, dtype=jnp.int32) * bs_ops.CAND_FRACTION) % nb_f)(0)
+    rows_f = jax.jit(lambda _: jnp.full(bound_f, R_F, jnp.int32))(0)
+    jax.device_get(jnp.sum(x16[:1, :1, :1]))
+
+    def pallas_fused(xc, cd, rw):
+        return ps.fused_filter_agg(
+            cd, rw, {"c": xc},
+            {"plo": jnp.array([100], jnp.int32),
+             "phi": jnp.array([60000], jnp.int32)}, fplan)[0]
+    rec("pallas_fused_filter_agg",
+        devtime(pallas_fused, x16, cand_f, rows_f, iters=3), 2 * N)
+
     # on-device final reduce: sort-based ORDER BY trim over a group table
     # (ops/device_reduce.py — the kernel that replaced the host
     # BrokerReduceService walk + full-table fetch)
@@ -1209,6 +1260,15 @@ _MICRO_R05_REFERENCE = {
     # unpack + EQ mask reads 0.5 bytes/row — conservative embedded floor
     # until a recorded reference takes over
     "narrow_unpack": 800.0,
+    # first recorded round 15 (Pallas scatter tier): embedded floors
+    # encode the tier's >=10x acceptance against the r05 scatter
+    # references at the SAME shapes (scatter_group_sum 84.9,
+    # hll_register_scatter 149.0) until a recorded reference takes over;
+    # the fused micro floors at 2x blockskip_compact (it reads the same
+    # ~1/16 candidate fraction but skips the gather round trip)
+    "pallas_group_scatter": 849.0,
+    "pallas_hll_max": 1490.0,
+    "pallas_fused_filter_agg": 1000.0,
     # first recorded round 12 (sub-RTT serving): the on-device final
     # reduce's sort-based top-K over a 4M-row group table (3 sort
     # operands + trimmed gather). Conservative embedded floor — a 2-core

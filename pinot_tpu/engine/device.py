@@ -234,17 +234,31 @@ def _legacy_rpb(extra):
     return extra[1] if isinstance(extra, tuple) else extra
 
 
-def _hll_regs(slot, rho, num_groups, log2m, mm_mode):
-    """(num_groups, m) HLL registers: matmul threshold-channel build when
-    VMEM allows, else the scatter-max (both exact max-of-rho). Returned as
-    int8 (rho <= 33 - log2m < 127): the register matrix rides the
-    device->host tunnel 4x smaller — ~450ms saved per 2000-group query."""
+def _hll_regs(slot, rho, num_groups, log2m, mm_mode, pallas_mode="off"):
+    """(num_groups, m) HLL registers: the Pallas register-max scatter
+    (ops/pallas_scatter.py — partitioned presence channels, ISSUE 15)
+    when the slot space is in its regime, else the matmul threshold-
+    channel build when VMEM allows, else the scatter-max (all exact
+    max-of-rho, bit-identical). Returned as int8 (rho <= 33 - log2m <
+    127): the register matrix rides the device->host tunnel 4x smaller
+    — ~450ms saved per 2000-group query."""
     from pinot_tpu.ops import groupby_mm as mm
 
     m = 1 << log2m
     n_total = 1
     for d in slot.shape:
         n_total *= d
+    if pallas_mode != "off":
+        from pinot_tpu.ops import pallas_scatter as ps
+
+        nrho = mm.hll_nrho(log2m)
+        if ps.hll_supported(num_groups * m, nrho) and (
+                pallas_mode == "interpret"
+                or n_total >= ps.PALLAS_MIN_ROWS):
+            regs = ps.hll_register_max(
+                slot, rho, num_groups * m, nrho,
+                interpret=(pallas_mode == "interpret"))
+            return regs.reshape(num_groups, m).astype(jnp.int8)
     use_mm = (
         mm_mode != "off"
         and mm.hll_supported(num_groups, log2m)
@@ -264,20 +278,23 @@ def _hll_regs(slot, rho, num_groups, log2m, mm_mode):
 
 
 def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs,
-                    widths=None):
-    """Route COUNT/SUM/AVG through ONE factored one-hot matmul launch
-    (ops/groupby_mm.py) when eligible. Fills outs["gcount"] +
-    outs[f"a{i}_sum"] and returns the set of agg indexes handled; scatter
-    code covers the rest. All decisions are trace-time static."""
+                    widths=None, pallas_mode="off"):
+    """Route COUNT/SUM/AVG through ONE factored one-hot launch when
+    eligible: the Pallas tiled local-accumulate scatter
+    (ops/pallas_scatter.py plane_group_sums — group-range partitioned,
+    so its coverage extends past the single-VMEM-accumulator ceiling)
+    when the pallas tier is on, else the single-accumulator matmul
+    kernel (ops/groupby_mm.py). Fills outs["gcount"] +
+    outs[f"a{i}_sum"] and returns the set of agg indexes handled;
+    scatter code covers the rest. All decisions are trace-time static."""
     from pinot_tpu.ops import groupby_mm as mm
+    from pinot_tpu.ops import pallas_scatter as ps
 
-    if mm_mode == "off":
+    if mm_mode == "off" and pallas_mode == "off":
         return set()
     n_total = 1
     for d in gid.shape:
         n_total *= d
-    if mm_mode == "tpu" and n_total < mm.MM_MIN_ROWS:
-        return set()
 
     # plan: which aggs become channels, and how many
     plans = []  # (i, kind, nplanes, values)
@@ -297,7 +314,18 @@ def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs,
             continue
         plans.append((i, kind, nplanes, v))
         total_ch += nplanes
-    if not mm.mm_supported(num_groups, total_ch - 1):
+    use_pallas = (
+        pallas_mode != "off"
+        and ps.sums_supported(num_groups, total_ch)
+        and (pallas_mode == "interpret" or n_total >= ps.PALLAS_MIN_ROWS)
+    )
+    use_mm = (
+        not use_pallas
+        and mm_mode != "off"
+        and mm.mm_supported(num_groups, total_ch - 1)
+        and (mm_mode == "interpret" or n_total >= mm.MM_MIN_ROWS)
+    )
+    if not use_pallas and not use_mm:
         return set()
     has_count_or_avg = any(a[0] in ("count", "avg") for a in aggs)
     if not plans and not has_count_or_avg:
@@ -316,10 +344,17 @@ def _try_mm_groupby(aggs, gid, cols, params, num_groups, mm_mode, outs,
         specs.append((i, kind, slice(row, row + nplanes)))
         row += nplanes
 
-    sums = mm.group_sums(
-        gid.reshape(-1), jnp.stack(channels), num_groups,
-        interpret=(mm_mode == "interpret"), first_channel_ones=True,
-    )
+    if use_pallas:
+        sums = ps.plane_group_sums(
+            gid.reshape(-1), jnp.stack(channels), num_groups,
+            interpret=(pallas_mode == "interpret"),
+            first_channel_ones=True,
+        )
+    else:
+        sums = mm.group_sums(
+            gid.reshape(-1), jnp.stack(channels), num_groups,
+            interpret=(mm_mode == "interpret"), first_channel_ones=True,
+        )
     gcount = jnp.round(sums[0]).astype(jnp.int64)
     outs["gcount"] = gcount
     done = set()
@@ -337,6 +372,97 @@ def _resolve_mm_mode(mm_mode: str) -> str:
     if mm_mode == "auto":
         return "tpu" if jax.default_backend() == "tpu" else "off"
     return mm_mode
+
+
+def _template_uses_pallas(template, widths, fused: bool,
+                          pallas_mode: str = "interpret",
+                          n_total: int | None = None) -> bool:
+    """Static: does this template route at least one op to the Pallas
+    tier?  Gates the roofline label's "+pallas" suffix AND the failure
+    attribution of launch()'s fallback ladder — a pipeline that compiles
+    ZERO Pallas kernels (the sorted radix regime, plain scalar
+    aggregations, out-of-regime group counts, sub-PALLAS_MIN_ROWS
+    batches on TPU) must not be attributed to the tier, or
+    roofline/EXPLAIN ANALYZE rows silently change between tier-on and
+    tier-off rounds and a device failure burns a Pallas-rung drop on a
+    byte-identical recompile. Mirrors the trace-time routing
+    conservatively: dtypes of computed expressions are unknowable here
+    and count as routed. ``n_total``: batch rows (S * L) — the same
+    minimum-rows gate every routing site applies outside interpret
+    mode (None = unknown, treated as large)."""
+    from pinot_tpu.ops import groupby_mm as mmod
+    from pinot_tpu.ops import pallas_scatter as ps
+
+    if fused:
+        return True  # the fused kernel has no minimum-rows gate
+    if pallas_mode != "interpret" and n_total is not None \
+            and n_total < ps.PALLAS_MIN_ROWS:
+        return False
+    shape, _ft, _gc, group_cards, agg_tpls, _sk, _final = template
+
+    def _arg_dtype(argt):
+        ck = ps._direct_colkey(argt)
+        w = (widths or {}).get(ck) if ck else None
+        if w is None:
+            return None
+        return np.dtype(w[3]) if w[3] else np.dtype(w[0])
+
+    if shape == "agg":
+        # scalar shape: only the HLL register-max routes (scalar
+        # min/max/sum are dense reductions, never scatters)
+        return any(
+            name == "distinctcounthll"
+            and ps.hll_supported(1 << extra, mmod.hll_nrho(extra))
+            for name, _a, extra in agg_tpls)
+    if shape != "groupby":
+        return False  # the sorted radix regime never consults the tier
+    num_groups = 1
+    for c in group_cards:
+        num_groups *= c
+    for name, argt, extra in agg_tpls:
+        if name in ("count", "sum", "avg"):
+            if ps.sums_supported(num_groups, 2):
+                return True
+        elif name in ("min", "max", "minmaxrange"):
+            dt = _arg_dtype(argt) or np.dtype(np.int32)
+            if ps.minmax_supported(num_groups, dt):
+                return True
+        elif name == "distinctcounthll":
+            if ps.hll_supported(num_groups * (1 << extra),
+                                mmod.hll_nrho(extra)):
+                return True
+    return False
+
+
+def _group_extreme(gid, v, num_groups: int, ops: tuple, pallas_mode: str):
+    """Per-group min/max: the Pallas masked-select scatter
+    (ops/pallas_scatter.py group_minmax — the aggregation family with no
+    MXU identity) when the value dtype and group count are in its
+    regime, else the XLA scatter. Empty-group fills come from the
+    ORIGINAL value dtype's extremes on both paths, so results are
+    bit-identical."""
+    from pinot_tpu.ops import pallas_scatter as ps
+
+    n_total = 1
+    for d in v.shape:
+        n_total *= d
+    if (pallas_mode != "off" and ps.minmax_supported(num_groups, v.dtype)
+            and (pallas_mode == "interpret"
+                 or n_total >= ps.PALLAS_MIN_ROWS)):
+        if jnp.issubdtype(v.dtype, jnp.integer):
+            info = jnp.iinfo(v.dtype)
+            fills = tuple(info.max if op == "min" else info.min
+                          for op in ops)
+        else:
+            fills = tuple(agg_ops.POS_INF if op == "min" else
+                          agg_ops.NEG_INF for op in ops)
+        res = ps.group_minmax(gid, v, num_groups, ops,
+                              interpret=(pallas_mode == "interpret"),
+                              fills=fills)
+        return tuple(r.astype(v.dtype) for r in res)
+    return tuple(
+        agg_ops.group_min(gid, v, num_groups) if op == "min"
+        else agg_ops.group_max(gid, v, num_groups) for op in ops)
 
 
 def _finalize_sketch_outs(outs, agg_tpls):
@@ -601,7 +727,7 @@ def _unpack_outs(bufs: dict, layout) -> dict:
 
 def build_pipeline(template, mm_mode: str = "auto",
                    sorted_hll_ok: bool = False, blockskip: bool = False,
-                   widths=None):
+                   widths=None, pallas_mode: str = "off"):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
     ``mm_mode``: "auto" → the factored one-hot matmul kernel
@@ -633,12 +759,29 @@ def build_pipeline(template, mm_mode: str = "auto",
     kernel-parity tests build directly). The executor folds the same
     mapping into its pipeline cache key, so one compiled template serves
     exactly the batches that share its width plan.
+
+    ``pallas_mode``: "off" (the XLA scatter reference — the default, and
+    the form the PINOT_TPU_PALLAS=0 / SET usePallas=false escape hatch
+    and the quarantine XLA rung compile), "tpu", or "interpret" (CPU
+    tests) — routes the scatter-bound ops through the Pallas kernel tier
+    (ops/pallas_scatter.py): tiled local-accumulate group sums, min/max
+    scatter, HLL register-max, and the fused filter+gather+aggregate
+    form of the block-skip path.
     """
     shape, filter_tpl, group_cols, group_cards, aggs, sorted_k, _final = template
     mm_mode = _resolve_mm_mode(mm_mode)
     num_groups = 1
     for c in group_cards:
         num_groups *= c
+    fused_plan = None
+    if pallas_mode != "off" and blockskip and shape == "agg":
+        from pinot_tpu.ops import pallas_scatter as ps_ops
+
+        # the fused kernel gathers ONE zone block per grid step: a
+        # retuned ZONE_BLOCK_ROWS must decline the plan, not silently
+        # read a FUSED_BLOCK_ROWS prefix of every candidate block
+        if bs_ops.BLOCK_ROWS == ps_ops.FUSED_BLOCK_ROWS:
+            fused_plan = ps_ops.plan_fused(filter_tpl, aggs, widths or {})
 
     def _kfactor(key: str) -> int:
         """ids per stored byte-axis element (sub-byte plans pack 8//bits
@@ -702,7 +845,90 @@ def build_pipeline(template, mm_mode: str = "auto",
         n_cand = jnp.sum(flat, dtype=jnp.int32)
         cand, cand_valid = bs_ops.compact_candidates(flat, B)
 
+        def fused_skip(ps_ops):
+            """Fused filter+gather+aggregate (ops/pallas_scatter.py): the
+            kernel's scalar-prefetched candidate indices drive its DMA,
+            so the (B, R) gather buffer the generic branch materializes
+            never exists. Aggregation runs over STORAGE-space values;
+            decode (widening + frame-of-reference offsets) applies to the
+            answer-scale per-block partials here — Σ(v+fo) = Σv + fo·n
+            and min(v+fo) = min(v)+fo are exact — so the leaves match the
+            dense branch's dtypes and values bit-for-bit (lax.cond
+            requires the former; the differential suite pins the
+            latter)."""
+            seg_of = cand // NB
+            rows_in = jnp.where(
+                cand_valid,
+                jnp.clip(n_docs[seg_of] - (cand % NB) * R, 0, R),
+                0).astype(jnp.int32)
+            col_arrays = {
+                key: data_cols[key].reshape(S * NB, R // 128, 128)
+                for key in fused_plan.cols}
+            par_arrays = {}
+            for key, (ck, kindp) in fused_plan.pred_params.items():
+                p = params[key].reshape(-1)
+                if kindp == "storage":
+                    w = widths.get(ck)
+                    p64 = p.astype(jnp.int64)
+                    if w[2]:
+                        fo = params.get("fo::" + ck)
+                        if fo is not None:
+                            p64 = p64 - fo.astype(jnp.int64)
+                    # clip into the plane's value range ±1: storage values
+                    # are a strict subset, so every comparison survives
+                    info = np.iinfo(np.dtype(w[0]))
+                    p64 = jnp.clip(p64, int(info.min) - 1,
+                                   int(info.max) + 1)
+                    par_arrays[key] = p64.astype(jnp.int32)
+                else:
+                    par_arrays[key] = p.astype(jnp.int32)
+            ints, flts = ps_ops.fused_filter_agg(
+                cand, rows_in, col_arrays, par_arrays, fused_plan,
+                interpret=(pallas_mode == "interpret"))
+            block_matched = ints[:, 0].astype(jnp.int64)
+            seg_matched = jnp.zeros(S + 1, dtype=jnp.int64).at[
+                jnp.where(cand_valid, seg_of, S)].add(block_matched)[:S]
+            outs = _stat_outs(
+                seg_matched, jnp.sum(rows_in, dtype=jnp.int64),
+                blocks_total, n_cand.astype(jnp.int64))
+            dc = outs["doc_count"]
+            by_idx: dict = {}
+            for spec in fused_plan.aggs:
+                by_idx.setdefault(spec[0], []).append(spec)
+            for i, (name, argt, extra) in enumerate(aggs):
+                k = f"a{i}"
+                if name == "count" or i not in by_idx:
+                    continue
+                for (_i, op, ck, buf, slot, _fill) in by_idx[i]:
+                    w = widths.get(ck)
+                    wide = jnp.dtype(w[3]) if w[3] else jnp.dtype(w[0])
+                    fo = params.get("fo::" + ck) if w[2] else None
+                    if op == "sum":
+                        s = jnp.sum(ints[:, slot].astype(jnp.int64))
+                        if fo is not None:
+                            s = s + fo.astype(jnp.int64) * dc
+                        outs[f"{k}_sum"] = s
+                    elif buf == "int":
+                        col = ints[:, slot]
+                        red = (col.min() if op == "min" else
+                               col.max()).astype(wide)
+                        if fo is not None:
+                            red = red + fo
+                        info = jnp.iinfo(wide)
+                        empty = info.max if op == "min" else info.min
+                        outs[f"{k}_{op}"] = jnp.where(dc > 0, red, empty)
+                    else:
+                        col = flts[:, slot]
+                        red = col.min() if op == "min" else col.max()
+                        outs[f"{k}_{op}"] = red.astype(wide)
+            return outs
+
         def skip():
+            if fused_plan is not None:
+                from pinot_tpu.ops import pallas_scatter as ps_ops
+
+                if ps_ops.fused_params_ok(fused_plan, params):
+                    return fused_skip(ps_ops)
             seg_of = cand // NB
             row_idx = ((cand % NB) * R)[:, None] \
                 + jnp.arange(R, dtype=jnp.int32)[None, :]
@@ -831,7 +1057,8 @@ def build_pipeline(template, mm_mode: str = "auto",
             per_col = [_ids_col(cols, c, widths) for c in group_cols]
             gid = agg_ops.group_ids_combine(per_col, group_cards, mask, num_groups)
             mm_done = _try_mm_groupby(
-                aggs, gid, cols, params, num_groups, mm_mode, outs, widths
+                aggs, gid, cols, params, num_groups, mm_mode, outs, widths,
+                pallas_mode=pallas_mode,
             )
             if "gcount" not in outs:
                 outs["gcount"] = agg_ops.group_count(gid, num_groups)
@@ -845,14 +1072,16 @@ def build_pipeline(template, mm_mode: str = "auto",
                     outs[f"{k}_sum"] = agg_ops.group_sum(gid, v, num_groups, rpb)
                 elif name == "min":
                     v = _eval_expr(argt, cols, params, widths)
-                    outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
+                    outs[f"{k}_min"], = _group_extreme(
+                        gid, v, num_groups, ("min",), pallas_mode)
                 elif name == "max":
                     v = _eval_expr(argt, cols, params, widths)
-                    outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
+                    outs[f"{k}_max"], = _group_extreme(
+                        gid, v, num_groups, ("max",), pallas_mode)
                 elif name == "minmaxrange":
                     v = _eval_expr(argt, cols, params, widths)
-                    outs[f"{k}_min"] = agg_ops.group_min(gid, v, num_groups)
-                    outs[f"{k}_max"] = agg_ops.group_max(gid, v, num_groups)
+                    outs[f"{k}_min"], outs[f"{k}_max"] = _group_extreme(
+                        gid, v, num_groups, ("min", "max"), pallas_mode)
                 elif name == "distinctcount":
                     card = extra
                     # ids widen in-register: uint8 * weak-int arithmetic
@@ -889,7 +1118,8 @@ def build_pipeline(template, mm_mode: str = "auto",
                         slot = jnp.where(mask, gid * m + idx,
                                          num_groups * m)
                         outs[f"{k}_regs"] = _hll_regs(
-                            slot, rho, num_groups, log2m, mm_mode
+                            slot, rho, num_groups, log2m, mm_mode,
+                            pallas_mode,
                         )
                 elif name == "hllmerge":
                     # cube rows carry whole register planes: scatter-max the
@@ -941,7 +1171,8 @@ def build_pipeline(template, mm_mode: str = "auto",
                 h = cols["hh::" + argt]
                 idx, rho = hll_ops.hll_idx_rho(h, log2m)
                 slot = jnp.where(mask, idx, m)
-                outs[f"{k}_regs"] = _hll_regs(slot, rho, 1, log2m, mm_mode)[0]
+                outs[f"{k}_regs"] = _hll_regs(
+                    slot, rho, 1, log2m, mm_mode, pallas_mode)[0]
             elif name == "hllmerge":
                 m = 1 << extra
                 planes = cols["bp::" + argt].astype(jnp.int32)
@@ -972,17 +1203,24 @@ class DeviceExecutor:
     MAX_CACHED_BYTES = int(os.environ.get("PINOT_TPU_BATCH_CACHE_BYTES", 6 << 30))
 
     def __init__(self, mesh=None, mm_mode: str = "auto",
-                 num_groups_limit: int = 100_000):
+                 num_groups_limit: int = 100_000,
+                 pallas_mode: str | None = None):
         """``mesh``: optional jax Mesh — shard the segment axis over it with
         psum-combined accumulators (parallel/mesh.py) instead of a
         single-device batched launch. ``mm_mode``: see build_pipeline.
         ``num_groups_limit``: the sorted high-card regime's group-table
-        cap, matching the engine's numGroupsLimit."""
+        cap, matching the engine's numGroupsLimit. ``pallas_mode``:
+        the scatter-kernel tier's mode (None = follow ``mm_mode``, so
+        DeviceExecutor(mm_mode="interpret") exercises the Pallas tier in
+        CPU tests exactly like the matmul kernel); per-process
+        PINOT_TPU_PALLAS=0 and per-query SET usePallas=false force the
+        XLA scatter path end to end."""
         self.mesh = mesh
         self.mm_mode = mm_mode
+        self.pallas_mode = pallas_mode
         self.num_groups_limit = max(1, num_groups_limit)
         self._batches: dict = {}     # segment-set key -> BatchContext (LRU)
-        # (template, mm_mode, blockskip, width_sig) -> entry dict
+        # (template, mm_mode, blockskip, width_sig, trim, pallas) -> entry
         self._pipelines: dict = {}
         # thread safety: server query threads launch/fetch concurrently —
         # one lock guards the caches, refcounts, and observability fields
@@ -1050,6 +1288,13 @@ class DeviceExecutor:
         self._pipeline_failures: dict = {}   # (template, batch_key) -> n
         self._quarantined: dict = {}         # key -> quarantined-at ts
         self._poisoned_batches: set = set()  # evict once their pins drain
+        # Pallas-tier quarantine rung (ISSUE 15): a failing Pallas
+        # pipeline drops to the XLA scatter form ON DEVICE first — host
+        # only when the XLA rung fails too. One failure blocks the
+        # (template, batch) pair for QUARANTINE_TTL_S; the host-path
+        # quarantine's strike counting only ever sees XLA-rung failures.
+        self._pallas_blocked: dict = {}      # (template, batch_key) -> ts
+        self.pallas_fallbacks = 0            # pallas → XLA rung drops
         # kernel roofline accounting (ISSUE 11): per-pipeline-label
         # aggregates of the static bytes-moved cost model (ColPlan-width
         # column planes, block-skip gather ratio, trimmed fetch bytes)
@@ -1262,6 +1507,11 @@ class DeviceExecutor:
                 # circuit breaker has routed to host
                 "device_failures": self.launch_failures,
                 "quarantined_pipelines": len(self._quarantined),
+                # Pallas scatter tier (ISSUE 15): (template, batch) pairs
+                # currently dropped to the XLA scatter rung, and the
+                # cumulative drop count
+                "pallas_quarantined": len(self._pallas_blocked),
+                "pallas_fallbacks": self.pallas_fallbacks,
                 # sub-RTT serving (ISSUE 9): device partials cache +
                 # on-device final-reduce counters
                 "partials_cache_entries": len(self._partials),
@@ -1353,6 +1603,44 @@ class DeviceExecutor:
         with self._lock:
             self._pipeline_failures.pop((template, batch_key), None)
 
+    def _resolve_pallas(self, opts: dict) -> str:
+        """Per-launch Pallas-tier mode: env kill switch, per-query SET
+        opt-out, then the executor's configured mode (None = follow
+        mm_mode, mirroring how the tier is exercised in interpret-mode
+        tests)."""
+        if os.environ.get("PINOT_TPU_PALLAS", "1") in ("", "0"):
+            return "off"
+        if opts.get("usepallas") is False:
+            return "off"
+        mode = self.mm_mode if self.pallas_mode is None else self.pallas_mode
+        return _resolve_mm_mode(mode)
+
+    def _is_pallas_blocked(self, template, batch_key) -> bool:
+        with self._lock:
+            ts = self._pallas_blocked.get((template, batch_key))
+            if ts is None:
+                return False
+            if time.monotonic() - ts >= self.QUARANTINE_TTL_S:
+                # half-open: probe the Pallas form again after cooldown
+                self._pallas_blocked.pop((template, batch_key), None)
+                return False
+            return True
+
+    def _block_pallas(self, template, batch_key) -> None:
+        """Drop a failing (template, batch) pair to the XLA scatter rung:
+        the NEXT launch compiles the pallas_mode="off" pipeline variant —
+        still on device. Compiled Pallas-form entries for the template
+        are dropped so the rung takes effect immediately."""
+        with self._lock:
+            if (template, batch_key) not in self._pallas_blocked and \
+                    len(self._pallas_blocked) >= self.MAX_FAILURE_KEYS:
+                self._pallas_blocked.pop(next(iter(self._pallas_blocked)))
+            self._pallas_blocked[(template, batch_key)] = time.monotonic()
+            self.pallas_fallbacks += 1
+            for pk in [pk for pk in self._pipelines
+                       if pk[0] == template and pk[5] != "off"]:
+                self._pipelines.pop(pk)
+
     def _is_quarantined(self, template, batch_key) -> bool:
         with self._lock:
             key = (template, batch_key)
@@ -1373,6 +1661,7 @@ class DeviceExecutor:
         with self._lock:
             self._pipeline_failures.clear()
             self._quarantined.clear()
+            self._pallas_blocked.clear()
 
     def evict_segment_dir(self, seg_dir: str) -> int:
         """Evict every cached batch whose key contains ``seg_dir`` — the
@@ -1403,13 +1692,18 @@ class DeviceExecutor:
             self._drop_partials_for_batch(key)
             return dropped
 
-    def on_fetch_device_error(self, e, template, batch_key) -> None:
+    def on_fetch_device_error(self, e, template, batch_key,
+                              used_pallas: bool = False) -> None:
         """InflightLaunch.fetch error hook: a device-runtime failure on
         the blocking fetch counts toward the quarantine breaker, marks
         the batch for eviction, and converts to DeviceUnsupported — the
-        engine then re-runs the batch's segments on the host through its
-        fallback gate. Non-device errors return so the caller re-raises
-        the original."""
+        engine then re-runs THIS query's batch on the host through its
+        fallback gate (a dispatched flight can't be relaunched). When the
+        failing pipeline was the Pallas form, the failure blocks only the
+        Pallas rung — the NEXT query on this (template, batch) compiles
+        the XLA scatter form and stays on device, and no host-quarantine
+        strike is recorded. Non-device errors return so the caller
+        re-raises the original."""
         if not _is_device_runtime_error(e):
             return
         # a coalesced cohort re-raises ONE shared exception to every
@@ -1421,12 +1715,23 @@ class DeviceExecutor:
                 e._pinot_failure_counted = True
             except Exception:  # noqa: BLE001 — slotted exceptions
                 pass
-            quarantined = self._record_device_failure(template, batch_key)
-            self._evict_batch(batch_key)
-            log.warning(
-                "device fetch failed (%s: %s); batch evicted%s — host "
-                "fallback", type(e).__name__, e,
-                ", pipeline QUARANTINED to host" if quarantined else "")
+            if used_pallas:
+                with self._lock:
+                    self.launch_failures += 1
+                self._block_pallas(template, batch_key)
+                self._evict_batch(batch_key)
+                log.warning(
+                    "pallas pipeline fetch failed (%s: %s); batch "
+                    "evicted, XLA scatter rung takes over — this query "
+                    "falls back to host", type(e).__name__, e)
+            else:
+                quarantined = self._record_device_failure(template,
+                                                          batch_key)
+                self._evict_batch(batch_key)
+                log.warning(
+                    "device fetch failed (%s: %s); batch evicted%s — host "
+                    "fallback", type(e).__name__, e,
+                    ", pipeline QUARANTINED to host" if quarantined else "")
         raise DeviceUnsupported(
             f"device fetch failed ({type(e).__name__}); host fallback"
         ) from e
@@ -1502,24 +1807,35 @@ class DeviceExecutor:
 
     # ---- kernel roofline accounting (ISSUE 11) ---------------------------
     @staticmethod
-    def _pipeline_label(template, blockskip: bool, trim) -> str:
+    def _pipeline_label(template, blockskip: bool, trim,
+                        pallas: bool = False, fused: bool = False) -> str:
         """Human-stable per-pipeline label the roofline aggregates key on:
         the template SHAPE plus the compile-affecting execution modes —
         coarse on purpose (per-template keys would fragment the stats
-        into one-row buckets per literal-free query shape)."""
+        into one-row buckets per literal-free query shape). The Pallas
+        scatter tier and the fused filter+gather+aggregate form carry
+        their own suffixes so hbm_stats()["roofline"] and EXPLAIN
+        ANALYZE's %-of-HBM-peak line attribute each kernel correctly."""
         label = template[0]
         if blockskip:
             label += "+bskip"
+        if fused:
+            label += "+fused"
+        if pallas:
+            label += "+pallas"
         if trim is not None:
             label += "+trim"
         return label
 
-    def _new_flight(self, label: str, cache_hit: bool = False) -> dict:
+    def _new_flight(self, label: str, cache_hit: bool = False,
+                    fused: bool = False) -> dict:
         """Per-launch roofline flight record skeleton. ``data_bytes`` /
         ``zone_bytes`` are the static cost model's inputs (filled after
         the column gather); the resolve fills timings and the final
-        record via _note_flight."""
-        return {"label": label, "cache_hit": cache_hit,
+        record via _note_flight. ``fused``: the block-skip gather runs
+        inside the fused Pallas kernel — the bytes-moved model must not
+        charge the (B, R) gather-buffer round trip the XLA form pays."""
+        return {"label": label, "cache_hit": cache_hit, "fused": fused,
                 "data_bytes": 0, "zone_bytes": 0, "record": None}
 
     def _note_flight(self, flight: dict, outs: dict, fetched_bytes: int,
@@ -1540,9 +1856,18 @@ class DeviceExecutor:
                 total_b = float(np.sum(np.asarray(bt)))
                 if total_b > 0:
                     ratio = min(1.0, float(np.sum(np.asarray(bs))) / total_b)
+            # block-skip gather-buffer round trip: the XLA form
+            # materializes the gathered (B, R) planes in HBM (one write +
+            # one read of every gathered byte) before the filter runs;
+            # the fused Pallas kernel streams candidate blocks straight
+            # into VMEM, so it must NOT be charged for the eliminated
+            # round trip (ISSUE 15 bytes-moved model fix)
+            gather_bytes = 0
+            if ratio < 1.0 and not flight.get("fused"):
+                gather_bytes = int(2 * flight["data_bytes"] * ratio)
             bytes_moved = 0 if cache_hit else int(
                 flight["zone_bytes"] + flight["data_bytes"] * ratio
-                + fetched_bytes)
+                + gather_bytes + fetched_bytes)
             kernel_ms = kernel_s * 1e3
             link_ms = link_s * 1e3
             rec = {"kernel": flight["label"],
@@ -1551,6 +1876,8 @@ class DeviceExecutor:
                    "kernelMs": round(kernel_ms, 3),
                    "linkMs": round(link_ms, 3),
                    "cacheHit": cache_hit}
+            if gather_bytes:
+                rec["gatherBytes"] = gather_bytes
             gbps = None
             if not cache_hit and kernel_s > 1e-9:
                 gbps = bytes_moved / kernel_s / 1e9
@@ -1716,7 +2043,12 @@ class DeviceExecutor:
         # (retain=True takes the pin atomically with the cache insert)
         batch_key = self._batch_key(segments)
         last_err = None
-        for attempt in (0, 1):  # one in-place retry after a device failure
+        xla_attempts = 0
+        # fallback ladder: Pallas form → XLA scatter form (still on
+        # device) → one XLA retry → host. A Pallas-only failure never
+        # leaves the device (ISSUE 15 quarantine rung); host-quarantine
+        # strikes count XLA-rung failures only.
+        for _attempt in range(3):
             ctx = self.batch_for(segments, retain=True)
             tpl_box: list = []
             try:
@@ -1733,24 +2065,39 @@ class DeviceExecutor:
                 if not _is_device_runtime_error(e):
                     raise
                 # device-runtime failure (XlaRuntimeError /
-                # RESOURCE_EXHAUSTED, real or injected): count it toward
-                # the quarantine breaker, evict the implicated batch so
-                # the retry re-uploads fresh buffers, retry ONCE on
-                # device, then fall back to the host path
+                # RESOURCE_EXHAUSTED, real or injected): evict the
+                # implicated batch so the retry re-uploads fresh buffers
                 last_err = e
+                tpl = tpl_box[0] if tpl_box else None
+                pmode_used = tpl_box[1] if len(tpl_box) > 1 else "off"
+                if pmode_used != "off" and tpl is not None:
+                    # Pallas rung: block the Pallas form for this
+                    # (template, batch) and retry the XLA scatter form on
+                    # device — no host-quarantine strike
+                    with self._lock:
+                        self.launch_failures += 1
+                    self._block_pallas(tpl, batch_key)
+                    self._evict_batch(batch_key)
+                    log.warning(
+                        "pallas pipeline failed (%s: %s); batch evicted, "
+                        "dropping to the XLA scatter rung on device",
+                        type(e).__name__, e)
+                    continue
                 quarantined = False
-                if tpl_box:
+                if tpl is not None:
                     quarantined = self._record_device_failure(
-                        tpl_box[0], batch_key)
+                        tpl, batch_key)
                 else:
                     with self._lock:
                         self.launch_failures += 1
                 self._evict_batch(batch_key)
-                if attempt == 0 and not quarantined:
+                xla_attempts += 1
+                if xla_attempts <= 1 and not quarantined:
                     log.warning(
                         "device launch failed (%s: %s); batch evicted, "
                         "retrying once on device", type(e).__name__, e)
                     continue
+                break
         raise DeviceUnsupported(
             f"device launch failed after retry "
             f"({type(last_err).__name__}: {last_err}); host fallback"
@@ -1837,6 +2184,23 @@ class DeviceExecutor:
             # publish the template to launch()'s recovery handler so a
             # device-runtime failure below is counted per-(template, batch)
             tpl_box.append(template)
+        # Pallas scatter tier (ISSUE 15): env kill switch + per-query SET
+        # usePallas opt-out + the quarantine XLA rung — a blocked
+        # (template, batch) pair compiles the pallas_mode="off" variant
+        # and stays ON DEVICE
+        pmode = self._resolve_pallas(opts)
+        if pmode != "off" and self._is_pallas_blocked(template, batch_key):
+            pmode = "off"
+        # failure ATTRIBUTION for launch()'s fallback ladder: a template
+        # that routes nothing to the tier must not charge its failures
+        # to the Pallas rung (the "XLA retry" would recompile a
+        # byte-identical pipeline and skip the host-quarantine strike).
+        # Widths aren't planned yet, so this conservative estimate is
+        # refined once the width plan and fused eligibility exist.
+        routes_pallas = pmode != "off" and _template_uses_pallas(
+            template, None, False, pmode, ctx.S * ctx.pad_to)
+        if tpl_box is not None:
+            tpl_box.append(pmode if routes_pallas else "off")
         if self._is_quarantined(template, batch_key):
             # circuit breaker: this (template, batch) failed on device
             # QUARANTINE_AFTER times — route it to the host path while
@@ -1944,14 +2308,34 @@ class DeviceExecutor:
                 params["tr_k"] = jnp.asarray(tr_k)
                 host_sigs.append(("tr_k", "<i4", (), tr_k.tobytes()))
 
-        pkey = self._pipeline_key(template, use_bs, wsig, trim)
+        pkey = self._pipeline_key(template, use_bs, wsig, trim, pmode)
         entry = self._pipeline_entry(template, agg_tpls, final, use_bs,
-                                     widths, wsig, trim)
+                                     widths, wsig, trim, pmode)
+        # fused filter+gather+aggregate eligibility (label + bytes-moved
+        # model): the plan walk is cheap and mirrors the one
+        # build_pipeline compiled into the pipeline
+        fused = False
+        if pmode != "off" and use_bs and shape == "agg":
+            from pinot_tpu.ops import pallas_scatter as ps_ops
+
+            if bs_ops.BLOCK_ROWS == ps_ops.FUSED_BLOCK_ROWS:
+                fplan = ps_ops.plan_fused(filter_tpl, agg_tpls, widths)
+                fused = fplan is not None and ps_ops.fused_params_ok(
+                    fplan, params)
+        # refine the rung attribution now that the width plan and fused
+        # eligibility are known (labels, handles, and launch()'s handler
+        # all read the same verdict)
+        routes_pallas = pmode != "off" and _template_uses_pallas(
+            template, widths, fused, pmode, ctx.S * ctx.pad_to)
+        if tpl_box is not None and len(tpl_box) > 1:
+            tpl_box[1] = pmode if routes_pallas else "off"
         # roofline flight (ISSUE 11): always-on except under profile
         # capture (the bench's amortized kernel probe re-dispatches the
         # same launch and would pollute the per-query aggregates)
         flight = None if self.profile_enabled else self._new_flight(
-            self._pipeline_label(template, use_bs, trim))
+            self._pipeline_label(template, use_bs, trim,
+                                 pallas=routes_pallas, fused=fused),
+            fused=fused)
 
         # device partials cache: a repeat execution — same pipeline, same
         # batch, same literal/ps_alive/param VALUES — skips the gather +
@@ -1979,6 +2363,7 @@ class DeviceExecutor:
                                         batch_key, resolve)
                 handle.cache_hit = True
                 handle.flight = flight
+                handle.used_pallas = routes_pallas
                 return handle
         cols = {}
         with trace_span("gather", tracer):
@@ -2053,15 +2438,20 @@ class DeviceExecutor:
         handle = InflightLaunch(self, q, ctx, template, aggs, batch_key,
                                 resolve)
         handle.flight = flight
+        handle.used_pallas = routes_pallas
         return handle
 
     # ---- dispatch: solo vs coalesced -------------------------------------
-    def _pipeline_key(self, template, blockskip, wsig, trim) -> tuple:
+    def _pipeline_key(self, template, blockskip, wsig, trim,
+                      pallas: str = "off") -> tuple:
         """The ONE composition of the compiled-pipeline cache key — the
         partials cache namespaces its entries by the same tuple, so a
         future compile-affecting component added here automatically
-        splits both caches together."""
-        return (template, self.mm_mode, blockskip, wsig, trim)
+        splits both caches together. ``pallas`` keys the scatter-tier
+        mode so the Pallas form and the XLA scatter form (the
+        PINOT_TPU_PALLAS=0 / SET usePallas=false escape hatch and the
+        quarantine XLA rung) coexist compiled in one process."""
+        return (template, self.mm_mode, blockskip, wsig, trim, pallas)
 
     @staticmethod
     def _post_chain(template, agg_tpls, final, trim):
@@ -2081,7 +2471,8 @@ class DeviceExecutor:
 
     def _pipeline_entry(self, template, agg_tpls, final,
                         blockskip: bool = False, widths=None,
-                        wsig: tuple = (), trim=None) -> dict:
+                        wsig: tuple = (), trim=None,
+                        pallas: str = "off") -> dict:
         """Compiled-pipeline cache entry for (template, mm_mode, blockskip,
         width-plan sig, trim sig): the solo jitted pipeline, the pre-pack
         inner fn (eval_shape layouts), the raw pipeline (cohort rebuilds
@@ -2093,14 +2484,16 @@ class DeviceExecutor:
         id(entry), so only same-width same-trim queries stack. Built
         under the executor lock so concurrent same-template launches
         share ONE entry."""
-        pkey = self._pipeline_key(template, blockskip, wsig, trim)
+        pkey = self._pipeline_key(template, blockskip, wsig, trim,
+                                  pallas)
         with self._lock:
             entry = self._pipelines.get(pkey)
             if entry is not None:
                 return entry
             raw = build_pipeline(template, self.mm_mode,
                                  sorted_hll_ok=(self.mesh is None),
-                                 blockskip=blockskip, widths=widths)
+                                 blockskip=blockskip, widths=widths,
+                                 pallas_mode=pallas)
             # cohorts vmap the pipeline over stacked member params, and a
             # vmapped lax.cond lowers to select — BOTH branches would run
             # for every member. Cohorts therefore ride the DENSE form;
@@ -2109,7 +2502,7 @@ class DeviceExecutor:
             # subsets stay correct.
             raw_cohort = build_pipeline(
                 template, self.mm_mode, sorted_hll_ok=(self.mesh is None),
-                widths=widths,
+                widths=widths, pallas_mode=pallas,
             ) if blockskip else raw
             if self.mesh is not None:
                 from pinot_tpu.parallel.mesh import shard_pipeline
@@ -2135,7 +2528,7 @@ class DeviceExecutor:
             entry = {
                 "pipeline": pipeline, "inner": inner, "raw": raw_cohort,
                 "agg_tpls": agg_tpls, "final": final,
-                "template": template, "trim": trim,
+                "template": template, "trim": trim, "pallas": pallas,
                 "layouts": {}, "cohort": None, "cohort_layouts": {},
             }
             self._pipelines[pkey] = entry

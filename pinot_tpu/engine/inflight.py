@@ -108,7 +108,8 @@ class InflightLaunch:
                 # host-fallback signal after the executor records them
                 # toward the quarantine breaker; anything else re-raises
                 self._executor.on_fetch_device_error(
-                    e, self._template, self._batch_key)
+                    e, self._template, self._batch_key,
+                    getattr(self, "used_pallas", False))
                 raise
             # success clears the quarantine breaker's strike count — the
             # breaker is for failures close together, not two transient

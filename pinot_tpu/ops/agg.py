@@ -14,6 +14,14 @@ scatters into per-block int32/float32 partials (block size chosen so a block
 sum cannot overflow / lose precision), stage 2 densely reduces blocks in
 int64/float64, which is cheap. Counts fit int32 (< 2^31 docs per launch) and
 widen on the way out.
+
+NOTE (ISSUE 15): these XLA scatters are now the DIFFERENTIAL REFERENCE
+and fallback rung for the Pallas scatter-kernel tier
+(ops/pallas_scatter.py) — engine/device.py routes the group
+sum/count/min/max family through the tiled local-accumulate Pallas
+kernels when the tier is on (PINOT_TPU_PALLAS, SET usePallas), and
+every tier kernel is pinned bit-exact against the functions here
+(tests/test_pallas_scatter.py).
 """
 
 from __future__ import annotations

@@ -23,7 +23,12 @@ min/max check, pushed down to ``ZONE_BLOCK_ROWS``-row granularity:
    already-compiled dense branch of the same kernel, not a host re-run).
 3. **Block gather**: each needed column reshapes to (total_blocks, R, ...)
    and gathers only the candidate blocks; the filter + aggregation then run
-   over B*R rows instead of S*L.
+   over B*R rows instead of S*L. When the Pallas scatter tier is on and
+   the template fits its surface, the gather/filter/aggregate step runs
+   instead as ONE fused kernel (ops/pallas_scatter.py fused_filter_agg):
+   the candidate indices from step 2 scalar-prefetch into the kernel's
+   BlockSpec index maps, so the (B, R) gather buffer this step would
+   materialize in HBM never exists.
 
 Everything is trace-time static in shapes: B derives from the (S, L) batch
 shape, so jit caches stay keyed on the same (template, batch-shape) pairs

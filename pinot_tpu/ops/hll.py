@@ -5,6 +5,11 @@ The reference delegates to the clearspring HyperLogLog Java lib
 register update is a TPU-friendly scatter-max over (m,) int32 registers —
 registers merge across segments/chips with an elementwise max (psum-style
 combine), and the cardinality estimate runs host-side from the registers.
+When the Pallas scatter tier is on, register spaces up to its slot bound
+build through the rho-threshold-presence kernel instead
+(ops/pallas_scatter.py hll_register_max; engine/device.py _hll_regs
+routes) — the serialized scatter-max here stays compiled-in as the
+differential reference and fallback rung.
 
 Hashing: 32-bit murmur3 finalizer (avalanche) over int32 keys — global dict
 ids for dictionary columns (value-consistent across segments), raw bits for

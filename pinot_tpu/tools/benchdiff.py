@@ -153,6 +153,12 @@ def extract_metrics(detail: dict) -> dict:
                 rate = _num(entry.get("mrows_per_s"))
                 if rate is not None:
                     out[f"micro.{kname}.mrows_per_s"] = (rate, "higher")
+                # achieved bandwidth rides next to the row rate so the
+                # Pallas scatter-tier micros (ISSUE 15) diff on their
+                # GB/s-vs-HBM-peak axis too
+                g = _num(entry.get("gbps"))
+                if g is not None:
+                    out[f"micro.{kname}.gbps"] = (g, "higher")
     conc = detail.get("concurrency")
     if isinstance(conc, dict):
         for lname, entry in conc.items():
