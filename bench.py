@@ -1278,6 +1278,403 @@ _MICRO_R05_REFERENCE = {
 }
 
 
+def process_scaling_ceiling() -> float:
+    """What 2 pinned CPU-bound OS processes can achieve on THIS box
+    relative to 2x one process — the environment's own hard cap on
+    any 2-server scaling figure. On a real multi-core host this is
+    ~1.0 and the normalization below is a no-op; on a 2-core
+    sandboxed container (shared cores with the sandbox supervisor,
+    per-syscall sentry overhead) it is measurably below 1 for ANY
+    workload, including two bare numpy loops. Shared by the cluster
+    phase's routing-tier gate and the join phase's distributed
+    stage-2 gate."""
+    import subprocess
+
+    worker = (
+        "import os,sys,time\n"
+        "import numpy as np\n"
+        "pin=int(sys.argv[1])\n"
+        "if pin>=0 and hasattr(os,'sched_setaffinity'):\n"
+        "    try: os.sched_setaffinity(0,{pin%max(1,os.cpu_count())})\n"
+        "    except OSError: pass\n"
+        "rng=np.random.default_rng(0)\n"
+        "a=rng.integers(0,4,1_200_000)\n"
+        "b=rng.integers(1,500,1_200_000).astype(np.int32)\n"
+        "for _ in range(3):\n"
+        "    m=b<400; k=a[m]; v=b[m]\n"
+        "    out=np.zeros(4); np.add.at(out,k,v)\n"
+        "t0=time.perf_counter()\n"
+        "for i in range(20):\n"
+        "    m=b<400+(i%16); k=a[m]; v=b[m]\n"
+        "    c=np.bincount(k,minlength=4)\n"
+        "    out=np.zeros(4); np.add.at(out,k,v)\n"
+        "print(20/(time.perf_counter()-t0))\n"
+    )
+
+    def run(pins):
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", worker, str(p)],
+            stdout=subprocess.PIPE, text=True) for p in pins]
+        rates = []
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            rates.append(float(out.strip()))
+        return rates
+
+    solo = run([0])[0]
+    duo = run([0, 1])
+    if solo <= 0:
+        return 1.0
+    return max(0.1, min(1.0, sum(duo) / (2 * solo)))
+
+
+def _bench_join_distributed():
+    """detail.join.distributed: the server-side shuffle exchange
+    sub-phase (ISSUE 16). Spawns 1- and 2-server OS-PROCESS clusters
+    (``admin start-server --no-device``, pinned cores, FileRegistry —
+    the cluster-phase recipe; real gRPC between servers is the whole
+    point: partition ships cross process boundaries) holding a
+    replicated fact-fact pair, and measures DISTRIBUTED stage-2 QPS at
+    each width over an offered-load ladder.
+
+    Gates (folded into the join phase's violations → exit 6):
+
+    - zero query errors/partials at every width, rows bit-exact against
+      the broker-local SHUFFLE reference (integer measures only — SUM
+      over int64 merges exactly in any partition order);
+    - stage-2 speedup at 2 servers (qps2/qps1), normalized by the box's
+      own 2-process ceiling, >= 1.6x — one bounded retry of the pair,
+      per-width peak kept (the cluster phase's noise policy);
+    - a chaos run (``PINOT_TPU_FAULTS=exchange.transfer@srv_1=error#2``
+      armed in every server process, exchange buffer squeezed to 64 KiB
+      so every partition spills to the mmap warm tier): ZERO errors —
+      the broker's exclude-and-retry must absorb the injected transfer
+      faults in-band — with at least one retry observed, at least one
+      spill counted, and rows still bit-exact.
+    """
+    import shutil
+    import subprocess
+    import threading as _threading
+
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.cluster.registry import FileRegistry, Role
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.storage.creator import build_segment
+
+    detail: dict = {}
+    violations: list = []
+    cores = os.cpu_count() or 2
+    # fact-fact: both sides larger than any BROADCAST build budget, key
+    # cardinality ~ build size so the join output stays ~ filtered-fact
+    # sized (no row explosion polluting the stage-2 timing)
+    n_fact, n_build, n_keys = 240_000, 120_000, 150_000
+    rng = np.random.default_rng(61)
+    fact = {
+        "k": rng.integers(0, n_keys, n_fact).astype(np.int64),
+        "v": rng.integers(1, 1000, n_fact).astype(np.int64),
+    }
+    fb = {
+        "k2": rng.integers(0, n_keys, n_build).astype(np.int64),
+        "mode": np.array([f"m{j}" for j in range(8)])[
+            rng.integers(0, 8, n_build)],
+        "w": rng.integers(1, 50, n_build).astype(np.int64),
+    }
+    fa_schema = Schema.build(
+        name="fa_x", dimensions=[("k", DataType.LONG)],
+        metrics=[("v", DataType.LONG)])
+    fb_schema = Schema.build(
+        name="fb_x",
+        dimensions=[("k2", DataType.LONG), ("mode", DataType.STRING)],
+        metrics=[("w", DataType.LONG)])
+
+    seg_base = tempfile.mkdtemp(prefix="pinot_tpu_xjoin_segs_")
+    for name, schema, data, n in (("fa_x", fa_schema, fact, n_fact),
+                                  ("fb_x", fb_schema, fb, n_build)):
+        for i, sl in enumerate([slice(0, n // 2), slice(n // 2, n)]):
+            build_segment(schema, {k: v[sl] for k, v in data.items()},
+                          os.path.join(seg_base, f"{name}_s{i}"),
+                          TableConfig(table_name=name), f"{name}_s{i}")
+
+    dist = "SET joinStrategy = 'distributed'; "
+    fixed_sql = ("SELECT b.mode, COUNT(*), SUM(a.v), SUM(b.w) "
+                 "FROM fa_x a JOIN fb_x b ON a.k = b.k2 "
+                 "WHERE a.v < 500 GROUP BY b.mode ORDER BY b.mode")
+    # literal sweep: distinct shapes per query, same template key
+    sweep = [f"SELECT b.mode, COUNT(*), SUM(a.v) "
+             f"FROM fa_x a JOIN fb_x b ON a.k = b.k2 "
+             f"WHERE a.v < {400 + 25 * k} GROUP BY b.mode "
+             f"ORDER BY b.mode" for k in range(16)]
+
+    def run_xcluster(n_servers: int, extra_env=None, chaos: bool = False):
+        """One isolated n-server cluster → entry dict (qps ladder, or
+        the chaos/spill counters when ``chaos``)."""
+        base = tempfile.mkdtemp(prefix=f"pinot_tpu_xjoin_{n_servers}_")
+        reg_path = os.path.join(base, "cluster.json")
+        procs = []
+        broker = None
+        try:
+            registry = FileRegistry(reg_path)
+            controller = Controller(registry, os.path.join(base, "ds"))
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in [os.path.dirname(os.path.abspath(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep) if p)
+            # same glibc-heap knobs as the cluster phase: page-table work
+            # serializes ACROSS server processes under sandboxed kernels
+            env.setdefault("MALLOC_MMAP_THRESHOLD_", "1073741824")
+            env.setdefault("MALLOC_TRIM_THRESHOLD_", "1073741824")
+            env.setdefault("MALLOC_TOP_PAD_", "268435456")
+            env.update(extra_env or {})
+            for i in range(n_servers):
+                log_f = open(os.path.join(base, f"srv_{i}.log"), "w")
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "pinot_tpu.tools.admin",
+                     "start-server", "--registry", reg_path,
+                     "--id", f"srv_{i}",
+                     "--data-dir", os.path.join(base, f"s{i}"),
+                     "--max-concurrent", str(max(1, cores // 2)),
+                     "--no-device"],
+                    stdout=log_f, stderr=subprocess.STDOUT, env=env)
+                if hasattr(os, "sched_setaffinity"):
+                    # one core per server: the 1-server baseline must not
+                    # silently borrow the second core for its own scans
+                    try:
+                        os.sched_setaffinity(p.pid, {i % cores})
+                    except OSError:
+                        pass
+                procs.append((p, log_f))
+            t_end = time.time() + 60
+            while time.time() < t_end:
+                if len(registry.instances(
+                        Role.SERVER, live_ttl_ms=10_000)) == n_servers:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError(
+                    f"join phase: {n_servers} servers never registered")
+            for name, schema in (("fa_x", fa_schema),
+                                 ("fb_x", fb_schema)):
+                controller.add_table(
+                    TableConfig(table_name=name, replication=n_servers),
+                    schema)
+                for i in range(2):
+                    controller.upload_segment(
+                        name, os.path.join(seg_base, f"{name}_s{i}"))
+            t_end = time.time() + 90
+            while time.time() < t_end:
+                evs = [registry.external_view(f"{t}_OFFLINE")
+                       for t in ("fa_x", "fb_x")]
+                if all(len(ev) == 2 and all(len(v) == n_servers
+                                            for v in ev.values())
+                       for ev in evs):
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("join phase: segments never loaded")
+
+            broker = Broker(registry, timeout_s=30.0)
+            ref = broker.execute(
+                f"SET joinStrategy = 'shuffle'; {fixed_sql}")
+            if ref.get("exceptions"):
+                raise RuntimeError(
+                    f"join phase shuffle ref failed: {ref['exceptions']}")
+            ref_rows = ref["resultTable"]["rows"]
+            warm = broker.execute(dist + fixed_sql)
+            if warm.get("exceptions"):
+                raise RuntimeError(f"join phase distributed warmup "
+                                   f"failed: {warm['exceptions']}")
+            if warm.get("joinStrategy") != "DISTRIBUTED":
+                raise RuntimeError(
+                    f"join phase: expected DISTRIBUTED, got "
+                    f"{warm.get('joinStrategy')}")
+            entry = {
+                "errors": 0,
+                "parity": warm["resultTable"]["rows"] == ref_rows,
+                "partitions": warm.get("joinFanout"),
+                "exchange_bytes": warm.get("exchangeBytes"),
+                "partitions_shipped": warm.get("numPartitionsShipped"),
+            }
+
+            if chaos:
+                # the warm query above already ran INTO the armed faults
+                # (first distributed attempt dies typed, the retry
+                # excludes srv_1) — fold its counters in
+                retries = int(warm.get("numRetries") or 0)
+                spills = int(warm.get("exchangeSpillCount") or 0)
+                bad = 0
+                parity = entry["parity"]
+                for _ in range(10):
+                    r = broker.execute(dist + fixed_sql)
+                    if r.get("exceptions") or r.get("partialResult"):
+                        bad += 1
+                        continue
+                    retries += int(r.get("numRetries") or 0)
+                    spills += int(r.get("exchangeSpillCount") or 0)
+                    if r["resultTable"]["rows"] != ref_rows:
+                        parity = False
+                entry.update({"errors": bad, "parity": parity,
+                              "queries": 11, "retries_total": retries,
+                              "spill_count": spills})
+                return entry
+
+            lock = _threading.Lock()
+            errs = [0]
+
+            def blast(width: int, nq: int) -> float:
+                counter = [0]
+
+                def worker():
+                    while True:
+                        with lock:
+                            k = counter[0]
+                            if k >= nq:
+                                return
+                            counter[0] += 1
+                        r = broker.execute(dist + sweep[k % len(sweep)])
+                        if r.get("exceptions") or r.get("partialResult"):
+                            with lock:
+                                errs[0] += 1
+
+                t0 = time.perf_counter()
+                ts = [_threading.Thread(target=worker)
+                      for _ in range(width)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return nq / (time.perf_counter() - t0)
+
+            # offered-load ladder, peak kept: a closed loop sized to
+            # saturate one server under-drives two (cluster-phase logic)
+            rungs = {}
+            qps = 0.0
+            for width in sorted({n_servers, 2 * n_servers,
+                                 4 * n_servers}):
+                r = blast(width, max(16, min(48, 16 * width)))
+                rungs[f"t{width}"] = round(r, 2)
+                qps = max(qps, r)
+            entry.update({"qps": round(qps, 2),
+                          "qps_by_offered": rungs, "errors": errs[0]})
+            return entry
+        finally:
+            if broker is not None:
+                broker.close()
+            for p, log_f in procs:
+                p.terminate()
+            for p, log_f in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                log_f.close()
+            shutil.rmtree(base, ignore_errors=True)
+
+    try:
+        entries: dict = {}
+
+        def measure(n: int) -> None:
+            e = run_xcluster(n)
+            prev = entries.get(n)
+            if prev is None or e["qps"] > prev["qps"]:
+                entries[n] = e
+            if e["errors"]:
+                violations.append(
+                    f"join.distributed: {e['errors']} query errors at "
+                    f"{n} servers (bar: 0)")
+            if not e["parity"]:
+                violations.append(
+                    f"join.distributed: rows != broker-local SHUFFLE "
+                    f"reference at {n} servers")
+
+        # ceiling sampled around the width runs, MEDIAN used — same
+        # noise policy as the cluster phase's scaling gate
+        ceilings = [process_scaling_ceiling()]
+        measure(1)
+        measure(2)
+        ceilings.append(process_scaling_ceiling())
+
+        def scaling() -> tuple:
+            q1, q2 = entries[1]["qps"], entries[2]["qps"]
+            speedup = q2 / q1 if q1 else 0.0
+            ceiling = float(np.median(ceilings))
+            return speedup, ceiling, \
+                (speedup / ceiling if ceiling else 0.0)
+
+        speedup, ceiling, norm = scaling()
+        if norm < 1.6:
+            # one bounded retry of the gated pair: shared-box noise only
+            # ever under-measures a width's peak
+            detail["retried"] = True
+            measure(1)
+            measure(2)
+            ceilings.append(process_scaling_ceiling())
+            speedup, ceiling, norm = scaling()
+        if norm < 1.6:
+            violations.append(
+                f"join.distributed: stage-2 speedup at 2 servers "
+                f"{norm:.2f}x normalized (raw {speedup:.2f}x / box "
+                f"2-process ceiling {ceiling:.3f}) < 1.6x "
+                f"(qps1={entries[1]['qps']}, qps2={entries[2]['qps']})")
+
+        chaos = run_xcluster(
+            2, chaos=True,
+            extra_env={
+                "PINOT_TPU_FAULTS": "exchange.transfer@srv_1=error#2",
+                "PINOT_TPU_EXCHANGE_BUFFER_BYTES": str(64 << 10),
+            })
+        if chaos["errors"]:
+            violations.append(
+                f"join.distributed: {chaos['errors']} errors under "
+                f"exchange.transfer chaos (bar: 0 — the broker's "
+                f"exclude-and-retry must absorb injected faults)")
+        if not chaos["retries_total"]:
+            violations.append(
+                "join.distributed: chaos faults never fired "
+                "(numRetries stayed 0)")
+        if not chaos["spill_count"]:
+            violations.append(
+                "join.distributed: 64 KiB exchange buffer never spilled")
+        if not chaos["parity"]:
+            violations.append(
+                "join.distributed: chaos-run rows != reference")
+
+        detail.update({
+            "stage2_qps": {"n1": entries[1]["qps"],
+                           "n2": entries[2]["qps"]},
+            "qps_by_offered": {f"n{n}": entries[n]["qps_by_offered"]
+                               for n in (1, 2)},
+            "speedup_2": round(speedup, 3),
+            "box_2proc_ceiling": round(ceiling, 3),
+            "box_2proc_ceiling_samples": [round(c, 3) for c in ceilings],
+            "speedup_2_normalized": round(norm, 3),
+            "partitions": entries[2]["partitions"],
+            "exchange_bytes": entries[2]["exchange_bytes"],
+            "partitions_shipped": entries[2]["partitions_shipped"],
+            "spill_count": chaos["spill_count"],
+            "chaos": {"queries": chaos["queries"],
+                      "errors": chaos["errors"],
+                      "retries_total": chaos["retries_total"],
+                      "spill_count": chaos["spill_count"],
+                      "faults": "exchange.transfer@srv_1=error#2 + "
+                                "64KiB exchange buffer"},
+            "note": (
+                f"peak DISTRIBUTED stage-2 QPS over an offered-load "
+                f"ladder on a {n_fact}x{n_build}-row fact-fact join "
+                f"sweep; each width is an isolated cluster of that many "
+                f"server OS PROCESSES (pinned cores, real gRPC "
+                f"partition ships), replication = width; speedup gate "
+                f"normalized by the box's own 2-process ceiling; "
+                f"cores={cores}"),
+        })
+    finally:
+        shutil.rmtree(seg_base, ignore_errors=True)
+    return detail, violations
+
+
 def bench_join(n_fact: int = 300_000, iters: int = 5):
     """detail.join: the multi-stage engine phase (ISSUE 8). An SSB-style
     star — fact table joined against two dimension tables — versus the
@@ -1289,7 +1686,9 @@ def bench_join(n_fact: int = 300_000, iters: int = 5):
     Returns (detail, violations); violations non-empty fails the gate
     (standalone: ``python -m bench --phase join`` exits 6). Reports the
     star-join p50 per strategy (the strategy breakdown) next to the
-    denormalized single-stage p50 the join replaces."""
+    denormalized single-stage p50 the join replaces, then runs the
+    DISTRIBUTED stage-2 sub-phase (``_bench_join_distributed``,
+    ISSUE 16): server-fleet scaling gate + fault-injected chaos run."""
     import shutil
     import tempfile
 
@@ -1448,6 +1847,16 @@ def bench_join(n_fact: int = 300_000, iters: int = 5):
             "parity": "asserted (star==denorm, broadcast+shuffle, "
                       "device+host; left-join device==host)",
         }
+        # distributed stage-2 sub-phase (ISSUE 16): OS-process server
+        # fleet, normalized scaling gate + fault-injected chaos run
+        dist_detail, dist_violations = _bench_join_distributed()
+        detail["distributed"] = dist_detail
+        # flat mirrors: the trend keys benchdiff tracks round-over-round
+        detail["stage2_qps"] = dist_detail.get(
+            "stage2_qps", {}).get("n2")
+        detail["exchange_bytes"] = dist_detail.get("exchange_bytes")
+        detail["spill_count"] = dist_detail.get("spill_count")
+        violations.extend(dist_violations)
     finally:
         shutil.rmtree(base, ignore_errors=True)
     return detail, violations
@@ -2016,53 +2425,6 @@ def bench_cluster(n_queries: int = 160, threads: int = 8):
         build_segment(schema, cols,
                       os.path.join(seg_base, f"s{i}"),
                       TableConfig(table_name="clu"), f"clu_s{i}")
-
-    def process_scaling_ceiling() -> float:
-        """What 2 pinned CPU-bound OS processes can achieve on THIS box
-        relative to 2x one process — the environment's own hard cap on
-        any 2-server scaling figure. On a real multi-core host this is
-        ~1.0 and the normalization below is a no-op; on a 2-core
-        sandboxed container (shared cores with the sandbox supervisor,
-        per-syscall sentry overhead) it is measurably below 1 for ANY
-        workload, including two bare numpy loops."""
-        import subprocess
-
-        worker = (
-            "import os,sys,time\n"
-            "import numpy as np\n"
-            "pin=int(sys.argv[1])\n"
-            "if pin>=0 and hasattr(os,'sched_setaffinity'):\n"
-            "    try: os.sched_setaffinity(0,{pin%max(1,os.cpu_count())})\n"
-            "    except OSError: pass\n"
-            "rng=np.random.default_rng(0)\n"
-            "a=rng.integers(0,4,1_200_000)\n"
-            "b=rng.integers(1,500,1_200_000).astype(np.int32)\n"
-            "for _ in range(3):\n"
-            "    m=b<400; k=a[m]; v=b[m]\n"
-            "    out=np.zeros(4); np.add.at(out,k,v)\n"
-            "t0=time.perf_counter()\n"
-            "for i in range(20):\n"
-            "    m=b<400+(i%16); k=a[m]; v=b[m]\n"
-            "    c=np.bincount(k,minlength=4)\n"
-            "    out=np.zeros(4); np.add.at(out,k,v)\n"
-            "print(20/(time.perf_counter()-t0))\n"
-        )
-
-        def run(pins):
-            procs = [subprocess.Popen(
-                [sys.executable, "-c", worker, str(p)],
-                stdout=subprocess.PIPE, text=True) for p in pins]
-            rates = []
-            for p in procs:
-                out, _ = p.communicate(timeout=120)
-                rates.append(float(out.strip()))
-            return rates
-
-        solo = run([0])[0]
-        duo = run([0, 1])
-        if solo <= 0:
-            return 1.0
-        return max(0.1, min(1.0, sum(duo) / (2 * solo)))
 
     fixed_sql = ("SELECT region, COUNT(*), SUM(amount) FROM clu "
                  "GROUP BY region ORDER BY region")

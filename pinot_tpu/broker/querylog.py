@@ -181,6 +181,13 @@ class QueryLogger:
                     # kernel roofline accounting (ISSUE 11): HBM bytes
                     # the device pipelines moved vs their kernel wall
                     "deviceBytesMoved", "deviceKernelMs", "deviceLinkMs",
+                    # distributed stage-2 exchange (ISSUE 16): effective
+                    # strategy (demotion included — the plan is mutated
+                    # before logging), partition fan-out, wire volume,
+                    # warm-tier spills
+                    "joinStrategy", "joinStrategyDemoted", "joinFanout",
+                    "numPartitionsShipped", "exchangeBytes",
+                    "exchangeSpillCount",
                 ) if resp.get(k) is not None
             },
         }

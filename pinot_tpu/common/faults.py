@@ -30,6 +30,16 @@ Points wired in this codebase:
                          instance id) — an injected error becomes a
                          typed QUERY_SCHEDULING_TIMEOUT, never a hang
                          or a transport fault
+    exchange.transfer    distributed stage-2 partition ship (ISSUE 16;
+                         target = the RECEIVING instance id): fired in
+                         the SENDING server before every mailbox offer
+                         — self-sends included — so blackholing one
+                         server starves every sender addressing it. The
+                         sender converts the fault into a typed
+                         EXCHANGE_TRANSFER_FAILED naming the peer; the
+                         broker excludes that instance and retries the
+                         exchange on replicas, or settles as a typed
+                         partialResult inside the deadline
 
 Installation: programmatic (``install(Fault(...))`` — what the chaos
 suite uses), or the ``PINOT_TPU_FAULTS`` env var parsed once at first

@@ -114,7 +114,13 @@ def explain_multistage(engine, plan) -> dict:
         lines.append(f"    WINDOW({w.describe()})")
     if plan.post_filter is not None:
         lines.append(f"    POST_JOIN_FILTER({to_sql(plan.post_filter)})")
-    exchange = "mesh-collective" if mesh is not None else "local"
+    # DISTRIBUTED runs stage 2 on the server fleet (ISSUE 16): the
+    # boundary is a wire exchange between servers, whatever mesh the
+    # broker-side renderer happens to see
+    if plan.strategy == "DISTRIBUTED" and plan.joins:
+        exchange = "server-fleet"
+    else:
+        exchange = "mesh-collective" if mesh is not None else "local"
     if plan.joins:
         lines.append(f"  STAGE_BOUNDARY(exchange:{plan.strategy} "
                      f"[{exchange}])")
@@ -210,6 +216,24 @@ def annotate_analyze(plan: dict, resp: dict) -> dict:
                    f"out={nrows} rows)")
         elif s.startswith("COMBINE_"):
             ln += f" (actual: in={docs} rows, out={nrows} rows)"
+        elif s.startswith("STAGE_BOUNDARY(") and resp.get("exchange"):
+            # distributed stage-2 ran (possibly a RUNTIME demotion the
+            # static plan did not know about): render the strategy that
+            # actually executed, plus the exchange actuals — partition
+            # count, shipped bytes, spill count, per-server stage-2 rows
+            import re as _re
+
+            ex = resp["exchange"]
+            if "exchange:DISTRIBUTED" not in ln:
+                ln = _re.sub(r"exchange:\w+ \[[^\]]*\]",
+                             "exchange:DISTRIBUTED [server-fleet]", ln)
+            per = ", ".join(
+                f"{w}={v.get('stage2Rows')}"
+                for w, v in sorted((ex.get("servers") or {}).items()))
+            ln += (f" (actual: partitions={ex.get('partitions')}, "
+                   f"shippedBytes={resp.get('exchangeBytes')}, "
+                   f"spills={resp.get('exchangeSpillCount')}, "
+                   f"stage2Rows[{per}])")
         elif s.startswith("JOIN_") and resp.get("numJoinedRows") is not None:
             ln += f" (actual: out={resp['numJoinedRows']} rows)"
         elif s.startswith("SCAN("):

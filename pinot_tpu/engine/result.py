@@ -66,6 +66,18 @@ class ExecutionStats:
     device_bytes_moved: int = 0
     device_kernel_ms: float = 0.0
     device_link_ms: float = 0.0
+    # distributed stage-2 exchange accounting (ISSUE 16,
+    # query2/exchange.py): partitions/bytes this worker SHIPPED to peers
+    # (self-offers to its own mailbox don't count), payloads its mailbox
+    # spilled to the warm tier's spill dir, joined rows its stage-2
+    # partials aggregated, and per-alias stage-1 leaf row counts. All
+    # sum-merged; the broker surfaces them as numPartitionsShipped /
+    # exchangeBytes / exchangeSpillCount response counters.
+    exchange_partitions_shipped: int = 0
+    exchange_bytes_shipped: int = 0
+    exchange_spill_count: int = 0
+    stage2_rows: int = 0
+    leaf_rows: dict = dataclasses.field(default_factory=dict)
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -90,6 +102,12 @@ class ExecutionStats:
         self.device_bytes_moved += other.device_bytes_moved
         self.device_kernel_ms += other.device_kernel_ms
         self.device_link_ms += other.device_link_ms
+        self.exchange_partitions_shipped += other.exchange_partitions_shipped
+        self.exchange_bytes_shipped += other.exchange_bytes_shipped
+        self.exchange_spill_count += other.exchange_spill_count
+        self.stage2_rows += other.stage2_rows
+        for alias, rows in (other.leaf_rows or {}).items():
+            self.leaf_rows[alias] = self.leaf_rows.get(alias, 0) + int(rows)
 
 
 @dataclasses.dataclass

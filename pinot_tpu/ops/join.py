@@ -211,3 +211,19 @@ def partition_by_key(keys: np.ndarray, n_buckets: int, pad_value: int):
         out_keys[d, : counts[d]] = keys[sl]
         out_rows[d, : counts[d]] = sl
     return out_keys, out_rows
+
+
+def hash_partition_rows(part_ids: np.ndarray, n_parts: int) -> list:
+    """Ragged counterpart of ``partition_by_key`` for the WIRE exchange
+    (query2/exchange.py): given each row's partition id (hash % n_parts,
+    already computed from the join key), return one int64 row-index array
+    per partition. No padding — partitions ship server-to-server as
+    variable-length payloads, so the dense (D, L) layout the mesh kernels
+    want would only inflate the wire bytes; the receiving server re-packs
+    for its device locally."""
+    part_ids = np.asarray(part_ids, dtype=np.int64)
+    order = np.argsort(part_ids, kind="stable")
+    counts = np.bincount(part_ids, minlength=n_parts)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    return [order[starts[p]: starts[p] + counts[p]]
+            for p in range(n_parts)]

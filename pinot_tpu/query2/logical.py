@@ -110,7 +110,7 @@ class MultiStagePlan:
     post_filter: Optional[Expression]  # canonical; applied to joined rows
     windows: tuple            # WindowSpec...
     stage2: QueryContext
-    strategy: str             # "BROADCAST" | "SHUFFLE"
+    strategy: str             # "BROADCAST" | "SHUFFLE" | "DISTRIBUTED"
     # True when SET joinStrategy forced it: the runner honors a forced
     # BROADCAST even past BROADCAST_MAX_BUILD_ROWS (a heuristic pick
     # demotes to SHUFFLE there instead of replicating a huge build table)
@@ -275,10 +275,10 @@ def _pick_strategy(opts: dict, builds) -> str:
     forced = opts.get("joinstrategy")
     if forced is not None:
         forced = str(forced).upper()
-        if forced not in ("BROADCAST", "SHUFFLE"):
+        if forced not in ("BROADCAST", "SHUFFLE", "DISTRIBUTED"):
             raise SqlAnalysisError(
-                f"SET joinStrategy must be 'broadcast' or 'shuffle', "
-                f"got {forced!r}")
+                f"SET joinStrategy must be 'broadcast', 'shuffle' or "
+                f"'distributed', got {forced!r}")
         return forced
     # dimension tables are replicated and cheap to broadcast (narrow
     # planes); anything else defaults to the partitioned shuffle join

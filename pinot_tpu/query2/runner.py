@@ -45,7 +45,7 @@ from pinot_tpu.engine.host import (
     factorize_multi,
     like_to_regex,
 )
-from pinot_tpu.engine.reduce import finalize
+from pinot_tpu.engine.reduce import finalize, merge_intermediates
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
 from pinot_tpu.ops.transform import get_function
 from pinot_tpu.query.context import (
@@ -190,21 +190,29 @@ def _tdm_for(engine, table: str):
 
 
 def scan_local_rows(engine, table: str, filter_expr: Optional[Expression],
-                    need_cols: tuple, stats: ExecutionStats) -> dict:
+                    need_cols: tuple, stats: ExecutionStats,
+                    segments: Optional[list] = None) -> dict:
     """Matched rows of one table over all locally hosted segments →
     {bare column -> np array}. Pushdown filters lower through the SAME
     FilterNode path as single-stage queries; upsert validDocIds and
-    consuming (mutable) segments behave exactly like the host executor."""
+    consuming (mutable) segments behave exactly like the host executor.
+    ``segments`` restricts the scan to the named segments — the
+    distributed exchange ships each worker its routed slice so two
+    replicas of one segment never both scan it."""
+    seg_filter = None if segments is None else set(segments)
     tdm = _tdm_for(engine, table)
-    segments = tdm.acquire()
+    hosted = tdm.acquire()
     try:
-        if not segments:
+        if not hosted:
             raise ValueError(f"table {table!r} has no segments")
         fnode = None if filter_expr is None \
             else optimize_filter(_to_filter(filter_expr))
         parts: dict[str, list] = {c: [] for c in need_cols}
         total = 0
-        for seg in segments:
+        for seg in hosted:
+            if seg_filter is not None and \
+                    getattr(seg, "name", None) not in seg_filter:
+                continue
             if getattr(seg, "is_cold", False):
                 # cold tier (server/tiering.py): planes live only in the
                 # deep store — honest in-flight partial, the touch
@@ -253,7 +261,7 @@ def scan_local_rows(engine, table: str, filter_expr: Optional[Expression],
                 else np.empty(0)) for c in need_cols
         }
     finally:
-        tdm.release(segments)
+        tdm.release(hosted)
 
 
 def needed_columns(plan: MultiStagePlan) -> dict:
@@ -658,8 +666,83 @@ def apply_windows(cols: dict, windows: tuple, n: int, device) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict):
-    """Joined rows → ResultTable through the single-stage reduce path."""
+def _pallas_groupby_partials(aggs, specs, cols, env, ginv, n_groups: int,
+                             n: int, device) -> dict:
+    """Route COUNT + integer SUM/AVG stage-2 group partials through the
+    PR-14 Pallas tiled local-accumulate scatter (ops/pallas_scatter.py
+    plane_group_sums), mirroring device.py's ``_try_mm_groupby``
+    channel-planning: each eligible agg contributes byte-plane bf16
+    channels, the ones channel carries the per-group count, and
+    ``recombine_int`` reassembles EXACT int64 sums (converted to the
+    canonical float64 ``{"sum"}`` partial — exact for in-range ints, so
+    results stay bit-identical to the host scatter). Float sums keep the
+    host path: f32 plane recombination can round differently from the
+    float64 ``np.add.at`` accumulator and stage-2 parity is pinned
+    bit-exact. Returns {agg index: partial dict}; {} when the tier is
+    off or out of regime, and the caller falls back per-agg."""
+    mode = device._resolve_pallas({}) if device is not None else "off"
+    if mode == "off" or n == 0 or n_groups == 0:
+        return {}
+    try:
+        import jax.numpy as jnp
+
+        from pinot_tpu.ops import groupby_mm as mm
+        from pinot_tpu.ops import pallas_scatter as ps
+    except Exception:  # noqa: BLE001 — tier is an optimization, not a dep
+        return {}
+
+    count_idx = [i for i, s in enumerate(specs) if s.name == "count"]
+    plans = []  # (i, int64 values, offset, nplanes)
+    total_ch = 1  # ones channel
+    for i, spec in enumerate(specs):
+        if spec.name not in ("sum", "avg") or spec.mv or not spec.args:
+            continue
+        v = np.asarray(_eval_rows(cols, spec.args[0], env, n))
+        if v.dtype.kind not in ("i", "u", "b"):
+            continue
+        lo, hi = int(v.min()), int(v.max())
+        nplanes = mm.int_planes_needed(lo, hi)
+        if total_ch + nplanes > mm.MAX_CHANNELS + 1:
+            continue
+        plans.append((i, v.astype(np.int64), lo, nplanes))
+        total_ch += nplanes
+    if not plans and not count_idx:
+        return {}
+    if not (ps.sums_supported(n_groups, total_ch)
+            and (mode == "interpret" or n >= ps.PALLAS_MIN_ROWS)):
+        return {}
+
+    channels = [jnp.ones(n, dtype=jnp.bfloat16)]
+    for _, v, off, nplanes in plans:
+        channels.extend(mm.int_planes(jnp.asarray(v), off, nplanes))
+    sums = ps.plane_group_sums(
+        jnp.asarray(np.asarray(ginv, dtype=np.int64)),
+        jnp.stack(channels), n_groups,
+        interpret=(mode == "interpret"), first_channel_ones=True)
+    gcount = jnp.round(sums[0]).astype(jnp.int64)
+    gcount_np = np.asarray(gcount)
+    out = {}
+    row = 1
+    for i, _, off, nplanes in plans:
+        planes = [sums[j] for j in range(row, row + nplanes)]
+        row += nplanes
+        s = np.asarray(mm.recombine_int(planes, gcount, off)) \
+            .astype(np.float64)
+        out[i] = ({"sum": s, "count": gcount_np.copy()}
+                  if specs[i].name == "avg" else {"sum": s})
+    for i in count_idx:
+        out[i] = {"count": gcount_np.copy()}
+    return out
+
+
+def stage2_partial(plan: MultiStagePlan, cols: dict, n: int, env: dict,
+                   device=None) -> IntermediateResult:
+    """Joined rows → one MERGEABLE IntermediateResult (the canonical
+    partial engine/reduce.py merges). The distributed exchange runs this
+    per owned partition on each server — partials ship back as
+    DataTables and the broker's merge_intermediates + finalize is the
+    only stage-2 work left above the fleet. ``device`` routes eligible
+    group-bys through the Pallas scatter tier."""
     q = plan.stage2
     stats = ExecutionStats(num_docs_scanned=n)
     aggs = q.aggregations()
@@ -671,33 +754,35 @@ def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict):
             keys = tuple(np.asarray(k)[:0] for k in key_cols)
         else:
             keys, _ = factorize_multi(key_cols)
-        merged = IntermediateResult("distinct", group_keys=keys,
-                                    stats=stats)
-        return finalize(q, merged)
+        return IntermediateResult("distinct", group_keys=keys, stats=stats)
 
     if aggs and q.group_by:
         key_cols = [_eval_rows(cols, g, env, n) for g in q.group_by]
         specs = [aggspec.make_spec(a) for a in aggs]
         if n == 0:
-            merged = IntermediateResult(
+            return IntermediateResult(
                 "group_by",
                 group_keys=tuple(np.asarray(k)[:0] for k in key_cols),
                 agg_partials=[s.empty(0) for s in specs], stats=stats)
-            return finalize(q, merged)
         keys, ginv = factorize_multi(key_cols)
         n_groups = len(keys[0])
-        partials = []
         for a, spec in zip(aggs, specs):
             if spec.mv:
                 raise SqlAnalysisError(
                     f"multi-value aggregation {a.name}() is not supported "
                     f"over joined rows")
+        fast = _pallas_groupby_partials(aggs, specs, cols, env, ginv,
+                                        n_groups, n, device)
+        partials = []
+        for i, (a, spec) in enumerate(zip(aggs, specs)):
+            if i in fast:
+                partials.append(fast[i])
+                continue
             arg_values = [_eval_rows(cols, arg, env, n)
                           for arg in spec.args]
             partials.append(spec.host_groups(arg_values, ginv, n_groups))
-        merged = IntermediateResult("group_by", group_keys=keys,
-                                    agg_partials=partials, stats=stats)
-        return finalize(q, merged)
+        return IntermediateResult("group_by", group_keys=keys,
+                                  agg_partials=partials, stats=stats)
 
     if aggs:
         specs = [aggspec.make_spec(a) for a in aggs]
@@ -711,9 +796,8 @@ def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict):
             arg_values = [_eval_rows(cols, arg, env, n)
                           for arg in spec.args]
             partials.append(spec.host_groups(arg_values, zero, 1))
-        merged = IntermediateResult("aggregation", agg_partials=partials,
-                                    stats=stats)
-        return finalize(q, merged)
+        return IntermediateResult("aggregation", agg_partials=partials,
+                                  stats=stats)
 
     # selection: evaluate select + order-by columns, let finalize trim
     rows: dict = {}
@@ -721,8 +805,13 @@ def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict):
         rows[i] = _eval_rows(cols, e, env, n)
     for j, ob in enumerate(q.order_by):
         rows[f"__ob{j}"] = _eval_rows(cols, ob.expression, env, n)
-    merged = IntermediateResult("selection", rows=rows, stats=stats)
-    return finalize(q, merged)
+    return IntermediateResult("selection", rows=rows, stats=stats)
+
+
+def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict,
+               device=None):
+    """Joined rows → ResultTable through the single-stage reduce path."""
+    return finalize(plan.stage2, stage2_partial(plan, cols, n, env, device))
 
 
 # ---------------------------------------------------------------------------
@@ -746,6 +835,13 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
                       for c, v in table_rows[step.build.alias].items()}
         n_build = len(next(iter(build_cols.values()))) if build_cols else 0
         strat = plan.strategy
+        if strat == "DISTRIBUTED":
+            # the wire exchange lives in the broker's orchestration
+            # (broker/broker.py _execute_distributed); when the plan
+            # reaches THIS runner — embedded engine, or a broker that
+            # found the plan ineligible — the local execution form of a
+            # distributed join IS the shuffle mirror
+            strat = "SHUFFLE"
         if strat == "BROADCAST" and not plan.strategy_forced \
                 and n_build > BROADCAST_MAX_BUILD_ROWS:
             # a heuristic BROADCAST must not replicate a huge build table
@@ -774,7 +870,7 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
     env = apply_windows(left_cols, plan.windows, n, device) \
         if plan.windows else {}
 
-    result = run_stage2(plan, left_cols, n, env)
+    result = run_stage2(plan, left_cols, n, env, device)
     effective = None
     if strategies:
         effective = strategies[0] if len(set(strategies)) == 1 else "MIXED"
@@ -785,6 +881,12 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
         "backend": "device" if device is not None else "host",
         "mesh": mesh is not None,
         "roofline": roofline_recs,
+        # partition fan-out of the executed join (the broker-local
+        # SHUFFLE baseline column vs the distributed exchange's
+        # numPartitions): one bucket per mesh device, 1 when solo/host
+        "joinFanout": (mesh.devices.size
+                       if (mesh is not None and effective == "SHUFFLE")
+                       else 1) if strategies else 0,
     }
     return result, meta
 
@@ -840,6 +942,122 @@ def run_local(engine, plan: MultiStagePlan):
         for alias, cols in table_rows.items()
     }
     return result, stats, meta
+
+
+def run_exchange_stage(engine, plan: MultiStagePlan, spec: dict, mailbox,
+                       send, done, deadline, device=None):
+    """One worker's slice of DISTRIBUTED stage 2 (the mailbox-exchange
+    tentpole, ISSUE 16): scan the locally routed stage-1 segments, hash-
+    partition every row set by join key (``exchange.stable_hash64`` —
+    data-independent, so all workers agree without coordination), hand
+    each partition to ``send`` (the server routes it to its owner: a
+    self-offer or an ExchangeTransfer RPC), then join + partially
+    aggregate every OWNED partition locally and merge those partials
+    into the one IntermediateResult the broker's final merge consumes —
+    stage 2 runs on the fleet, the broker only merges, exactly like
+    stage 1.
+
+    ``spec``: {"partitions": P, "partitionOwners": {str(p): instance},
+    "senders": [instances], "selfId": str, "routing": {alias: {"table",
+    "segments", optional "dtypes"}}}. The broker gates this path to
+    single-join, window-free plans.
+    """
+    from pinot_tpu.common.trace import span
+    from pinot_tpu.ops import join as join_ops
+    from pinot_tpu.query2 import exchange
+
+    if len(plan.joins) != 1 or plan.windows:
+        raise SqlAnalysisError(
+            "distributed exchange supports exactly one join and no "
+            "window functions")
+    step = plan.joins[0]
+    probe_alias = plan.probe.alias
+    build_alias = step.build.alias
+    P = int(spec["partitions"])
+    owners = {int(p): o for p, o in spec["partitionOwners"].items()}
+    self_id = spec["selfId"]
+    mesh = getattr(device, "mesh", None) if device is not None else None
+
+    stats = ExecutionStats()
+    need = needed_columns(plan)
+    key_exprs = {probe_alias: step.left_keys, build_alias: step.right_keys}
+
+    # ---- stage 1 + scatter: scan routed segments, partition, ship ----
+    for src in plan.sources:
+        route = spec["routing"].get(src.alias) or {}
+        segs = route.get("segments")
+        with span(f"exchange_scan:{src.alias}"):
+            if segs:
+                cols = scan_local_rows(
+                    engine, src.table, plan.pushdown.get(src.alias),
+                    need[src.alias], stats, segments=segs)
+            else:
+                cols = {c: np.empty(0) for c in need[src.alias]}
+        # empty scans surface float64-empty arrays; the broker ships the
+        # schema dtypes so a worker with zero routed rows still sends
+        # correctly-typed (empty) payloads — the empty-leaf dtype guard
+        dtypes = route.get("dtypes") or {}
+        cols = {c: (v.astype(dtypes[c]) if len(v) == 0 and c in dtypes
+                    else v) for c, v in cols.items()}
+        n_rows = len(next(iter(cols.values()))) if cols else 0
+        stats.leaf_rows[src.alias] = \
+            stats.leaf_rows.get(src.alias, 0) + n_rows
+        prefixed = {f"{src.alias}.{c}": v for c, v in cols.items()}
+        key_vals = [_eval_rows(prefixed, k, None, n_rows)
+                    for k in key_exprs[src.alias]]
+        part = exchange.stable_hash64(key_vals, n_rows) % P
+        deadline.check("exchange.partition")
+        with span(f"exchange_send:{src.alias}"):
+            for p, rows in enumerate(
+                    join_ops.hash_partition_rows(part, P)):
+                # EVERY partition ships, empty included: the owner's
+                # gather then always sees dtyped arrays for both sides
+                send(owners[p], src.alias, p,
+                     {c: np.asarray(v)[rows] for c, v in cols.items()},
+                     len(rows))
+    done()
+
+    # ---- barrier: all senders done, all announced payloads arrived ----
+    with span("exchange_barrier"):
+        mailbox.wait_ready(spec["senders"], deadline)
+
+    # ---- stage 2 per owned partition: build+probe join + partials ----
+    owned = sorted(p for p, o in owners.items() if o == self_id)
+    partials = []
+    total_joined = 0
+    for p in owned:
+        deadline.check("exchange.stage2")
+        probe_cols, n_probe = mailbox.gather(probe_alias, p)
+        build_cols, n_build = mailbox.gather(build_alias, p)
+        if not probe_cols:
+            probe_cols = {c: np.empty(0) for c in need[probe_alias]}
+        if not build_cols:
+            build_cols = {c: np.empty(0) for c in need[build_alias]}
+        left = {f"{probe_alias}.{c}": np.asarray(v)
+                for c, v in probe_cols.items()}
+        build = {f"{build_alias}.{c}": np.asarray(v)
+                 for c, v in build_cols.items()}
+        with span(f"exchange_join:p{p}"):
+            joined, n_j = execute_join_step(
+                left, n_probe, step, build, device, mesh, "SHUFFLE")
+        if plan.post_filter is not None and n_j:
+            m = _expr_mask(joined, plan.post_filter, None, n_j)
+            joined = {k: v[m] for k, v in joined.items()}
+            n_j = int(m.sum())
+        total_joined += n_j
+        with span(f"exchange_stage2:p{p}"):
+            partials.append(stage2_partial(plan, joined, n_j, {}, device))
+    if partials:
+        merged = merge_intermediates(plan.stage2, partials)
+    else:
+        # belt-and-braces: a worker that owns no partition still returns
+        # a well-typed empty partial over the canonical joined namespace
+        empty = {f"{a}.{c}": np.empty(0)
+                 for a, cs in need.items() for c in cs}
+        merged = stage2_partial(plan, empty, 0, {}, device)
+    stats.stage2_rows = total_joined
+    merged.stats = stats
+    return merged
 
 
 def execute_multistage(engine, stmt, t0: Optional[float] = None) -> dict:

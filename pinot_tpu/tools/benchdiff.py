@@ -39,7 +39,13 @@ Compared metric families (direction-aware):
 - the overload-survival phase (``overload.knee_qps`` — higher is
   better — ``overload.p99_at_2x_knee_ms`` and
   ``overload.tenant_b.spike_p99_ms`` — lower is better — ISSUE 14),
-  compared only when BOTH rounds carry a ``detail.overload`` section.
+  compared only when BOTH rounds carry a ``detail.overload`` section,
+- the join phase (``join.join_p50_ms`` — lower is better — and the
+  distributed stage-2 exchange trend keys ``join.stage2_qps`` — higher
+  is better — ``join.exchange_bytes`` / ``join.spill_count`` —
+  informational wire-volume and warm-tier-spill trackers, never gated:
+  both move legitimately with partition count and buffer sizing —
+  ISSUE 16), compared only when BOTH rounds carry the keys.
 """
 
 from __future__ import annotations
@@ -243,6 +249,22 @@ def extract_metrics(detail: dict) -> dict:
             v = _num(tb.get("spike_p99_ms"))
             if v is not None:
                 out["overload.tenant_b.spike_p99_ms"] = (v, "lower")
+    # join phase (ISSUE 16): star-join p50 plus the distributed
+    # stage-2 exchange trend line — QPS gates, wire volume and spill
+    # count ride along informationally (see diff_rounds: info metrics
+    # are reported but never regress)
+    joi = detail.get("join")
+    if isinstance(joi, dict):
+        v = _num(joi.get("join_p50_ms"))
+        if v is not None:
+            out["join.join_p50_ms"] = (v, "lower")
+        v = _num(joi.get("stage2_qps"))
+        if v is not None:
+            out["join.stage2_qps"] = (v, "higher")
+        for k in ("exchange_bytes", "spill_count"):
+            v = _num(joi.get(k))
+            if v is not None:
+                out[f"join.{k}"] = (v, "info")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of
@@ -273,6 +295,11 @@ def diff_rounds(old: dict, new: dict, threshold: float,
             continue
         ratio = vn / vo
         entry = {"old": vo, "new": vn, "ratio": round(ratio, 3)}
+        if direction == "info":
+            # trend-only metric (exchange wire volume, spill count):
+            # reported, never a regression or an improvement
+            report["unchanged"][name] = entry
+            continue
         worse = ratio > 1 + threshold if direction == "lower" \
             else ratio < 1 - threshold
         better = ratio < 1 - threshold if direction == "lower" \

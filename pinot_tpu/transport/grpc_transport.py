@@ -28,6 +28,12 @@ SUBMIT_STREAMING_METHOD = "/pinot.PinotQueryServer/SubmitStreaming"
 # tar of a segment dir it serves to a replica whose deep-store copy is
 # unreachable
 FETCH_SEGMENT_METHOD = "/pinot.PinotQueryServer/FetchSegment"
+# distributed stage-2 exchange (mailbox leapfrog — the reference snapshot
+# has no pinot-query-runtime): ExecuteStage is the broker→server "run your
+# slice of stage 2" request; ExchangeTransfer is the server→server
+# partition payload (query2/exchange.py wire codec)
+EXECUTE_STAGE_METHOD = "/pinot.PinotQueryServer/ExecuteStage"
+EXCHANGE_TRANSFER_METHOD = "/pinot.PinotQueryServer/ExchangeTransfer"
 
 
 def make_instance_request(sql: str, segments: list, request_id: int,
@@ -80,15 +86,36 @@ def parse_instance_request(data: bytes) -> dict:
 class _BytesHandler(grpc.GenericRpcHandler):
     def __init__(self, submit_fn: Callable[[bytes], bytes],
                  submit_streaming_fn: Optional[Callable] = None,
-                 fetch_segment_fn: Optional[Callable] = None):
+                 fetch_segment_fn: Optional[Callable] = None,
+                 execute_stage_fn: Optional[Callable] = None,
+                 exchange_transfer_fn: Optional[Callable] = None):
         self._submit = submit_fn
         self._submit_streaming = submit_streaming_fn
         self._fetch_segment = fetch_segment_fn
+        self._execute_stage = execute_stage_fn
+        self._exchange_transfer = exchange_transfer_fn
 
     def service(self, handler_call_details):
         if handler_call_details.method == SUBMIT_METHOD:
             return grpc.unary_unary_rpc_method_handler(
                 lambda req, ctx: self._submit(req),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        if (handler_call_details.method == EXECUTE_STAGE_METHOD
+                and self._execute_stage is not None):
+            # broker → server: run one worker's slice of distributed
+            # stage 2 (scan, partition, ship, join, partial-aggregate)
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._execute_stage(req),
+                request_deserializer=None,
+                response_serializer=None,
+            )
+        if (handler_call_details.method == EXCHANGE_TRANSFER_METHOD
+                and self._exchange_transfer is not None):
+            # server → server: one hash-partition payload for a mailbox
+            return grpc.unary_unary_rpc_method_handler(
+                lambda req, ctx: self._exchange_transfer(req),
                 request_deserializer=None,
                 response_serializer=None,
             )
@@ -118,11 +145,14 @@ class QueryServerTransport:
     def __init__(self, submit_fn: Callable[[bytes], bytes],
                  host: str = "127.0.0.1", port: int = 0, max_workers: int = 8,
                  submit_streaming_fn: Optional[Callable] = None, tls=None,
-                 fetch_segment_fn: Optional[Callable] = None):
+                 fetch_segment_fn: Optional[Callable] = None,
+                 execute_stage_fn: Optional[Callable] = None,
+                 exchange_transfer_fn: Optional[Callable] = None):
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             handlers=(_BytesHandler(submit_fn, submit_streaming_fn,
-                                    fetch_segment_fn),),
+                                    fetch_segment_fn, execute_stage_fn,
+                                    exchange_transfer_fn),),
         )
         if tls is not None:
             # TlsConfig (common/tls.py) — the reference's Netty/gRPC TLS
@@ -168,9 +198,26 @@ class QueryRouterChannel:
             FETCH_SEGMENT_METHOD, request_serializer=None,
             response_deserializer=None,
         )
+        self._execute_stage = self._channel.unary_unary(
+            EXECUTE_STAGE_METHOD, request_serializer=None,
+            response_deserializer=None,
+        )
+        self._exchange_transfer = self._channel.unary_unary(
+            EXCHANGE_TRANSFER_METHOD, request_serializer=None,
+            response_deserializer=None,
+        )
 
     def submit(self, request: bytes, timeout_s: float) -> bytes:
         return self._submit(request, timeout=timeout_s)
+
+    def execute_stage(self, request: bytes, timeout_s: float) -> bytes:
+        """Distributed stage-2: DataTable of the worker's merged
+        partition partials."""
+        return self._execute_stage(request, timeout=timeout_s)
+
+    def transfer(self, request: bytes, timeout_s: float) -> bytes:
+        """Exchange payload → JSON ack {ok, spilled, softLimit}."""
+        return self._exchange_transfer(request, timeout=timeout_s)
 
     def fetch_segment(self, request: bytes, timeout_s: float):
         """Peer segment download: iterator of tar chunks."""

@@ -1,0 +1,505 @@
+"""Distributed stage-2 exchange (ISSUE 16) — differential + chaos suite.
+
+The mailbox exchange (query2/exchange.py + server ExecuteStage /
+ExchangeTransfer RPCs) must be invisible to results: a fact-fact join
+under ``SET joinStrategy = 'distributed'`` answers bit-identically to the
+broker-local SHUFFLE mirror and a sqlite3 oracle — sealed + consuming
+segments, host-only + mesh-device servers, with and without warm-tier
+spills (simulated via a tiny mailbox buffer). Also pins:
+
+- the wire codec + stable partition hash (value-identical keys hash
+  equal across dtypes; empty partitions still ship dtyped),
+- the planner demotion past BROADCAST_MAX_BUILD_ROWS (effective strategy
+  + joinStrategyDemoted reported),
+- EXPLAIN / EXPLAIN ANALYZE rendering of the DISTRIBUTED boundary with
+  partition/shipped/spill actuals,
+- chaos at the ``exchange.transfer`` seam: error → replica retry with
+  PEER attribution; blackhole → deadline-bounded; unrecoverable → typed
+  partialResult, never a hang.
+"""
+
+import math
+import sqlite3
+import time
+
+import numpy as np
+import pytest
+
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.cluster.registry import ClusterRegistry
+from pinot_tpu.common import faults
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+
+N_FACT = 3000
+N_SHIP = 900
+N_KEYS = 50
+
+
+def _wait_until(cond, timeout=15.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _norm(v):
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return float(v)
+    if isinstance(v, (int, float)):
+        f = float(v)
+        return None if math.isnan(f) else round(f, 6)
+    return v
+
+
+def _rows(resp):
+    assert not resp.get("exceptions"), resp.get("exceptions")
+    return [[_norm(v) for v in r] for r in resp["resultTable"]["rows"]]
+
+
+def _data():
+    rng = np.random.default_rng(23)
+    # integer measures only: float SUM partials merge in partition order,
+    # which is not bit-stable across fan-outs (documented in PARITY.md)
+    fact = {
+        "k": rng.integers(0, N_KEYS + 6, N_FACT).astype(np.int32),
+        "status": np.array(["open", "paid", "void"])[
+            rng.integers(0, 3, N_FACT)],
+        "v": rng.integers(1, 40, N_FACT).astype(np.int32),
+    }
+    ship = {
+        "k2": rng.integers(0, N_KEYS, N_SHIP).astype(np.int32),
+        "mode": np.array(["air", "sea", "rail"])[
+            rng.integers(0, 3, N_SHIP)],
+        "w": rng.integers(1, 9, N_SHIP).astype(np.int32),
+    }
+    return fact, ship
+
+
+def _schemas():
+    fact = Schema.build(
+        name="fa",
+        dimensions=[("k", DataType.INT), ("status", DataType.STRING)],
+        metrics=[("v", DataType.INT)],
+    )
+    ship = Schema.build(
+        name="fb",
+        dimensions=[("k2", DataType.INT), ("mode", DataType.STRING)],
+        metrics=[("w", DataType.INT)],
+    )
+    return fact, ship
+
+
+def _make_cluster(tmp, device_executors=None):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp / "ds"))
+    devs = device_executors or [None, None]
+    servers = [
+        ServerInstance(f"server_{i}", registry, str(tmp / f"s{i}"),
+                       device_executor=devs[i])
+        for i in range(2)
+    ]
+    for s in servers:
+        s.start()
+    broker = Broker(registry, timeout_s=15.0)
+    fact, ship = _data()
+    fact_schema, ship_schema = _schemas()
+    for name, schema, data, keycol in (("fa", fact_schema, fact, "k"),
+                                       ("fb", ship_schema, ship, "k2")):
+        cfg = TableConfig(table_name=name, replication=2)
+        controller.add_table(cfg, schema)
+        n = len(data[keycol])
+        for i, sl in enumerate([slice(0, n // 2), slice(n // 2, n)]):
+            build_segment(schema, {k: v[sl] for k, v in data.items()},
+                          str(tmp / f"{name}up{i}"), cfg, f"{name}{i}")
+            controller.upload_segment(name, str(tmp / f"{name}up{i}"))
+    assert _wait_until(
+        lambda: len(registry.external_view("fa_OFFLINE")) == 2
+        and len(registry.external_view("fb_OFFLINE")) == 2)
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE fa (k INT, status TEXT, v INT)")
+    con.executemany("INSERT INTO fa VALUES (?,?,?)",
+                    list(zip(*(fact[c].tolist()
+                               for c in ("k", "status", "v")))))
+    con.execute("CREATE TABLE fb (k2 INT, mode TEXT, w INT)")
+    con.executemany("INSERT INTO fb VALUES (?,?,?)",
+                    list(zip(*(ship[c].tolist()
+                               for c in ("k2", "mode", "w")))))
+    return registry, controller, servers, broker, con
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("exchange")
+    registry, controller, servers, broker, con = _make_cluster(tmp)
+    yield registry, controller, servers, broker, con
+    broker.close()
+    for s in servers:
+        s.stop()
+
+
+def _reset_failures(broker):
+    for inst in ("server_0", "server_1"):
+        for _ in range(4):
+            broker.failures.mark_success(inst)
+
+
+GROUP_SQL = ("SELECT b.mode, COUNT(*), SUM(a.v), SUM(b.w) FROM fa a "
+             "JOIN fb b ON a.k = b.k2 WHERE a.status = 'paid' "
+             "GROUP BY b.mode ORDER BY b.mode")
+SELECT_SQL = ("SELECT a.k, b.mode, a.v FROM fa a "
+              "JOIN fb b ON a.k = b.k2 WHERE a.status = 'void' "
+              "ORDER BY a.k, b.mode, a.v LIMIT 40")
+LEFT_SQL = ("SELECT a.k, COUNT(*), SUM(b.w) FROM fa a "
+            "LEFT JOIN fb b ON a.k = b.k2 WHERE a.status = 'open' "
+            "GROUP BY a.k ORDER BY a.k LIMIT 30")
+
+
+class TestDistributedParity:
+    @pytest.mark.parametrize("sql", [GROUP_SQL, SELECT_SQL, LEFT_SQL],
+                             ids=["group_by", "selection", "left_join"])
+    def test_parity_vs_local_and_oracle(self, cluster, sql):
+        _, _, _, broker, con = cluster
+        oracle = [[_norm(v) for v in r] for r in con.execute(sql)]
+        local = broker.execute(f"SET joinStrategy = 'shuffle'; {sql}")
+        dist = broker.execute(f"SET joinStrategy = 'distributed'; {sql}")
+        assert _rows(local) == oracle
+        assert _rows(dist) == oracle
+        assert dist["joinStrategy"] == "DISTRIBUTED"
+        assert local["joinStrategy"] == "SHUFFLE"
+
+    def test_exchange_counters(self, cluster):
+        _, _, _, broker, _ = cluster
+        local = broker.execute(f"SET joinStrategy = 'shuffle'; {GROUP_SQL}")
+        dist = broker.execute(
+            f"SET joinStrategy = 'distributed'; {GROUP_SQL}")
+        assert dist["numServersQueried"] == 2
+        assert dist["numServersResponded"] == 2
+        assert dist["numStages"] == 2
+        assert dist["numPartitionsShipped"] > 0
+        assert dist["exchangeBytes"] > 0
+        assert dist["exchangeSpillCount"] == 0
+        assert dist["numJoinedRows"] == local["numJoinedRows"]
+        ex = dist["exchange"]
+        assert ex["numWorkers"] == 2
+        assert ex["partitions"] == 4  # 2x workers
+        assert dist["joinFanout"] == 4
+        per = ex["servers"]
+        assert set(per) == {"server_0", "server_1"}
+        assert sum(v["stage2Rows"] for v in per.values()) \
+            == dist["numJoinedRows"]
+        # every worker scanned its share of both leaves
+        total_leaf = {}
+        for v in per.values():
+            for alias, n in v["leafRows"].items():
+                total_leaf[alias] = total_leaf.get(alias, 0) + n
+        assert total_leaf == dist["leafRows"]
+        # the broker-local mirror now reports its fan-out too (satellite)
+        assert local["joinFanout"] == 1
+
+    def test_trace_merges_per_server_spans(self, cluster):
+        _, _, _, broker, _ = cluster
+        resp = broker.execute(
+            f"SET joinStrategy = 'distributed'; SET trace = true; "
+            f"{GROUP_SQL}")
+        assert not resp.get("exceptions"), resp.get("exceptions")
+        ti = resp.get("traceInfo") or {}
+        assert {"stage2:server_0", "stage2:server_1"} <= set(ti)
+
+    def test_spill_path_stays_bit_exact(self, cluster):
+        _, _, servers, broker, con = cluster
+        oracle = [[_norm(v) for v in r] for r in con.execute(GROUP_SQL)]
+        limits = [s.exchanges.spill_limit_bytes for s in servers]
+        for s in servers:
+            s.exchanges.spill_limit_bytes = 512
+        try:
+            dist = broker.execute(
+                f"SET joinStrategy = 'distributed'; {GROUP_SQL}")
+        finally:
+            for s, lim in zip(servers, limits):
+                s.exchanges.spill_limit_bytes = lim
+        assert _rows(dist) == oracle
+        assert dist["exchangeSpillCount"] > 0
+
+    def test_demotion_past_broadcast_cap(self, cluster, monkeypatch):
+        """An unforced SHUFFLE plan whose build side exceeds the
+        broadcast cap (per registry doc counts) demotes to DISTRIBUTED
+        at runtime; querylog/template_key see the mutated strategy."""
+        from pinot_tpu.query2 import logical
+
+        _, _, _, broker, con = cluster
+        monkeypatch.setattr(logical, "BROADCAST_MAX_BUILD_ROWS", 100)
+        resp = broker.execute(GROUP_SQL)
+        assert resp["joinStrategy"] == "DISTRIBUTED"
+        assert resp.get("joinStrategyDemoted") is True
+        assert _rows(resp) == [[_norm(v) for v in r]
+                               for r in con.execute(GROUP_SQL)]
+
+    def test_forced_but_unroutable_falls_back_local(self, tmp_path):
+        """SET joinStrategy='distributed' against an embedded engine (no
+        fleet at all) must still answer — through the broker-local
+        SHUFFLE mirror — and report the EFFECTIVE strategy."""
+        from pinot_tpu.engine.engine import QueryEngine
+
+        fact, ship = _data()
+        fact_schema, ship_schema = _schemas()
+        eng = QueryEngine(device_executor=None)
+        eng.add_segment("fa", build_segment(
+            fact_schema, fact, str(tmp_path / "fa"),
+            TableConfig(table_name="fa"), "fa0"))
+        eng.add_segment("fb", build_segment(
+            ship_schema, ship, str(tmp_path / "fb"),
+            TableConfig(table_name="fb"), "fb0"))
+        local = eng.execute(f"SET joinStrategy = 'shuffle'; {GROUP_SQL}")
+        dist = eng.execute(f"SET joinStrategy = 'distributed'; {GROUP_SQL}")
+        assert _rows(dist) == _rows(local)
+        assert dist["joinStrategy"] == "SHUFFLE"  # what actually ran
+
+
+class TestDistributedExplain:
+    def test_explain_renders_distributed_boundary(self, cluster):
+        _, _, _, broker, _ = cluster
+        resp = broker.execute(
+            f"SET joinStrategy = 'distributed'; EXPLAIN PLAN FOR "
+            f"{GROUP_SQL}")
+        text = "\n".join(r[0] for r in resp["resultTable"]["rows"])
+        assert "STAGE_BOUNDARY(exchange:DISTRIBUTED [server-fleet])" \
+            in text
+        assert "strategy=DISTRIBUTED" in text
+
+    def test_explain_analyze_exchange_actuals(self, cluster):
+        _, _, _, broker, _ = cluster
+        resp = broker.execute(
+            f"SET joinStrategy = 'distributed'; EXPLAIN ANALYZE "
+            f"{GROUP_SQL}")
+        assert not resp.get("exceptions"), resp.get("exceptions")
+        text = "\n".join(r[0] for r in resp["resultTable"]["rows"])
+        boundary = next(ln for ln in text.splitlines()
+                        if "STAGE_BOUNDARY" in ln)
+        assert "exchange:DISTRIBUTED" in boundary
+        assert "partitions=4" in boundary
+        assert "shippedBytes=" in boundary
+        assert "spills=" in boundary
+        assert "stage2Rows[" in boundary
+        assert "server_0=" in boundary and "server_1=" in boundary
+
+
+class TestDistributedChaos:
+    def test_error_faults_retry_on_replica(self, cluster):
+        """Kill every transfer addressed to server_1: attempt 1 answers
+        a typed EXCHANGE_TRANSFER_FAILED naming the peer, the retry
+        excludes server_1 and completes bit-exact on the replicas."""
+        _, _, _, broker, con = cluster
+        _reset_failures(broker)
+        f = faults.install(faults.Fault(
+            point="exchange.transfer", target="server_1", mode="error"))
+        try:
+            resp = broker.execute(
+                f"SET joinStrategy = 'distributed'; {GROUP_SQL}")
+        finally:
+            faults.clear()
+            _reset_failures(broker)
+        assert _rows(resp) == [[_norm(v) for v in r]
+                               for r in con.execute(GROUP_SQL)]
+        assert resp["numRetries"] == 1
+        assert f.fired > 0
+        assert set(resp["exchange"]["servers"]) == {"server_0"}
+
+    def test_blackhole_bounded_by_deadline(self, cluster):
+        """A blackholed receiver must not hang the query: the sender's
+        injected stall is bounded by the stage deadline, the failure
+        comes back typed, and the retry (or typed partial) lands inside
+        the query budget."""
+        _, _, _, broker, con = cluster
+        _reset_failures(broker)
+        faults.install(faults.Fault(
+            point="exchange.transfer", target="server_1",
+            mode="blackhole"))
+        t0 = time.time()
+        try:
+            resp = broker.execute(
+                f"SET joinStrategy = 'distributed'; "
+                f"SET timeoutMs = 4000; {GROUP_SQL}")
+        finally:
+            faults.clear()
+            _reset_failures(broker)
+        wall = time.time() - t0
+        assert wall < 8.0, wall
+        if resp.get("exceptions"):
+            assert resp.get("partialResult") is True
+        else:
+            assert _rows(resp) == [[_norm(v) for v in r]
+                                   for r in con.execute(GROUP_SQL)]
+            assert resp["numRetries"] == 1
+
+    def test_unrecoverable_returns_typed_partial(self, cluster, caplog):
+        """Faults on EVERY instance: no replica can cover the exchange —
+        the broker answers a typed partialResult inside the deadline
+        instead of hanging or retrying forever."""
+        import logging
+
+        _, _, _, broker, con = cluster
+        _reset_failures(broker)
+        faults.install(faults.Fault(point="exchange.transfer",
+                                    mode="error"))
+        t0 = time.time()
+        try:
+            with caplog.at_level(logging.CRITICAL,
+                                 logger="pinot_tpu.broker.broker"):
+                resp = broker.execute(
+                    f"SET joinStrategy = 'distributed'; "
+                    f"SET timeoutMs = 5000; {GROUP_SQL}")
+        finally:
+            faults.clear()
+            _reset_failures(broker)
+        assert time.time() - t0 < 6.0
+        assert resp.get("partialResult") is True
+        excs = resp.get("exceptions")
+        assert excs and "distributed stage-2 failed" in excs[0]["message"]
+        # the fleet answers normally once the faults clear
+        ok = broker.execute(f"SET joinStrategy = 'distributed'; "
+                            f"{GROUP_SQL}")
+        assert _rows(ok) == [[_norm(v) for v in r]
+                             for r in con.execute(GROUP_SQL)]
+
+
+class TestDistributedConsuming:
+    def test_sealed_plus_consuming_parity(self, cluster):
+        """A realtime table mid-consumption joins distributed against a
+        sealed fact table bit-exactly: consuming chunklets ride the same
+        routed-segment scan as sealed segments."""
+        from pinot_tpu.stream.memory_stream import TopicRegistry
+
+        registry, controller, servers, broker, con = cluster
+        _reset_failures(broker)
+        TopicRegistry.delete("exch_clicks")
+        topic = TopicRegistry.create("exch_clicks", 1)
+        schema = Schema.build(
+            name="rt",
+            dimensions=[("k3", DataType.INT)],
+            metrics=[("n", DataType.INT)],
+        )
+        cfg = TableConfig(
+            table_name="rt", table_type=TableType.REALTIME, replication=2,
+            stream=StreamConfig(
+                stream_type="memory", topic="exch_clicks", decoder="json",
+                segment_flush_threshold_rows=100000,
+                segment_flush_threshold_seconds=3600,
+            ),
+        )
+        controller.add_table(cfg, schema)
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, N_KEYS, 300)
+        vals = rng.integers(1, 20, 300)
+        for k, n in zip(keys.tolist(), vals.tolist()):
+            topic.publish_json({"k3": k, "n": n})
+
+        def _count():
+            r = broker.execute("SELECT COUNT(*) FROM rt")
+            if r.get("exceptions"):
+                return -1
+            return r["resultTable"]["rows"][0][0]
+
+        assert _wait_until(lambda: _count() == 300, timeout=20), _count()
+        con.execute("CREATE TABLE rt (k3 INT, n INT)")
+        con.executemany("INSERT INTO rt VALUES (?,?)",
+                        list(zip(keys.tolist(), vals.tolist())))
+        sql = ("SELECT r.k3, COUNT(*), SUM(a.v), SUM(r.n) FROM fa a "
+               "JOIN rt r ON a.k = r.k3 WHERE a.status = 'paid' "
+               "GROUP BY r.k3 ORDER BY r.k3 LIMIT 25")
+        oracle = [[_norm(v) for v in r] for r in con.execute(sql)]
+        local = broker.execute(f"SET joinStrategy = 'shuffle'; {sql}")
+        dist = broker.execute(f"SET joinStrategy = 'distributed'; {sql}")
+        assert _rows(local) == oracle
+        assert _rows(dist) == oracle
+        assert dist["joinStrategy"] == "DISTRIBUTED"
+
+
+class TestDistributedMesh:
+    @pytest.fixture(scope="class")
+    def mesh_cluster(self, tmp_path_factory):
+        from pinot_tpu.engine.device import DeviceExecutor
+        from pinot_tpu.parallel.mesh import make_mesh
+
+        tmp = tmp_path_factory.mktemp("exchange_mesh")
+        devs = [DeviceExecutor(mesh=make_mesh(8)), None]
+        registry, controller, servers, broker, con = \
+            _make_cluster(tmp, device_executors=devs)
+        yield broker, con
+        broker.close()
+        for s in servers:
+            s.stop()
+
+    def test_mesh_and_host_workers_agree(self, mesh_cluster):
+        """One mesh-device worker + one host worker in the same
+        exchange: integer stage-2 partials are exact on both backends,
+        so the merged answer matches the oracle bit-for-bit."""
+        broker, con = mesh_cluster
+        oracle = [[_norm(v) for v in r] for r in con.execute(GROUP_SQL)]
+        dist = broker.execute(
+            f"SET joinStrategy = 'distributed'; {GROUP_SQL}")
+        assert _rows(dist) == oracle
+        assert dist["joinStrategy"] == "DISTRIBUTED"
+        assert dist["numServersQueried"] == 2
+
+
+class TestExchangePrimitives:
+    def test_stable_hash_dtype_independent(self):
+        from pinot_tpu.query2 import exchange
+
+        a = np.array([1, 2, 3, 1 << 40], dtype=np.int64)
+        b = a.astype(np.float64)
+        ha = exchange.stable_hash64([a], 4)
+        hb = exchange.stable_hash64([b], 4)
+        assert (ha == hb).all()
+        assert (ha >= 0).all()
+        # strings hash by value too
+        s1 = np.array(["x", "y", "x"], dtype=object)
+        s2 = np.array(["x", "y", "x"])
+        assert (exchange.stable_hash64([s1], 3)
+                == exchange.stable_hash64([s2], 3)).all()
+
+    def test_wire_roundtrip_empty_partition_keeps_dtype(self):
+        from pinot_tpu.query2 import exchange
+
+        cols = {"k": np.empty(0, dtype=np.int64),
+                "s": np.empty(0, dtype="U1")}
+        payload = exchange.encode_transfer("e1", "s0", "a", 3, cols, 0)
+        msg = exchange.decode_transfer(payload)
+        assert msg["n"] == 0 and msg["partition"] == 3
+        assert msg["cols"]["k"].dtype == np.int64
+        assert msg["cols"]["k"].shape == (0,)
+
+    def test_buffer_spills_and_gathers_in_order(self, tmp_path):
+        from pinot_tpu.query2 import exchange
+
+        buf = exchange.ExchangeBuffer("e2", str(tmp_path / "spill"),
+                                      spill_limit_bytes=64)
+        buf.offer("s0", "a", 0, {"v": np.arange(50, dtype=np.int64)}, 50)
+        buf.offer("s1", "a", 0, {"v": np.arange(50, 80,
+                                                dtype=np.int64)}, 30)
+        assert buf.spill_count > 0
+        buf.mark_done("s0", {"a": {"0": 1}})
+        buf.mark_done("s1", {"a": {"0": 1}})
+
+        class _NoDeadline:
+            def remaining_s(self):
+                return 5.0
+
+            def check(self, where=None):
+                return None
+
+        buf.wait_ready(["s0", "s1"], _NoDeadline())
+        cols, n = buf.gather("a", 0)
+        assert n == 80
+        got = np.sort(np.asarray(cols["v"]))
+        assert (got == np.arange(80)).all()
+        buf.close()
