@@ -3368,6 +3368,223 @@ def bench_overload(knee_window_s: float = 2.0, spike_window_s: float = 4.0):
     return detail, violations
 
 
+def bench_adaptive(train_n: int = 8, iters: int = 7):
+    """detail.adaptive: the feedback-loop phase (ISSUE 17). Two defaults
+    are deliberately mis-tuned and the plan advisor must rescue both
+    from measurements alone:
+
+    - **join**: a fact-sized (> BROADCAST_MAX_BUILD_ROWS) build table is
+      mis-registered as a dimension table, so the static planner picks
+      BROADCAST for a fact-fact shape. The advisor's measured build-side
+      rows must converge the pick to SHUFFLE (stamped
+      ``ADVISOR(joinStrategy=...)``).
+    - **blockskip**: a range filter with interval structure the zone
+      maps can act on but ZERO selectivity (every block matches), so the
+      default engages the skip path and pays candidate-gather + in-kernel
+      dense-fallback overhead for nothing. The advisor's measured
+      ``blocks_scanned/blocks_total`` must converge the template to the
+      dense form (stamped ``ADVISOR(blockSkip=dense)``).
+
+    Gates (standalone: ``python -m bench --phase adaptive`` exits 11,
+    after the full run's other gates):
+
+    - each scenario converges (first stamped response) within
+      ``train_n`` queries;
+    - post-convergence advisor-on p50 lands within 10% of the hand-tuned
+      p50 (``SET joinStrategy='shuffle'`` / ``SET useBlockSkip=false``
+      with the advisor off) — a 0.5 ms absolute allowance absorbs timer
+      jitter on fast queries;
+    - ZERO parity drift: every advisor-on response row-set is bit-exact
+      against its ``SET useAdvisor=false`` twin, throughout training and
+      after convergence;
+    - the learned decisions are visible in EXPLAIN ANALYZE.
+
+    ``usePartialsCache=false`` rides every single-stage query so each
+    execution is real (a cache hit would neither measure nor prove
+    parity); queries-to-converge is reported as an info trend line for
+    benchdiff, never gated (it moves with min-samples/reprobe tuning)."""
+    import shutil
+    import tempfile
+
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import IndexingConfig, TableConfig
+    from pinot_tpu.engine.engine import QueryEngine
+    from pinot_tpu.query2.logical import BROADCAST_MAX_BUILD_ROWS
+    from pinot_tpu.storage.creator import build_segment
+
+    rng = np.random.default_rng(47)
+    base = tempfile.mkdtemp(prefix="bench_adaptive_")
+    detail: dict = {}
+    violations: list = []
+
+    def rows_of(resp):
+        if resp.get("exceptions"):
+            raise RuntimeError(f"adaptive phase query failed: "
+                               f"{resp['exceptions'][0]}")
+        return resp["resultTable"]["rows"]
+
+    def p50_of(eng, sql, warm: int = 1):
+        for _ in range(warm):
+            rows_of(eng.execute(sql))
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            rows_of(eng.execute(sql))
+            lat.append((time.perf_counter() - t0) * 1e3)
+        return float(np.percentile(lat, 50))
+
+    def train(eng, scenario, sql_of, stamp):
+        """Run advisor-on queries (varying literals so every execution
+        measures) until a response carries ``stamp``; each one is parity-
+        checked bit-exact against its SET useAdvisor=false twin."""
+        converge_at = None
+        for i in range(1, train_n + 1):
+            sql = sql_of(i)
+            resp = eng.execute(sql)
+            twin = eng.execute(f"SET useAdvisor = false; {sql}")
+            if rows_of(resp) != rows_of(twin):
+                violations.append({
+                    "scenario": scenario, "check": "parity", "query": i,
+                    "got": rows_of(resp)[:3], "expected": rows_of(twin)[:3]})
+            if converge_at is None and any(
+                    stamp in line
+                    for line in resp.get("advisorDecisions") or ()):
+                converge_at = i
+        if converge_at is None:
+            violations.append({
+                "scenario": scenario,
+                "check": f"convergence within {train_n} queries",
+                "stamp": stamp})
+        return converge_at
+
+    def gate_p50(scenario, converged, hand):
+        if converged > hand * 1.10 + 0.5:
+            violations.append({
+                "scenario": scenario,
+                "check": "converged p50 within 10% of hand-tuned",
+                "converged_p50_ms": round(converged, 2),
+                "hand_tuned_p50_ms": round(hand, 2)})
+
+    try:
+        eng = QueryEngine()
+
+        # ---- scenario 1: mis-tuned join strategy -------------------------
+        # build side: 1 row past the broadcast cap, mis-flagged dim
+        n_build = BROADCAST_MAX_BUILD_ROWS + 1
+        n_fact = 120_000
+        build_schema = Schema.build(
+            name="adaptdim",
+            dimensions=[("bkey", DataType.LONG), ("grp", DataType.LONG)],
+            primary_key_columns=["bkey"])
+        fact_schema = Schema.build(
+            name="adaptfact",
+            dimensions=[("k", DataType.LONG)],
+            metrics=[("rev", DataType.LONG)])
+        eng.add_segment("adaptdim", build_segment(
+            build_schema,
+            {"bkey": np.arange(n_build, dtype=np.int64),
+             "grp": (np.arange(n_build, dtype=np.int64) % 40)},
+            os.path.join(base, "dim"),
+            TableConfig(table_name="adaptdim", is_dim_table=True), "d0"))
+        eng.add_segment("adaptfact", build_segment(
+            fact_schema,
+            {"k": rng.integers(0, n_build, n_fact).astype(np.int64),
+             "rev": rng.integers(1, 1000, n_fact).astype(np.int64)},
+            os.path.join(base, "fact"),
+            TableConfig(table_name="adaptfact"), "f0"))
+        eng.table("adaptdim").is_dim_table = True
+
+        join_sql = (
+            "SELECT d.grp, SUM(o.rev) FROM adaptfact o "
+            "JOIN adaptdim d ON o.k = d.bkey "
+            "GROUP BY d.grp ORDER BY d.grp LIMIT 50")
+        # literals don't vary (the multi-stage path re-executes fully);
+        # the template key is literal-free either way
+        join_converge = train(eng, "join", lambda i: join_sql,
+                              "ADVISOR(joinStrategy=SHUFFLE")
+        join_hand = p50_of(eng, "SET useAdvisor = false; "
+                                "SET joinStrategy = 'shuffle'; " + join_sql)
+        join_mistuned = p50_of(eng, "SET useAdvisor = false; " + join_sql)
+        join_converged = p50_of(eng, join_sql)
+        gate_p50("join", join_converged, join_hand)
+        ea = eng.execute("EXPLAIN ANALYZE " + join_sql)
+        join_ea_ok = "ADVISOR(" in json.dumps(ea)
+        if not join_ea_ok:
+            violations.append({"scenario": "join",
+                               "check": "ADVISOR line in EXPLAIN ANALYZE"})
+        detail["join"] = {
+            "n_build_rows": n_build,
+            "queries_to_converge": join_converge,
+            "mistuned_p50_ms": round(join_mistuned, 2),
+            "hand_tuned_p50_ms": round(join_hand, 2),
+            "converged_p50_ms": round(join_converged, 2),
+            "explain_analyze_stamped": join_ea_ok,
+            "note": ("mis-registered dim table past the broadcast cap: "
+                     "the runner's over-cap guard bounds the blast radius "
+                     "at run time; the advisor makes the SHUFFLE pick "
+                     "explicit, stamped, and available to the broker's "
+                     "distributed probe (measured rows beat estimates)"),
+        }
+
+        # ---- scenario 2: mis-tuned block skip ----------------------------
+        # time-ordered zone-mapped table; the training filter matches
+        # EVERY block (selectivity 1.0) so the skip default buys nothing
+        n_seg, seg_rows = 2, 524_288
+        bs_schema = Schema.build(
+            name="adaptbs",
+            dimensions=[("ts", DataType.LONG)],
+            metrics=[("val", DataType.INT)])
+        bs_cfg = TableConfig(
+            table_name="adaptbs",
+            indexing=IndexingConfig(no_dictionary_columns=["ts"]))
+        for i in range(n_seg):
+            n = seg_rows
+            eng.add_segment("adaptbs", build_segment(
+                bs_schema,
+                {"ts": np.int64(i) * n + np.arange(n, dtype=np.int64),
+                 "val": rng.integers(0, 10_000, n).astype(np.int32)},
+                os.path.join(base, f"bs{i}"), bs_cfg, f"bs{i}"))
+        total = n_seg * seg_rows
+
+        def bs_select(i):
+            # literal varies (dodges nothing here — the partials cache is
+            # off — but keeps the training honest about literal-free
+            # template keying); every bound covers the full ts domain
+            return (f"SELECT COUNT(*), SUM(val) FROM adaptbs "
+                    f"WHERE ts BETWEEN 0 AND {total * 10 + i}")
+
+        def bs_sql(i):
+            return "SET usePartialsCache = false; " + bs_select(i)
+
+        bs_converge = train(eng, "blockskip", bs_sql,
+                            "ADVISOR(blockSkip=dense")
+        bs_hand = p50_of(eng, "SET useAdvisor = false; "
+                              "SET useBlockSkip = false; " + bs_sql(0))
+        bs_mistuned = p50_of(eng, "SET useAdvisor = false; " + bs_sql(0))
+        bs_converged = p50_of(eng, bs_sql(0))
+        gate_p50("blockskip", bs_converged, bs_hand)
+        ea = eng.execute("SET usePartialsCache = false; "
+                         "EXPLAIN ANALYZE " + bs_select(0))
+        bs_ea_ok = "ADVISOR(" in json.dumps(ea)
+        if not bs_ea_ok:
+            violations.append({"scenario": "blockskip",
+                               "check": "ADVISOR line in EXPLAIN ANALYZE"})
+        detail["blockskip"] = {
+            "n_rows": total,
+            "queries_to_converge": bs_converge,
+            "mistuned_p50_ms": round(bs_mistuned, 2),
+            "hand_tuned_p50_ms": round(bs_hand, 2),
+            "converged_p50_ms": round(bs_converged, 2),
+            "explain_analyze_stamped": bs_ea_ok,
+        }
+        detail["parity"] = ("asserted bit-exact vs SET useAdvisor=false "
+                            "on every training query, both scenarios")
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return detail, violations
+
+
 def bench_observability(n_queries: int = 24):
     """detail.observability: the flight-recorder phase (ISSUE 7). A
     2-server in-process cluster serves a device group-by; the phase runs
@@ -3714,12 +3931,21 @@ def main():
     ap.add_argument(
         "--phase",
         choices=("full", "faults", "observability", "join", "subrtt",
-                 "cluster", "tiering", "overload"),
+                 "cluster", "tiering", "overload", "adaptive"),
         default="full",
         help="'faults' / 'observability' / 'join' / 'subrtt' / 'cluster' "
-             "/ 'tiering' / 'overload' run ONLY that phase (no dataset "
-             "build) so CI can gate on each standalone")
+             "/ 'tiering' / 'overload' / 'adaptive' run ONLY that phase "
+             "(no dataset build) so CI can gate on each standalone")
     args = ap.parse_args()
+    if args.phase == "adaptive":
+        detail, violations = bench_adaptive()
+        print(json.dumps({"metric": "adaptive-phase standalone",
+                          "detail": {"adaptive": detail}}))
+        if violations:
+            print(f"adaptive gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(11)
+        return
     if args.phase == "overload":
         detail, violations = bench_overload()
         print(json.dumps({"metric": "overload-phase standalone",
@@ -3839,6 +4065,7 @@ def main():
     cluster_detail, cluster_violations = bench_cluster()
     tiering_detail, tiering_violations = bench_tiering()
     overload_detail, overload_violations = bench_overload()
+    adaptive_detail, adaptive_violations = bench_adaptive()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -3905,6 +4132,7 @@ def main():
                     "cluster": cluster_detail,
                     "tiering": tiering_detail,
                     "overload": overload_detail,
+                    "adaptive": adaptive_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -3990,6 +4218,10 @@ def main():
         print(f"overload gate FAILED: {json.dumps(overload_violations)}",
               file=sys.stderr)
         sys.exit(10)
+    if adaptive_violations:
+        print(f"adaptive gate FAILED: {json.dumps(adaptive_violations)}",
+              file=sys.stderr)
+        sys.exit(11)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ from pinot_tpu.cluster.registry import (
 )
 from pinot_tpu.common import faults
 from pinot_tpu.common.deadline import Deadline
+from pinot_tpu.common.options import bool_option
 from pinot_tpu.engine.datatable import decode
 from pinot_tpu.engine.reduce import finalize, merge_intermediates
 from pinot_tpu.engine.result import ExecutionStats, IntermediateResult
@@ -687,6 +688,14 @@ class Broker:
                 "pinot.broker.resultcache.max.bytes", float(32 << 20))),
             stale_retention_s=conf.get_float(
                 "pinot.broker.resultcache.stale.retention.s", 30.0))
+        # feedback-driven plan advisor (ISSUE 17, engine/advisor.py):
+        # the broker's own memo store — measured stage-1 build rows per
+        # multi-stage template feed the distributed-demotion probe and
+        # the join-strategy pick where the registry's doc-count estimate
+        # used to decide alone. None when pinot.advisor.enabled=false.
+        from pinot_tpu.engine.advisor import PlanAdvisor
+
+        self.advisor = PlanAdvisor.from_config(conf)
         # per-tenant priority admission + load shedding (ISSUE 14,
         # broker/admission.py): OFF by default — every existing
         # single-tenant deployment and test keeps its exact semantics
@@ -911,15 +920,11 @@ class Broker:
         adm_key) — reused so the template walk + digest run once per
         query."""
         opts = q.options_ci()
-        use = opts.get("useresultcache")
-        if use is None:
-            enabled = self.result_cache_default
-        elif isinstance(use, bool):
-            enabled = use
-        else:
-            # quoted SET values arrive as strings: 'false' must opt OUT,
-            # not truthy-enable a stale-tolerant path the user refused
-            enabled = str(use).strip().lower() in ("true", "1", "yes")
+        # quoted SET values arrive as strings: 'false' must opt OUT, not
+        # truthy-enable a stale-tolerant path the user refused — the
+        # shared helper folds them uniformly (common/options.py)
+        enabled = bool_option(opts, "useresultcache",
+                              self.result_cache_default)
         if not enabled or faults.ACTIVE:
             # chaos harness armed: fault tests repeat queries on purpose
             # and must observe every injected failure, not a cached hit
@@ -1268,6 +1273,85 @@ class Broker:
             return tuple(schema.column_names()), is_dim
 
         plan = compile_plan(stmt, catalog)
+
+        # plan-advisor hookup (ISSUE 17): measured build rows from past
+        # executions of this template sharpen the demotion probe and the
+        # join-strategy pick; SET useAdvisor=false bypasses both
+        advisor, adv_key = None, None
+        adv_notes: list = []
+        if self.advisor is not None:
+            from pinot_tpu.engine.advisor import advisor_enabled
+            from pinot_tpu.broker.querylog import template_key
+
+            try:
+                if advisor_enabled(plan.stage2.options_ci()):
+                    advisor = self.advisor
+                    adv_key = template_key(plan)
+            except Exception:  # noqa: BLE001 — advice is optional
+                pass
+
+        # ---- distributed stage-2 demotion probe (ISSUE 16) --------------
+        # A fact-fact join whose build side is past the broadcast cap is
+        # exactly the shape where the broker-local shuffle stops scaling:
+        # every build row funnels through this one process no matter how
+        # many servers host the table. Demote it to the server-side
+        # mailbox exchange (query2/exchange.py) when the fleet can route
+        # it. SET joinStrategy='distributed' forces the path; a forced-
+        # but-unroutable plan (hybrid split, unknown table, no live
+        # servers) falls through to the broker-local mirror and the
+        # response reports the EFFECTIVE strategy. The probe runs BEFORE
+        # the EXPLAIN early-return below, so the static plan text renders
+        # the EFFECTIVE (post-demotion) strategy in STAGE_BOUNDARY —
+        # previously only the response/querylog saw the demotion. The
+        # advisor's MEASURED stage-1 build rows (post-pushdown) replace
+        # the registry's raw doc-count estimate once converged — a heavy
+        # pushdown filter no longer demotes a join whose build side
+        # actually arrives small. Quota/admission are not debited on the
+        # distributed path: it has no per-table leaf queries, and stage-1
+        # cost lands on the servers' own schedulers.
+        dist = None
+        if len(plan.joins) == 1 and not plan.windows:
+            want = plan.strategy == "DISTRIBUTED"
+            if not want and plan.strategy == "SHUFFLE" \
+                    and not plan.strategy_forced:
+                build = plan.joins[0].build
+                est = self._estimated_docs(build.table, _table_keys)
+                build_docs = est
+                if advisor is not None:
+                    measured = advisor.measured_build_rows(
+                        adv_key, build.alias)
+                    if measured is not None:
+                        build_docs = measured
+                        if (measured > BROADCAST_MAX_BUILD_ROWS) \
+                                != (est > BROADCAST_MAX_BUILD_ROWS):
+                            adv_notes.append(
+                                f"ADVISOR(distributedDemotion="
+                                f"{'on' if measured > BROADCAST_MAX_BUILD_ROWS else 'off'}: "
+                                f"measured={measured} default={est})")
+                want = build_docs > BROADCAST_MAX_BUILD_ROWS
+            if want and not plan.explain:
+                try:
+                    dist = self._distributed_spec(plan, _table_keys,
+                                                  _schema_for)
+                except Exception:  # noqa: BLE001 — probe must not fail
+                    log.exception("distributed routability probe failed; "
+                                  "falling back to broker-local join")
+                    dist = None
+            elif want and plan.explain:
+                # EXPLAIN renders the routable outcome without paying
+                # the full spec build when the probe fails
+                try:
+                    dist = self._distributed_spec(plan, _table_keys,
+                                                  _schema_for)
+                except Exception:  # noqa: BLE001 — display only
+                    dist = None
+        if dist is not None and plan.strategy != "DISTRIBUTED":
+            # demotion mutates the plan so EXPLAIN's STAGE_BOUNDARY, the
+            # query log's template_key, and the strategy column all see
+            # what actually ran
+            plan.strategy = "DISTRIBUTED"
+            dist["demoted"] = True
+
         if plan.explain:
             from pinot_tpu.engine.explain import explain_multistage
 
@@ -1327,39 +1411,15 @@ class Broker:
                            f"({budget_ms:.0f} ms) exhausted"}]}, t0)
 
         # ---- distributed stage-2 dispatch (tentpole, ISSUE 16) ----------
-        # A fact-fact join whose build side is past the broadcast cap is
-        # exactly the shape where the broker-local shuffle stops scaling:
-        # every build row funnels through this one process no matter how
-        # many servers host the table. Demote it to the server-side
-        # mailbox exchange (query2/exchange.py) when the fleet can route
-        # it. SET joinStrategy='distributed' forces the path; a forced-
-        # but-unroutable plan (hybrid split, unknown table, no live
-        # servers) falls through to the broker-local mirror and the
-        # response reports the EFFECTIVE strategy. Quota/admission are
-        # not debited here: the path has no per-table leaf queries, and
-        # stage-1 cost lands on the servers' own schedulers.
-        dist = None
-        if len(plan.joins) == 1 and not plan.windows:
-            want = plan.strategy == "DISTRIBUTED"
-            if not want and plan.strategy == "SHUFFLE" \
-                    and not plan.strategy_forced:
-                want = self._estimated_docs(
-                    plan.joins[0].build.table, _table_keys) \
-                    > BROADCAST_MAX_BUILD_ROWS
-            if want:
-                try:
-                    dist = self._distributed_spec(plan, _table_keys,
-                                                  _schema_for)
-                except Exception:  # noqa: BLE001 — probe must not fail
-                    log.exception("distributed routability probe failed; "
-                                  "falling back to broker-local join")
-                    dist = None
+        # the demotion probe ran above (before the EXPLAIN early-return);
+        # here the routable plan hands off to the mailbox exchange
         if dist is not None:
-            if plan.strategy != "DISTRIBUTED":
-                # demotion mutates the plan so the query log's
-                # template_key and strategy column see what actually ran
-                plan.strategy = "DISTRIBUTED"
-                dist["demoted"] = True
+            if adv_key is not None and advisor is not None:
+                advisor.observe(adv_key, join_strategy="DISTRIBUTED",
+                                demoted=bool(dist.get("demoted")))
+                dist["adv_key"] = adv_key
+            if adv_notes:
+                dist["adv_notes"] = adv_notes
             return self._execute_distributed(plan, sql, t0, budget_ms,
                                              dist)
 
@@ -1441,8 +1501,10 @@ class Broker:
             # leaves consumed the whole budget: a late broker-local join
             # would return a success AFTER the client's deadline
             return _timeout_resp()
-        result, meta = run_plan(plan, table_rows, device=None)
+        result, meta = run_plan(plan, table_rows, device=None,
+                                advisor=advisor, advisor_key=adv_key)
         roofline_recs.extend(meta.get("roofline") or ())
+        adv_notes.extend(meta.get("advisorDecisions") or ())
         resp = result.to_json()
         resp.update(counters)
         resp.update({
@@ -1464,6 +1526,8 @@ class Broker:
             # SHUFFLE baseline column next to the distributed exchange's
             # partition count (previously only the strategy name showed)
             resp["joinFanout"] = meta["joinFanout"]
+        if adv_notes:
+            resp["advisorDecisions"] = list(dict.fromkeys(adv_notes))
         self.metrics.time_ms("query", resp["timeUsedMs"])
         return self._log_query(sql, plan, resp, t0)
 
@@ -1794,6 +1858,20 @@ class Broker:
         })
         if dist.get("demoted"):
             resp["joinStrategyDemoted"] = True
+        # plan-advisor (ISSUE 17): stamp probe overrides + any worker-side
+        # decisions, and feed the MEASURED per-alias leaf rows back so the
+        # next demotion probe decides from observation, not the registry
+        adv_lines = list(dist.get("adv_notes") or [])
+        for line in (st.advisor_decisions or []):
+            if line not in adv_lines:
+                adv_lines.append(line)
+        if adv_lines:
+            resp["advisorDecisions"] = adv_lines
+        adv_key = dist.get("adv_key")
+        if adv_key and self.advisor is not None and st.leaf_rows:
+            self.advisor.observe(
+                adv_key,
+                build_rows={a: int(v) for a, v in st.leaf_rows.items()})
         trace_info = {f"stage2:{w}": parts[w].trace
                       for w in workers if parts[w].trace}
         if trace_info:
@@ -2208,8 +2286,9 @@ class Broker:
         # Streaming selections don't hedge — the duplicate's blocks would
         # double-count against the shared row budget.
         hedging = (not use_streaming) and (
-            opts.get("usehedging") is True
-            or (self.hedging_enabled and opts.get("usehedging") is not False))
+            bool_option(opts, "usehedging", None) is True
+            or (self.hedging_enabled
+                and bool_option(opts, "usehedging", None) is not False))
 
         for inst, phys, segs, tf in scatter:
             entries.append({
@@ -2556,6 +2635,10 @@ class Broker:
         )
         if server_roofline:
             resp["roofline"] = server_roofline
+        if stats.advisor_decisions:
+            # plan-advisor stamps (ISSUE 17): the decisions the answering
+            # servers' launches ran with, deduped by the stats merge
+            resp["advisorDecisions"] = list(stats.advisor_decisions)
         if rg_load_score is not None:
             resp["loadScore"] = rg_load_score
             resp["replicaGroup"] = rg_name

@@ -188,6 +188,11 @@ class QueryLogger:
                     "joinStrategy", "joinStrategyDemoted", "joinFanout",
                     "numPartitionsShipped", "exchangeBytes",
                     "exchangeSpillCount",
+                    # plan advisor (ISSUE 17): the measurement-driven
+                    # overrides this execution ran with — the raw
+                    # ADVISOR(...) stamps, aggregated per template by
+                    # tools/querylog.py --per-template
+                    "advisorDecisions",
                 ) if resp.get(k) is not None
             },
         }

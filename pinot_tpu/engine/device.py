@@ -33,8 +33,10 @@ import jax.numpy as jnp
 
 from pinot_tpu.common import faults
 from pinot_tpu.common.metrics import get_metrics
+from pinot_tpu.common.options import bool_option
 from pinot_tpu.common.trace import span as trace_span
 from pinot_tpu.engine import aggspec
+from pinot_tpu.engine.advisor import PlanAdvisor, advisor_enabled
 from pinot_tpu.engine.inflight import InflightLaunch, LaunchCoalescer
 from pinot_tpu.engine.params import (
     BatchContext,
@@ -726,7 +728,7 @@ def _unpack_outs(bufs: dict, layout) -> dict:
 
 
 def build_pipeline(template, mm_mode: str = "auto",
-                   sorted_hll_ok: bool = False, blockskip: bool = False,
+                   sorted_hll_ok: bool = False, blockskip=False,
                    widths=None, pallas_mode: str = "off"):
     """template (hashable) → jitted fn(cols, n_docs, params) → outputs dict.
 
@@ -746,6 +748,11 @@ def build_pipeline(template, mm_mode: str = "auto",
     form as the in-kernel overflow fallback (lax.cond), so an unselective
     query costs only the verdict + compaction work extra. The executor
     requests it for templates whose filter has interval structure.
+    Truthiness selects the form; an int value > 1 additionally overrides
+    the candidate-bound fraction (``ceil(total/frac)`` candidates instead
+    of the static ``CAND_FRACTION``) — the plan advisor tightens it for
+    templates whose measured selectivity leaves headroom, and a bound
+    overflow still lands on the in-kernel dense fallback bit-exactly.
 
     Every pipeline honors the optional ``ps_alive`` param — the per-query
     (S,) segment-alive vector from launch-time stats pruning (Level 1).
@@ -841,7 +848,9 @@ def build_pipeline(template, mm_mode: str = "auto",
             & alive_b[:, None]
         flat = verdict.reshape(-1)
         total = S * NB
-        B = min(total, max(1, -(-total // bs_ops.CAND_FRACTION)))
+        frac = bs_ops.CAND_FRACTION if blockskip is True \
+            or int(blockskip) <= 1 else int(blockskip)
+        B = min(total, max(1, -(-total // frac)))
         n_cand = jnp.sum(flat, dtype=jnp.int32)
         cand, cand_valid = bs_ops.compact_candidates(flat, B)
 
@@ -1313,6 +1322,12 @@ class DeviceExecutor:
         # device launch/fetch latency histograms ride the server registry
         # (ISSUE 7: the hot timers share ONE histogram-backed truth)
         self.metrics = get_metrics("server")
+        # feedback-driven plan advisor (engine/advisor.py): per-template
+        # memos of measured skip selectivity / rung GB/s / group counts /
+        # cohort cohesion feed the next execution's candidate-bound, rung,
+        # trim, and cohort-window choices. None disables process-wide
+        # (pinot.advisor.enabled=false); SET useAdvisor=false per query.
+        self.advisor = PlanAdvisor.from_config()
         # stateless launch-time stats pruner (engine.SegmentPruner), built
         # lazily to keep the engine module import one-directional
         self._stats_pruner = None
@@ -1610,7 +1625,7 @@ class DeviceExecutor:
         tests)."""
         if os.environ.get("PINOT_TPU_PALLAS", "1") in ("", "0"):
             return "off"
-        if opts.get("usepallas") is False:
+        if bool_option(opts, "usepallas", None) is False:
             return "off"
         mode = self.mm_mode if self.pallas_mode is None else self.pallas_mode
         return _resolve_mm_mode(mode)
@@ -1851,11 +1866,13 @@ class DeviceExecutor:
         try:
             cache_hit = bool(flight.get("cache_hit"))
             ratio = 1.0
+            skip_obs = None  # measured selectivity (skip path only)
             bt, bs = outs.get("blocks_total"), outs.get("blocks_scanned")
             if bt is not None and bs is not None:
                 total_b = float(np.sum(np.asarray(bt)))
                 if total_b > 0:
                     ratio = min(1.0, float(np.sum(np.asarray(bs))) / total_b)
+                    skip_obs = ratio
             # block-skip gather-buffer round trip: the XLA form
             # materializes the gathered (B, R) planes in HBM (one write +
             # one read of every gathered byte) before the filter runs;
@@ -1904,6 +1921,15 @@ class DeviceExecutor:
                     agg["kernel_ms"] += kernel_ms
             if gbps is not None:
                 self.metrics.observe("deviceKernelGbps", gbps)
+            # plan-advisor feedback: measured skip selectivity (only the
+            # skip path emits blocks_total>0 — the dense form measures
+            # nothing, by design) and per-rung achieved GB/s keyed by the
+            # pipeline label (advisor splits off the +pallas suffix)
+            adv_key = flight.get("adv_key")
+            if adv_key and self.advisor is not None and not cache_hit:
+                self.advisor.observe(
+                    adv_key, skip_ratio=skip_obs,
+                    label=flight["label"], gbps=gbps)
         except Exception:  # noqa: BLE001 — accounting must never fail a fetch
             log.exception("roofline flight accounting failed")
 
@@ -2116,7 +2142,20 @@ class DeviceExecutor:
         opts = q.options_ci()
         cacheable = (self.partials_cache_enabled
                      and not self.profile_enabled
-                     and opts.get("usepartialscache") is not False)
+                     and bool_option(opts, "usepartialscache", None)
+                     is not False)
+        # feedback-driven plan advisor (engine/advisor.py): keyed by the
+        # PR-7 literal-free template key. SET useAdvisor=false bypasses
+        # BOTH the reads (advice) and the writes (observation) — a
+        # bypassed query leaves zero memo effect, so advisor-off runs are
+        # bit-exact against advisor-on by construction.
+        adv_key = None
+        adv_notes: list = []
+        if self.advisor is not None and not self.profile_enabled \
+                and advisor_enabled(opts):
+            from pinot_tpu.broker.querylog import template_key
+
+            adv_key = template_key(q)
         if cacheable:
             params["__hostsig__"] = []
         counter = [0]
@@ -2217,10 +2256,27 @@ class DeviceExecutor:
         # differential parity suite compares against)
         use_bs, zone_cols = False, set()
         if filter_tpl[0] not in ("true", "false") \
-                and opts.get("useblockskip") is not False \
+                and bool_option(opts, "useblockskip", None) is not False \
                 and ctx.pad_to % bs_ops.BLOCK_ROWS == 0:
             prunable, zone_cols = bs_ops.prunable_columns(filter_tpl)
             use_bs = prunable and bool(zone_cols)
+        # advisor: skip-vs-dense and candidate-bound selection from the
+        # template's MEASURED selectivity. ``use_bs`` carries the choice
+        # as its truthiness: False = dense, True = static CAND_FRACTION,
+        # int>1 = tightened fraction — the pipeline key/entry/label all
+        # fork on the value, so each advised form compiles once. Either
+        # way the results are bit-exact: the dense form and the skip form
+        # agree by the differential suite, and an over-tight bound
+        # overflows onto the in-kernel dense fallback.
+        if use_bs and adv_key is not None:
+            frac, note = self.advisor.advise_blockskip(
+                adv_key, bs_ops.CAND_FRACTION)
+            if frac == 0:
+                use_bs, zone_cols = False, set()
+            elif frac != bs_ops.CAND_FRACTION:
+                use_bs = frac
+            if note:
+                adv_notes.append(note)
 
         # Level-1 launch-time segment skip: evaluate the filter tree against
         # per-segment column stats (min/max, dictionary membership, bloom
@@ -2244,7 +2300,8 @@ class DeviceExecutor:
         # SET useSortedProjection=false keeps the per-query in-pipeline
         # sort (the cold-scan measurement form); default taps the batch's
         # cached sorted projection for filterless terminal HLL
-        sorted_proj_ok = opts.get("usesortedprojection") is not False
+        sorted_proj_ok = bool_option(
+            opts, "usesortedprojection", None) is not False
         needed = self._needed_columns(filter_tpl) | set(group_cols)
         if use_bs:
             for zc in zone_cols:
@@ -2297,16 +2354,43 @@ class DeviceExecutor:
         # The spec is static (pow2 bound + order signature) and keys the
         # pipeline entry; the exact keep count rides as the tr_k param.
         trim = None
+        adv_trim_keep = None
         if reduce_mode is not None and shape in ("groupby",
                                                  "groupby_sorted"):
+            # advisor: group_trim_size tightened toward the template's
+            # observed group count (trim_bound still floors the keep at
+            # the reference's 5*(offset+limit), so parity semantics
+            # hold; the tightened bound covers every observed group with
+            # headroom — overflow observations stand the advice down)
+            gts = self.group_trim_size
+            if adv_key is not None:
+                gts2, note = self.advisor.advise_trim(adv_key, gts)
+                if note:
+                    gts = gts2
+                    adv_notes.append(note)
             table_len = total if shape == "groupby" else sorted_k
             trim = dr_ops.plan_trim(q, group_exprs, aggs, shape, table_len,
-                                    reduce_mode, self.group_trim_size)
+                                    reduce_mode, gts)
             if trim is not None:
                 tr_k = np.int32(dr_ops.trim_keep_count(
-                    q, reduce_mode, self.group_trim_size))
+                    q, reduce_mode, gts))
                 params["tr_k"] = jnp.asarray(tr_k)
                 host_sigs.append(("tr_k", "<i4", (), tr_k.tobytes()))
+                if adv_key is not None:
+                    adv_trim_keep = int(tr_k)
+
+        # advisor: Pallas-vs-XLA rung selection — demote to the XLA
+        # scatter rung when BOTH rungs have measured GB/s for this
+        # pipeline label and XLA measured meaningfully faster (the rungs
+        # are differential-pinned, so the flip is bit-exact)
+        if adv_key is not None and pmode != "off":
+            prov_label = self._pipeline_label(template, use_bs, trim,
+                                              pallas=True)
+            pmode2, note = self.advisor.advise_pallas(adv_key, pmode,
+                                                      prov_label)
+            if note:
+                pmode = pmode2
+                adv_notes.append(note)
 
         pkey = self._pipeline_key(template, use_bs, wsig, trim, pmode)
         entry = self._pipeline_entry(template, agg_tpls, final, use_bs,
@@ -2336,6 +2420,10 @@ class DeviceExecutor:
             self._pipeline_label(template, use_bs, trim,
                                  pallas=routes_pallas, fused=fused),
             fused=fused)
+        if flight is not None and adv_key is not None:
+            # _note_flight's observation hook: measured skip selectivity
+            # and per-rung GB/s feed the template's memo at resolve time
+            flight["adv_key"] = adv_key
 
         # device partials cache: a repeat execution — same pipeline, same
         # batch, same literal/ps_alive/param VALUES — skips the gather +
@@ -2364,6 +2452,9 @@ class DeviceExecutor:
                 handle.cache_hit = True
                 handle.flight = flight
                 handle.used_pallas = routes_pallas
+                handle.adv_key = adv_key
+                handle.advisor_notes = adv_notes
+                handle.adv_trim_keep = adv_trim_keep
                 return handle
         cols = {}
         with trace_span("gather", tracer):
@@ -2434,11 +2525,14 @@ class DeviceExecutor:
         with trace_span("dispatch", tracer):
             resolve = self._dispatch(
                 entry, batch_key, cols, n_docs, params, lkey, layout, tracer,
-                cache_key, flight)
+                cache_key, flight, adv_key=adv_key, adv_notes=adv_notes)
         handle = InflightLaunch(self, q, ctx, template, aggs, batch_key,
                                 resolve)
         handle.flight = flight
         handle.used_pallas = routes_pallas
+        handle.adv_key = adv_key
+        handle.advisor_notes = adv_notes
+        handle.adv_trim_keep = adv_trim_keep
         return handle
 
     # ---- dispatch: solo vs coalesced -------------------------------------
@@ -2470,7 +2564,7 @@ class DeviceExecutor:
         return tuple(post_fns)
 
     def _pipeline_entry(self, template, agg_tpls, final,
-                        blockskip: bool = False, widths=None,
+                        blockskip=False, widths=None,
                         wsig: tuple = (), trim=None,
                         pallas: str = "off") -> dict:
         """Compiled-pipeline cache entry for (template, mm_mode, blockskip,
@@ -2535,7 +2629,8 @@ class DeviceExecutor:
             return entry
 
     def _dispatch(self, entry, batch_key, cols, n_docs, params, lkey, layout,
-                  tracer=None, cache_key=None, flight=None):
+                  tracer=None, cache_key=None, flight=None, adv_key=None,
+                  adv_notes=None):
         """Dispatch one query: through the coalescer when concurrency makes
         a cohort partner likely, else solo. Returns the resolve() closure
         the InflightLaunch fetch phase blocks on. Coalescing is disabled
@@ -2555,10 +2650,27 @@ class DeviceExecutor:
             sig = tuple(sorted(
                 (k, tuple(v.shape), str(v.dtype)) for k, v in params.items()))
             ckey = (id(entry), batch_key, lkey, tuple(sorted(cols)), sig)
-            cohort, idx = co.join(
-                ckey, params,
-                lambda members: self._cohort_launch(
-                    entry, cols, n_docs, members, lkey, tracer, flight))
+            # advisor: cohort window sized from the template's OBSERVED
+            # arrival cohesion (templates whose cohorts stay solo stop
+            # paying the window wait; ones that reliably stack hold it
+            # open longer), and every dispatched cohort's size feeds the
+            # memo back via the launch closure
+            window_s = None
+            if adv_key is not None:
+                w, note = self.advisor.advise_cohort_window(
+                    adv_key, co.window_s)
+                if note:
+                    window_s = w
+                    if adv_notes is not None:
+                        adv_notes.append(note)
+
+            def _launch(members, _ak=adv_key):
+                if _ak is not None and self.advisor is not None:
+                    self.advisor.observe(_ak, cohort=len(members))
+                return self._cohort_launch(
+                    entry, cols, n_docs, members, lkey, tracer, flight)
+
+            cohort, idx = co.join(ckey, params, _launch, window_s=window_s)
 
             def resolve(_c=cohort, _i=idx):
                 return _c.resolve_member(_i)
@@ -2694,7 +2806,8 @@ class DeviceExecutor:
 
     # ---- device outputs → canonical IntermediateResult -------------------
     def _to_intermediate(self, q, ctx: BatchContext, template, outs, aggs,
-                         cache_hit: bool = False):
+                         cache_hit: bool = False, adv_key=None,
+                         adv_trim_keep=None):
         shape, _, group_cols, group_cards, agg_tpls, sorted_k, _final = template
         doc_count = int(outs["doc_count"])
         # mirror the host executor's stats accounting so responses are
@@ -2751,6 +2864,22 @@ class DeviceExecutor:
             limit = max(1, int(opts["numgroupslimit"]))
         trimmed = "trim_keys" in outs
         t_reduce = time.perf_counter()
+        # plan-advisor group-count feedback: the template's OBSERVED
+        # group count (trimmed tables report n_present_total — the real
+        # present count, not the kept count — so an advised keep that
+        # proved too tight registers as an overflow and the trim advice
+        # stands down). Cache hits replay the original execution's
+        # buffer and are not re-observed.
+        if adv_key is not None and self.advisor is not None \
+                and not cache_hit:
+            if trimmed:
+                obs_groups = int(outs["n_present_total"])
+            elif shape == "groupby_sorted":
+                obs_groups = int(outs["n_groups_total"])
+            else:
+                obs_groups = int((np.asarray(outs["gcount"]) > 0).sum())
+            self.advisor.observe(adv_key, groups=obs_groups,
+                                 trim_keep=adv_trim_keep)
         if trimmed:
             # on-device final reduce ran (ops/device_reduce.py): the
             # fetched table is already ordered + trimmed, keys packed in

@@ -255,6 +255,11 @@ class QueryEngine:
         )
         if getattr(merged, "roofline", None):
             resp["roofline"] = merged.roofline
+        if stats.advisor_decisions:
+            # plan-advisor stamps (ISSUE 17): every measurement-driven
+            # override this execution ran with, for responses / querylog
+            # / EXPLAIN ANALYZE
+            resp["advisorDecisions"] = list(stats.advisor_decisions)
         return resp
 
     def execute_query(self, q: QueryContext, tracer=None):

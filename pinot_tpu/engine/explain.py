@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 
+from pinot_tpu.common.options import bool_option
 from pinot_tpu.query.context import FilterNode, FilterNodeType, QueryContext
 
 
@@ -268,6 +269,12 @@ def annotate_analyze(plan: dict, resp: dict) -> dict:
     out.append(
         f"    CACHE(partialsCacheHit={bool(resp.get('partialsCacheHit'))}, "
         f"resultCacheHit={bool(resp.get('resultCacheHit'))})")
+    # plan advisor (ISSUE 17): one line per measurement-driven override
+    # this execution ran with — already formatted as
+    # ADVISOR(<decision>: measured=X default=Y) at the decision site, so
+    # a mis-advised plan is debuggable straight from EXPLAIN ANALYZE
+    for line in resp.get("advisorDecisions") or ():
+        out.append(f"    {line}")
     return _rows_response(out)
 
 
@@ -361,7 +368,8 @@ def explain_plan(engine, q: QueryContext) -> dict:
                 lines.append(
                     f"    DEVICE_REDUCE(trim={trim_keep_count(q, 'terminal')})")
         if getattr(dev, "partials_cache_enabled", False) \
-                and q.options_ci().get("usepartialscache") is not False:
+                and bool_option(q.options_ci(), "usepartialscache",
+                                None) is not False:
             lines.append(
                 f"    CACHED_PARTIALS(entries={len(dev._partials)})")
     if (backend.startswith("DEVICE")

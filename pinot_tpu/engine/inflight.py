@@ -116,10 +116,22 @@ class InflightLaunch:
             # faults a week apart
             self._executor._note_device_success(
                 self._template, self._batch_key)
+            adv_key = getattr(self, "adv_key", None)
             result = self._executor._to_intermediate(
                 self._q, self._ctx, self._template, outs, self._aggs,
-                cache_hit=self.cache_hit)
+                cache_hit=self.cache_hit, adv_key=adv_key,
+                adv_trim_keep=getattr(self, "adv_trim_keep", None))
             result.stats.partials_cache_hit = self.cache_hit
+            # plan-advisor stamps + cache-hit feedback (ISSUE 17): the
+            # decisions this launch ran with ride the result's stats to
+            # the response / querylog / EXPLAIN ANALYZE, and the
+            # partials-cache outcome feeds the template's memo
+            notes = getattr(self, "advisor_notes", None)
+            if notes:
+                result.stats.advisor_decisions.extend(notes)
+            advisor = getattr(self._executor, "advisor", None)
+            if adv_key is not None and advisor is not None:
+                advisor.observe(adv_key, partials_hit=self.cache_hit)
             rec = None if self.flight is None else self.flight.get("record")
             if rec is not None:
                 # per-query roofline accounting (ISSUE 11): the flight's
@@ -303,7 +315,7 @@ class LaunchCoalescer:
                 return False
         return False
 
-    def join(self, key, params: dict, launch_fn):
+    def join(self, key, params: dict, launch_fn, window_s=None):
         """Join (or open) the cohort for ``key`` → (cohort, member index).
 
         The FIRST arrival becomes leader: it holds the window open for
@@ -312,6 +324,10 @@ class LaunchCoalescer:
         their params and return immediately — they block only inside
         ``resolve_member`` (their fetch phase), so a member's scheduler
         slot is released while the leader's launch is still in flight.
+
+        ``window_s``: per-join override of the leader's micro-batch
+        window (the plan advisor sizes it from the template's observed
+        arrival cohesion); None keeps the configured default.
         """
         with self._lock:
             c = self._pending.get(key)
@@ -352,7 +368,7 @@ class LaunchCoalescer:
                     break
                 c.full.wait(min(0.002, left))
         else:
-            c.full.wait(self.window_s)
+            c.full.wait(self.window_s if window_s is None else window_s)
         with self._lock:
             c.open = False
             if self._pending.get(key) is c:

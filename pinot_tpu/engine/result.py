@@ -78,6 +78,13 @@ class ExecutionStats:
     exchange_spill_count: int = 0
     stage2_rows: int = 0
     leaf_rows: dict = dataclasses.field(default_factory=dict)
+    # plan-advisor decision stamps (ISSUE 17, engine/advisor.py): one
+    # "ADVISOR(<decision>: measured=X default=Y)" line per measurement-
+    # driven override this execution ran with. Merged with order-
+    # preserving dedup (partials of one query repeat the same stamps);
+    # surfaced as advisorDecisions in responses, the query log, and
+    # EXPLAIN ANALYZE.
+    advisor_decisions: list = dataclasses.field(default_factory=list)
 
     def merge(self, other: "ExecutionStats") -> None:
         self.num_docs_scanned += other.num_docs_scanned
@@ -108,6 +115,9 @@ class ExecutionStats:
         self.stage2_rows += other.stage2_rows
         for alias, rows in (other.leaf_rows or {}).items():
             self.leaf_rows[alias] = self.leaf_rows.get(alias, 0) + int(rows)
+        for line in (other.advisor_decisions or []):
+            if line not in self.advisor_decisions:
+                self.advisor_decisions.append(line)
 
 
 @dataclasses.dataclass

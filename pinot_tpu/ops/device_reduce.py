@@ -135,8 +135,10 @@ def plan_trim(q, group_exprs, aggs, shape: str, table_len: int,
         return None
     if q.distinct or q.having is not None:
         return None
+    from pinot_tpu.common.options import bool_option
+
     opts = q.options_ci()
-    if opts.get("usedevicereduce") is False:
+    if bool_option(opts, "usedevicereduce", None) is False:
         return None
     if opts.get("gapfillbucketms") is not None:
         return None  # gapfill synthesizes buckets from the FULL group set

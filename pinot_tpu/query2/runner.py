@@ -819,9 +819,16 @@ def run_stage2(plan: MultiStagePlan, cols: dict, n: int, env: dict,
 # ---------------------------------------------------------------------------
 
 
-def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
+def run_plan(plan: MultiStagePlan, table_rows: dict, device=None,
+             advisor=None, advisor_key=None):
     """table_rows: alias → {bare column: np array}. Returns (ResultTable,
-    meta dict with join/window execution facts)."""
+    meta dict with join/window execution facts).
+
+    ``advisor``/``advisor_key`` (ISSUE 17): the plan advisor's memo for
+    this template feeds the join-strategy pick (measured build-side rows
+    from prior executions beat the catalog's dim-table heuristic), and
+    every step's ACTUAL build rows + effective strategy are observed
+    back. Overrides land in meta["advisorDecisions"]."""
     mesh = getattr(device, "mesh", None) if device is not None else None
     probe = plan.probe
     left_cols = {f"{probe.alias}.{c}": np.asarray(v)
@@ -830,6 +837,7 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
 
     strategies = []
     roofline_recs = []
+    adv_notes = []
     for step in plan.joins:
         build_cols = {f"{step.build.alias}.{c}": np.asarray(v)
                       for c, v in table_rows[step.build.alias].items()}
@@ -842,6 +850,18 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
             # found the plan ineligible — the local execution form of a
             # distributed join IS the shuffle mirror
             strat = "SHUFFLE"
+        if advisor is not None and advisor_key \
+                and not plan.strategy_forced:
+            # measured build rows beat the static dim-table heuristic:
+            # a fact build that filters down tiny broadcasts, a dim
+            # build that grew past the threshold shuffles. Both sides
+            # compute identical joined rows — strategy is pure perf.
+            strat2, note = advisor.advise_join_strategy(
+                advisor_key, strat, step.build.alias,
+                BROADCAST_MAX_BUILD_ROWS)
+            if note:
+                strat = strat2
+                adv_notes.append(note)
         if strat == "BROADCAST" and not plan.strategy_forced \
                 and n_build > BROADCAST_MAX_BUILD_ROWS:
             # a heuristic BROADCAST must not replicate a huge build table
@@ -854,6 +874,10 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
             left_cols, n, step, build_cols, device, mesh, strat)
         join_ms = (time.perf_counter() - t_join) * 1e3
         strategies.append(strat)
+        if advisor is not None and advisor_key:
+            advisor.observe(advisor_key,
+                            build_rows={step.build.alias: n_build},
+                            join_strategy=strat)
         # roofline record for the join step (ISSUE 11): probe+build
         # bytes in, expanded pairs out, over the step's wall — a coarser
         # model than the leaf-scan kernels' (host glue is inside the
@@ -888,6 +912,8 @@ def run_plan(plan: MultiStagePlan, table_rows: dict, device=None):
                        if (mesh is not None and effective == "SHUFFLE")
                        else 1) if strategies else 0,
     }
+    if adv_notes:
+        meta["advisorDecisions"] = adv_notes
     return result, meta
 
 
@@ -935,8 +961,26 @@ def run_local(engine, plan: MultiStagePlan):
             table_rows[src.alias] = scan_local_rows(
                 engine, src.table, plan.pushdown.get(src.alias),
                 need[src.alias], stats)
+    # plan-advisor hookup (ISSUE 17): the device executor's advisor (one
+    # per process) also memoizes multi-stage templates — join-strategy
+    # advice from measured build rows. SET useAdvisor=false bypasses.
+    advisor = getattr(engine.device, "advisor", None) \
+        if engine.device is not None else None
+    adv_key = None
+    if advisor is not None:
+        from pinot_tpu.engine.advisor import advisor_enabled
+
+        try:
+            opts = plan.stage2.options_ci()
+        except Exception:  # noqa: BLE001 — advice is optional
+            opts = {}
+        if advisor_enabled(opts):
+            from pinot_tpu.broker.querylog import template_key
+
+            adv_key = template_key(plan)
     with span("stage2"):
-        result, meta = run_plan(plan, table_rows, device=engine.device)
+        result, meta = run_plan(plan, table_rows, device=engine.device,
+                                advisor=advisor, advisor_key=adv_key)
     meta["leafRows"] = {
         alias: (len(next(iter(cols.values()))) if cols else 0)
         for alias, cols in table_rows.items()
@@ -1124,6 +1168,14 @@ def execute_multistage(engine, stmt, t0: Optional[float] = None) -> dict:
         resp["roofline"] = meta["roofline"]
     if meta["joinStrategy"]:
         resp["joinStrategy"] = meta["joinStrategy"]
+    # plan-advisor stamps (ISSUE 17): stage-2 strategy overrides from
+    # the plan runner + leaf-scan overrides the stats carried up
+    adv_lines = list(meta.get("advisorDecisions") or [])
+    for line in (stats.advisor_decisions or []):
+        if line not in adv_lines:
+            adv_lines.append(line)
+    if adv_lines:
+        resp["advisorDecisions"] = adv_lines
     if analyze:
         # EXPLAIN ANALYZE (ISSUE 11): the plan ran for real above —
         # annotate the static tree with its actuals; the executed

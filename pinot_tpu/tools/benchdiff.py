@@ -45,7 +45,13 @@ Compared metric families (direction-aware):
   is better — ``join.exchange_bytes`` / ``join.spill_count`` —
   informational wire-volume and warm-tier-spill trackers, never gated:
   both move legitimately with partition count and buffer sizing —
-  ISSUE 16), compared only when BOTH rounds carry the keys.
+  ISSUE 16), compared only when BOTH rounds carry the keys,
+- the adaptive phase (``adaptive.*.converged_p50_ms`` — lower is
+  better — the advisor's post-convergence latency on each deliberately
+  mis-tuned scenario, plus ``adaptive.*.queries_to_converge`` —
+  informational, never gated: it moves with min-samples/reprobe tuning —
+  ISSUE 17), compared only when BOTH rounds carry a ``detail.adaptive``
+  section.
 """
 
 from __future__ import annotations
@@ -57,7 +63,8 @@ import sys
 # sections brace-matched out of a truncated driver-wrapper tail
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
-                  "cluster", "breakdown", "roofline", "tiering", "overload")
+                  "cluster", "breakdown", "roofline", "tiering", "overload",
+                  "adaptive")
 
 
 def _brace_match(text: str, key: str):
@@ -265,6 +272,20 @@ def extract_metrics(detail: dict) -> dict:
             v = _num(joi.get(k))
             if v is not None:
                 out[f"join.{k}"] = (v, "info")
+    # adaptive phase (ISSUE 17): post-convergence p50 per mis-tuned
+    # scenario gates (the advisor must keep rescuing the bad default);
+    # queries-to-converge rides along informationally — it moves with
+    # min_samples/reprobe tuning, both legitimate knobs
+    ada = detail.get("adaptive")
+    if isinstance(ada, dict):
+        for sname, entry in ada.items():
+            if isinstance(entry, dict):
+                v = _num(entry.get("converged_p50_ms"))
+                if v is not None:
+                    out[f"adaptive.{sname}.converged_p50_ms"] = (v, "lower")
+                v = _num(entry.get("queries_to_converge"))
+                if v is not None:
+                    out[f"adaptive.{sname}.queries_to_converge"] = (v, "info")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of

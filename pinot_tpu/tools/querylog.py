@@ -89,6 +89,18 @@ def phase_breakdown(entry: dict) -> dict:
     return out
 
 
+def _advisor_state(kind_sets: list) -> str:
+    """Convergence label for one template's advisor override history
+    (entry-ordered decision-kind sets). "cold" = no execution ever
+    stamped an override; "converged" = the trailing executions all ran
+    with the same override set (the memo stopped changing its mind);
+    "adapting" = the override set is still moving."""
+    if not any(kind_sets):
+        return "cold"
+    tail = kind_sets[-min(3, len(kind_sets)):]
+    return "converged" if len(set(tail)) == 1 else "adapting"
+
+
 def summarize(entries: list, top: int = 5,
               per_template: bool = False) -> dict:
     lats = sorted(e.get("timeUsedMs", 0.0) for e in entries)
@@ -128,25 +140,47 @@ def summarize(entries: list, top: int = 5,
         by_tpl: dict = {}
         for e in entries:
             counters = e.get("counters") or {}
+            # the decisions a plan advisor override stamped on this
+            # execution — e.g. "ADVISOR(candBound=1/32: ...)" — keyed on
+            # the decision name left of '=' so per-template aggregation
+            # sees "the advisor overrides candBound here", not one row
+            # per measured value (ISSUE 17 satellite)
+            stamps = counters.get("advisorDecisions") or ()
+            kinds = frozenset(
+                s.split("(", 1)[-1].split("=", 1)[0] for s in stamps)
             by_tpl.setdefault(e.get("template") or "?", []).append(
                 (e.get("timeUsedMs", 0.0),
                  bool(counters.get("partialsCacheHit")),
-                 bool(counters.get("resultCacheHit"))))
+                 bool(counters.get("resultCacheHit")),
+                 kinds))
         summary["templates"] = {
             t: {"queries": len(v),
                 "p50Ms": round(
-                    _percentile(sorted(x for x, _, _ in v), 0.5), 2),
+                    _percentile(sorted(x for x, _, _, _ in v), 0.5), 2),
                 # device partials-cache hit rate for this literal-free
                 # template — the repeat-dashboard-query signal the cache
                 # exists to serve
                 "cacheHitRate": round(
-                    sum(1 for _, h, _ in v if h) / len(v), 3),
+                    sum(1 for _, h, _, _ in v if h) / len(v), 3),
                 # broker result-cache hit rate (PR 10's resultCacheHit):
                 # hits answer with NO scatter at all, so a template whose
                 # latency looks great may simply be cache-hot — the two
                 # rates disambiguate (ISSUE 11 satellite)
                 "resultCacheHitRate": round(
-                    sum(1 for _, _, h in v if h) / len(v), 3)}
+                    sum(1 for _, _, h, _ in v if h) / len(v), 3),
+                # plan advisor (ISSUE 17): how often the memo overrode a
+                # static default for this template, which knobs it turned,
+                # and whether the decision set has settled — "converged"
+                # once the latest executions all stamp the same override
+                # set (possibly empty after warm-up confirmed the
+                # defaults), "adapting" while it still changes, "cold"
+                # before any query ran with advisor overrides recorded
+                "advisorOverrides": sum(len(k) for _, _, _, k in v),
+                "advisorOverrideRate": round(
+                    sum(1 for _, _, _, k in v if k) / len(v), 3),
+                "advisorDecisions": sorted(
+                    set().union(*(k for _, _, _, k in v))),
+                "advisorState": _advisor_state([k for _, _, _, k in v])}
             for t, v in sorted(by_tpl.items())
         }
     slowest = sorted(entries, key=lambda e: e.get("timeUsedMs", 0.0),
@@ -212,9 +246,15 @@ def main(argv=None) -> int:
               f"p90={row['p90Ms']}ms")
     if "templates" in summary:
         for t, row in summary["templates"].items():
+            adv = ""
+            if row["advisorState"] != "cold":
+                kinds = ",".join(row["advisorDecisions"]) or "-"
+                adv = (f" advisor={row['advisorState']} "
+                       f"overrides={row['advisorOverrides']} "
+                       f"({kinds})")
             print(f"  template {t}: n={row['queries']} p50={row['p50Ms']}ms "
                   f"partialsCache={row['cacheHitRate']:.1%} "
-                  f"resultCache={row['resultCacheHitRate']:.1%}")
+                  f"resultCache={row['resultCacheHitRate']:.1%}{adv}")
     print(f"top {len(summary['slowest'])} slowest:")
     for e in summary["slowest"]:
         phases = " ".join(f"{k}={v}" for k, v in (e["phases"] or {}).items())

@@ -34,6 +34,10 @@ def canonical(resp: dict) -> dict:
     out = dict(resp)
     out.pop("timeUsedMs", None)
     out.pop("partialsCacheHit", None)
+    # advisor stamps (ISSUE 17) are plan-state metadata: a repeat
+    # execution of a trained template carries ADVISOR(...) lines the
+    # cold run didn't — results stay bit-exact by construction
+    out.pop("advisorDecisions", None)
     # roofline accounting (ISSUE 11) is measurement, not results: kernel
     # wall and modeled bytes differ run to run (cohort members attribute
     # the shared kernel to the leader; cache hits move zero bytes)
