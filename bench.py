@@ -3585,6 +3585,665 @@ def bench_adaptive(train_n: int = 8, iters: int = 7):
     return detail, violations
 
 
+def _vm_rss_mb() -> float:
+    """This process's resident set in MB (/proc/self/status VmRSS);
+    0.0 where /proc is unavailable."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def bench_frontdoor(n_queries: int = 240):
+    """detail.frontdoor: the broker-fleet front door phase (ISSUE 18).
+    Three sub-measurements:
+
+    A. **Broker-tier scaling**: one server OS process serves a small
+       table; 1 then 2 BROKER OS processes (``admin start-broker``,
+       result cache ON via env config, fleet-registered in the shared
+       FileRegistry) answer a cache-hot fixed query over HTTP. The
+       client discovers both brokers from the registry (fleet.py —
+       the bench never hardcodes the second URL) and rotates across
+       them via ``broker_urls``. Gate: ``qps2/qps1`` normalized by the
+       box's own 2-process ceiling >= 1.6 (a real 2-core-or-better host
+       must nearly double; a 1-core sandbox is graded against what two
+       pinned processes can do AT ALL there), zero errors, and the two
+       brokers' cache hits answer bit-identically.
+
+    B. **Streaming delivery**: an in-process 1-server cluster holds a
+       10M-row table; ``Broker.execute_stream`` cursors the full SELECT
+       through the chunked path while the bench samples VmRSS per chunk.
+       Gates: peak RSS delta during the stream < 256 MB, and a running
+       hash of the streamed rows equals the hash of the same query run
+       BUFFERED (bit-identical rows, same order).
+
+    C. **Fleet-fair admission**: two in-process brokers share one
+       logical per-tenant budget via heartbeat-gossiped spend
+       (fleet.py + admission.observe_peer_spend). Tenant A sprays BOTH
+       brokers; gates: A's fleet-wide admitted count stays within one
+       heartbeat of refill over the single-broker budget (not 2x), and
+       tenant B's p99 drifts < 25% vs its solo baseline.
+
+    Standalone: ``python -m bench --phase frontdoor`` exits 12 on gate
+    failure (after adaptive=11). The scaling pair and the fairness
+    drift each get one bounded retry: both divide two measurements
+    taken in different noise regimes on a shared box.
+    """
+    import gc
+    import hashlib
+    import shutil
+    import subprocess
+    import threading as _threading
+    import urllib.request
+
+    from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.broker.admission import TenantAdmissionController
+    from pinot_tpu.broker.fleet import BrokerFleetMember, discover_broker_urls
+    from pinot_tpu.cluster.registry import ClusterRegistry, FileRegistry, Role
+    from pinot_tpu.common.datatypes import DataType
+    from pinot_tpu.common.schema import Schema
+    from pinot_tpu.common.table_config import TableConfig
+    from pinot_tpu.controller.controller import Controller
+    from pinot_tpu.server.server import ServerInstance
+    from pinot_tpu.storage.creator import build_segment
+    from pinot_tpu import client as pt_client
+
+    detail: dict = {}
+    violations: list = []
+    cores = os.cpu_count() or 2
+
+    def _post(url: str, sql: str) -> dict:
+        req = urllib.request.Request(
+            url.rstrip("/") + "/query/sql",
+            data=json.dumps({"sql": sql}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read().decode())
+
+    # ---- A. broker-tier scaling over OS-process brokers ------------------
+    def broker_scaling() -> dict:
+        part: dict = {"brokers": {}}
+        base = tempfile.mkdtemp(prefix="pinot_tpu_frontdoor_")
+        reg_path = os.path.join(base, "cluster.json")
+        procs = []
+        broker_procs = []
+        try:
+            registry = FileRegistry(reg_path)
+            controller = Controller(registry, os.path.join(base, "ds"))
+            schema = Schema.build(
+                name="fd",
+                dimensions=[("region", DataType.STRING)],
+                metrics=[("amount", DataType.INT)],
+            )
+            rng = np.random.default_rng(18)
+            rows_per = 120_000
+            for i in range(2):
+                cols = {
+                    "region": np.array(["na", "eu", "apac", "latam"])[
+                        rng.integers(0, 4, rows_per)],
+                    "amount": rng.integers(1, 500, rows_per).astype(np.int32),
+                }
+                build_segment(schema, cols, os.path.join(base, f"seg{i}"),
+                              TableConfig(table_name="fd"), f"fd_s{i}")
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in [os.path.dirname(os.path.abspath(__file__))]
+                + env.get("PYTHONPATH", "").split(os.pathsep) if p)
+            # the whole point of this width ladder is the CACHE-HOT
+            # broker tier: every broker process serves the same fixed
+            # query from its own result cache after one warming miss
+            env["PINOT_TPU_PINOT_BROKER_RESULTCACHE_ENABLED"] = "true"
+            log_f = open(os.path.join(base, "srv.log"), "w")
+            p = subprocess.Popen(
+                [sys.executable, "-m", "pinot_tpu.tools.admin",
+                 "start-server", "--registry", reg_path, "--id", "fd_srv",
+                 "--data-dir", os.path.join(base, "sd"),
+                 "--max-concurrent", "2", "--no-device"],
+                stdout=log_f, stderr=subprocess.STDOUT, env=env)
+            procs.append((p, log_f))
+            t_end = time.time() + 60
+            while time.time() < t_end:
+                if len(registry.instances(Role.SERVER,
+                                          live_ttl_ms=10_000)) == 1:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("frontdoor: server never registered")
+            controller.add_table(TableConfig(table_name="fd"), schema)
+            for i in range(2):
+                controller.upload_segment("fd", os.path.join(base, f"seg{i}"))
+            t_end = time.time() + 60
+            while time.time() < t_end:
+                if len(registry.external_view("fd_OFFLINE")) == 2:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("frontdoor: segments never assigned")
+
+            def spawn_broker(i: int):
+                blog = open(os.path.join(base, f"bk_{i}.log"), "w")
+                bp = subprocess.Popen(
+                    [sys.executable, "-m", "pinot_tpu.tools.admin",
+                     "start-broker", "--registry", reg_path,
+                     "--id", f"fd_bk_{i}", "--port", "0",
+                     "--timeout-s", "30"],
+                    stdout=blog, stderr=subprocess.STDOUT, env=env)
+                if hasattr(os, "sched_setaffinity"):
+                    try:
+                        os.sched_setaffinity(bp.pid, {i % cores})
+                    except OSError:
+                        pass
+                broker_procs.append((bp, blog))
+
+            def wait_urls(n: int) -> list:
+                # registry-driven discovery IS the surface under test:
+                # the bench learns the brokers' ephemeral ports the same
+                # way a client would, from their fleet registrations
+                t_end = time.time() + 60
+                while time.time() < t_end:
+                    urls = discover_broker_urls(registry)
+                    if len(urls) >= n:
+                        return sorted(urls)
+                    time.sleep(0.1)
+                raise RuntimeError(
+                    f"frontdoor: {n} brokers never became discoverable")
+
+            fixed_sql = ("SELECT region, COUNT(*), SUM(amount) FROM fd "
+                         "GROUP BY region ORDER BY region")
+
+            def warm(url: str) -> dict:
+                # first request pays the scatter and fills that broker's
+                # cache; repeats must flag resultCacheHit
+                r = _post(url, fixed_sql)
+                if r.get("exceptions"):
+                    raise RuntimeError(f"frontdoor warmup failed: "
+                                       f"{r['exceptions']}")
+                t_end = time.time() + 30
+                while time.time() < t_end:
+                    r = _post(url, fixed_sql)
+                    if r.get("resultCacheHit"):
+                        return r
+                    time.sleep(0.05)
+                raise RuntimeError(f"frontdoor: {url} never served a "
+                                   f"cache hit")
+
+            errors = [0]
+            lock = _threading.Lock()
+
+            def blast(urls: list, width: int, nq: int) -> float:
+                counter = [0]
+
+                def worker():
+                    conn = pt_client.connect(broker_urls=list(urls),
+                                             timeout_s=30.0)
+                    try:
+                        cur = conn.cursor()
+                        while True:
+                            with lock:
+                                if counter[0] >= nq:
+                                    return
+                                counter[0] += 1
+                            try:
+                                cur.execute(fixed_sql)
+                                cur.fetchall()
+                            except pt_client.Error:
+                                with lock:
+                                    errors[0] += 1
+                    finally:
+                        conn.close()
+
+                t0 = time.perf_counter()
+                ts = [_threading.Thread(target=worker)
+                      for _ in range(width)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                return nq / (time.perf_counter() - t0)
+
+            def measure(urls: list, label: str) -> None:
+                rungs = {}
+                qps = 0.0
+                for width in (2, 4):
+                    per_rung = max(60, n_queries // 2)
+                    rungs[f"t{width}"] = round(
+                        blast(urls, width, per_rung), 2)
+                    qps = max(qps, rungs[f"t{width}"])
+                prev = part["brokers"].get(label)
+                entry = {"qps": round(qps, 2), "qps_by_offered": rungs,
+                         "urls": len(urls)}
+                if prev is None or entry["qps"] > prev["qps"]:
+                    part["brokers"][label] = entry
+
+            ceilings = [process_scaling_ceiling()]
+            spawn_broker(0)
+            urls1 = wait_urls(1)
+            hit1 = warm(urls1[0])
+            measure(urls1, "n1")
+            spawn_broker(1)
+            urls2 = wait_urls(2)
+            url_b = next(u for u in urls2 if u not in urls1)
+            hit2 = warm(url_b)
+            measure(urls2, "n2")
+            ceilings.append(process_scaling_ceiling())
+
+            # cross-broker cache parity: two independent caches, same
+            # table epochs, must answer the same bytes
+            part["cache_parity"] = (
+                hit1["resultTable"]["rows"] == hit2["resultTable"]["rows"])
+            if not part["cache_parity"]:
+                violations.append(
+                    "frontdoor: cache-hit rows differ across brokers")
+
+            def ratio() -> tuple:
+                qps1 = part["brokers"]["n1"]["qps"]
+                qps2 = part["brokers"]["n2"]["qps"]
+                raw = qps2 / qps1 if qps1 else 0.0
+                ceiling = float(np.median(ceilings))
+                return raw, ceiling, (raw / ceiling if ceiling else 0.0)
+
+            raw, ceiling, norm = ratio()
+            if norm < 1.6:
+                # one bounded retry of the gated pair: peak-per-width is
+                # kept, and the ceiling is resampled in the same regime
+                part["retried"] = True
+                measure(urls1, "n1")
+                measure(urls2, "n2")
+                ceilings.append(process_scaling_ceiling())
+                raw, ceiling, norm = ratio()
+            part["qps2_over_qps1_raw"] = round(raw, 3)
+            part["box_2proc_ceiling"] = round(ceiling, 3)
+            part["box_2proc_ceiling_samples"] = [
+                round(c, 3) for c in ceilings]
+            part["qps2_over_qps1"] = round(norm, 3)
+            part["errors"] = errors[0]
+            if errors[0]:
+                violations.append(
+                    f"frontdoor: {errors[0]} client errors during "
+                    f"rotation blasts (bar: 0)")
+            if norm < 1.6:
+                violations.append(
+                    f"frontdoor: 2-broker QPS gain {norm:.3f} "
+                    f"(raw {raw:.3f} / box 2-process ceiling "
+                    f"{ceiling:.3f}) < 1.6 "
+                    f"(qps1={part['brokers']['n1']['qps']}, "
+                    f"qps2={part['brokers']['n2']['qps']})")
+            return part
+        finally:
+            for bp, blog in broker_procs:
+                bp.terminate()
+            for p, log_f in procs:
+                p.terminate()
+            for bp, blog in broker_procs + procs:
+                try:
+                    bp.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    bp.kill()
+                blog.close()
+            shutil.rmtree(base, ignore_errors=True)
+
+    # ---- B. streaming delivery: bounded RSS + bit-identity ---------------
+    def streaming() -> dict:
+        part: dict = {}
+        base = tempfile.mkdtemp(prefix="pinot_tpu_frontdoor_strm_")
+        n_seg, rows_per = 40, 250_000
+        server = None
+        broker = None
+        try:
+            registry = ClusterRegistry()
+            controller = Controller(registry, os.path.join(base, "ds"))
+            schema = Schema.build(
+                name="strm",
+                dimensions=[],
+                metrics=[("a", DataType.INT), ("b", DataType.INT)],
+            )
+            cfg = TableConfig(table_name="strm")
+            rng = np.random.default_rng(19)
+            for i in range(n_seg):
+                # values < 256 so the row tuples hold interned small
+                # ints: the bench measures the STREAM's buffering, not
+                # the cost of 20M distinct PyLong objects
+                cols = {
+                    "a": rng.integers(0, 256, rows_per).astype(np.int32),
+                    "b": rng.integers(0, 256, rows_per).astype(np.int32),
+                }
+                build_segment(schema, cols, os.path.join(base, f"s{i}"),
+                              cfg, f"strm_s{i}")
+            server = ServerInstance("fd_strm_srv", registry,
+                                    os.path.join(base, "sd"),
+                                    device_executor=None)
+            server.start()
+            controller.add_table(cfg, schema)
+            for i in range(n_seg):
+                controller.upload_segment("strm", os.path.join(base,
+                                                               f"s{i}"))
+            t_end = time.time() + 120
+            while time.time() < t_end:
+                tdm = server.engine.tables.get("strm_OFFLINE")
+                if tdm is not None and len(tdm.segments) == n_seg:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("frontdoor: stream segments never "
+                                   "loaded")
+            broker = Broker(registry, broker_id="fd_strm_bk",
+                            timeout_s=600.0)
+            warm = broker.execute("SELECT COUNT(*) FROM strm")
+            total_rows = warm["resultTable"]["rows"][0][0]
+            sql = f"SELECT a, b FROM strm LIMIT {total_rows}"
+
+            def row_hash(rows_iter) -> tuple:
+                h = hashlib.sha256()
+                n = 0
+                for row in rows_iter:
+                    h.update(repr(row).encode())
+                    n += 1
+                return h.hexdigest(), n
+
+            gc.collect()
+            rss0 = _vm_rss_mb()
+            peak = rss0
+            h = hashlib.sha256()
+            n_streamed = 0
+            chunks = 0
+            final = None
+            t0 = time.perf_counter()
+            for chunk in broker.execute_stream(sql):
+                if chunk.get("type") == "rows":
+                    for row in chunk["rows"]:
+                        h.update(repr(row).encode())
+                        n_streamed += 1
+                    chunks += 1
+                    rss = _vm_rss_mb()
+                    if rss > peak:
+                        peak = rss
+                elif chunk.get("type") == "final":
+                    final = chunk
+            stream_s = time.perf_counter() - t0
+            hash_stream = h.hexdigest()
+            part["rows"] = n_streamed
+            part["chunks"] = chunks
+            part["stream_s"] = round(stream_s, 2)
+            part["stream_mrows_per_s"] = round(
+                n_streamed / stream_s / 1e6, 2) if stream_s else 0.0
+            part["rss_before_mb"] = round(rss0, 1)
+            part["rss_peak_mb"] = round(peak, 1)
+            part["stream_rss_delta_mb"] = round(peak - rss0, 1)
+            if final is None or final.get("exceptions"):
+                violations.append(
+                    f"frontdoor: streaming SELECT errored: "
+                    f"{(final or {}).get('exceptions')}")
+            if not (final or {}).get("streamed"):
+                violations.append(
+                    "frontdoor: SELECT did not take the true streaming "
+                    "path (buffered fallback)")
+            if n_streamed != total_rows:
+                violations.append(
+                    f"frontdoor: streamed {n_streamed} rows, table has "
+                    f"{total_rows}")
+            if part["stream_rss_delta_mb"] >= 256.0:
+                violations.append(
+                    f"frontdoor: streaming RSS delta "
+                    f"{part['stream_rss_delta_mb']}MB >= 256MB")
+
+            # buffered comparison AFTER the RSS window: same query, whole
+            # result materialized — the rows must hash identically in
+            # identical order
+            t0 = time.perf_counter()
+            buffered = broker.execute(sql)
+            part["buffered_s"] = round(time.perf_counter() - t0, 2)
+            if buffered.get("exceptions"):
+                violations.append(
+                    f"frontdoor: buffered SELECT errored: "
+                    f"{buffered['exceptions']}")
+            else:
+                hash_buf, n_buf = row_hash(
+                    iter(buffered["resultTable"]["rows"]))
+                part["bit_identical"] = (
+                    hash_buf == hash_stream and n_buf == n_streamed)
+                if not part["bit_identical"]:
+                    violations.append(
+                        f"frontdoor: streamed rows != buffered rows "
+                        f"(hash {hash_stream[:12]} vs {hash_buf[:12]}, "
+                        f"n {n_streamed} vs {n_buf})")
+            return part
+        finally:
+            if broker is not None:
+                broker.close()
+            if server is not None:
+                try:
+                    server.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(base, ignore_errors=True)
+
+    # ---- C. fleet-fair admission via gossiped spend ----------------------
+    def fairness() -> dict:
+        part: dict = {}
+        base = tempfile.mkdtemp(prefix="pinot_tpu_frontdoor_fair_")
+        # small tenant budget + throttled spray: the phase measures the
+        # ADMISSION wall, so the offered load must overrun the budget
+        # (rejections engage) without the spray's own broker overhead
+        # (parse/admit/log per request) starving tenant B of CPU — on a
+        # small box an unthrottled spray fakes a fairness failure out of
+        # plain core contention
+        rate, burst, hb_s = 10.0, 5.0, 0.25
+        server = None
+        brokers = []
+        fleets = []
+        try:
+            registry = ClusterRegistry()
+            controller = Controller(registry, os.path.join(base, "ds"))
+            rng = np.random.default_rng(20)
+            # tenant B pays a real scan (heavy enough that its p99 is
+            # its own work, not scheduler noise); tenant A's spray is a
+            # near-free lookup so ADMISSION, not CPU, is what bounds it
+            schema_b = Schema.build(
+                name="fair", dimensions=[("region", DataType.STRING)],
+                metrics=[("v", DataType.INT)])
+            cfg_b = TableConfig(table_name="fair")
+            for i in range(4):
+                cols = {
+                    "region": np.array(["na", "eu", "apac", "latam"])[
+                        rng.integers(0, 4, 150_000)],
+                    "v": rng.integers(1, 500, 150_000).astype(np.int32),
+                }
+                build_segment(schema_b, cols, os.path.join(base, f"f{i}"),
+                              cfg_b, f"fair_s{i}")
+            schema_a = Schema.build(
+                name="ping", dimensions=[],
+                metrics=[("x", DataType.INT)])
+            cfg_a = TableConfig(table_name="ping")
+            build_segment(schema_a,
+                          {"x": np.arange(1000, dtype=np.int32)},
+                          os.path.join(base, "p0"), cfg_a, "ping_s0")
+            server = ServerInstance("fd_fair_srv", registry,
+                                    os.path.join(base, "sd"),
+                                    device_executor=None)
+            server.start()
+            controller.add_table(cfg_b, schema_b)
+            for i in range(4):
+                controller.upload_segment("fair", os.path.join(base,
+                                                               f"f{i}"))
+            controller.add_table(cfg_a, schema_a)
+            controller.upload_segment("ping", os.path.join(base, "p0"))
+            t_end = time.time() + 60
+            while time.time() < t_end:
+                tf = server.engine.tables.get("fair_OFFLINE")
+                tp = server.engine.tables.get("ping_OFFLINE")
+                if tf is not None and len(tf.segments) == 4 \
+                        and tp is not None and len(tp.segments) == 1:
+                    break
+                time.sleep(0.1)
+            else:
+                raise RuntimeError("frontdoor: fairness segments never "
+                                   "loaded")
+            for name in ("fd_fair_a", "fd_fair_b"):
+                bk = Broker(registry, broker_id=name, timeout_s=15.0,
+                            admission=TenantAdmissionController(
+                                rate_qps=rate, burst=burst))
+                brokers.append(bk)
+                fm = BrokerFleetMember(registry, bk,
+                                       heartbeat_interval_ms=int(hb_s * 1e3))
+                fm.start()
+                fleets.append(fm)
+            sql_a = "SELECT COUNT(*) FROM ping"
+            sql_b = ("SELECT region, SUM(v) FROM fair GROUP BY region "
+                     "ORDER BY region")
+            for bk in brokers:
+                r = bk.execute(sql_b, principal="tenantB")
+                if r.get("exceptions"):
+                    raise RuntimeError(f"frontdoor fairness warmup: "
+                                       f"{r['exceptions']}")
+
+            def paced_b(n: int, pace_s: float = 0.15) -> list:
+                lats = []
+                next_t = time.perf_counter()
+                for k in range(n):
+                    sleep = next_t - time.perf_counter()
+                    if sleep > 0:
+                        time.sleep(sleep)
+                    next_t += pace_s
+                    t0 = time.perf_counter()
+                    r = brokers[k % 2].execute(sql_b, principal="tenantB")
+                    if not r.get("exceptions"):
+                        lats.append((time.perf_counter() - t0) * 1e3)
+                return lats
+
+            def run_round() -> tuple:
+                base_lats = paced_b(24)
+                p99_base = float(np.percentile(base_lats, 99)) \
+                    if base_lats else 0.0
+                # pre-drain: burn tenant A's cold-start burst on BOTH
+                # brokers, then give gossip one interval to converge —
+                # the measured window tests the steady state the bound
+                # is written for
+                stop = _threading.Event()
+                admitted = [0]
+                rejected = [0]
+                lock = _threading.Lock()
+
+                def spray(bk):
+                    while not stop.is_set():
+                        r = bk.execute(sql_a, principal="tenantA")
+                        with lock:
+                            if r.get("exceptions"):
+                                rejected[0] += 1
+                            else:
+                                admitted[0] += 1
+                        time.sleep(0.04)
+
+                for bk in brokers:
+                    for _ in range(int(2 * burst)):
+                        bk.execute(sql_a, principal="tenantA")
+                time.sleep(2 * hb_s)
+                admitted[0] = rejected[0] = 0
+                threads = [_threading.Thread(target=spray, args=(bk,))
+                           for bk in brokers]
+                t_start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                with_lats = paced_b(24)
+                stop.set()
+                for t in threads:
+                    t.join()
+                window_s = time.perf_counter() - t_start
+                p99_with = float(np.percentile(with_lats, 99)) \
+                    if with_lats else 0.0
+                return (p99_base, p99_with, admitted[0], rejected[0],
+                        window_s, len(base_lats), len(with_lats))
+
+            (p99_base, p99_with, admitted, rejected, window_s,
+             n_base, n_with) = run_round()
+            drift = (p99_with - p99_base) / max(p99_base, 50.0)
+            if drift >= 0.25:
+                # contention-drift retry: one more full round — on a
+                # busy shared box a single background burst during
+                # either window fakes a fairness failure
+                part["retried"] = True
+                (p99_base, p99_with, admitted, rejected, window_s,
+                 n_base, n_with) = run_round()
+                drift = (p99_with - p99_base) / max(p99_base, 50.0)
+            # fleet-wide bound: one logical budget (rate*T), plus the
+            # burst the fleet may legitimately hold, plus one heartbeat
+            # of refill PER PEER of gossip lag, plus a small pacing slack
+            bound = rate * window_s + burst + 2 * rate * hb_s + 8
+            no_gossip = 2 * rate * window_s
+            part.update({
+                "rate_qps": rate, "burst": burst,
+                "heartbeat_s": hb_s,
+                "window_s": round(window_s, 2),
+                "tenantA_admitted": admitted,
+                "tenantA_rejected": rejected,
+                "admit_bound": round(bound, 1),
+                "no_gossip_would_admit": round(no_gossip, 1),
+                "tenantB_p99_base_ms": round(p99_base, 1),
+                "tenantB_p99_with_spray_ms": round(p99_with, 1),
+                "tenantB_p99_drift": round(drift, 3),
+                "samples": {"base": n_base, "with": n_with},
+            })
+            # each broker must have OBSERVED its peer's tenant-A spend
+            # (the gossip is what makes the fleet bound reachable at all)
+            part["gossip_active"] = all(
+                any(seen.get("tenantA", 0) > 0
+                    for seen in bk.admission._peer_spend_seen.values())
+                for bk in brokers)
+            if not part["gossip_active"]:
+                violations.append(
+                    "frontdoor: brokers never observed peer tenant spend "
+                    "(fleet gossip inactive)")
+            if admitted > bound:
+                violations.append(
+                    f"frontdoor: tenant A admitted {admitted} across 2 "
+                    f"brokers in {window_s:.1f}s > fleet bound "
+                    f"{bound:.0f} (no-gossip would be ~{no_gossip:.0f})")
+            if not rejected:
+                violations.append(
+                    "frontdoor: tenant A spray was never rejected — the "
+                    "admission wall is not engaging")
+            if drift >= 0.25:
+                violations.append(
+                    f"frontdoor: tenant B p99 drifted {drift:.1%} under "
+                    f"tenant A spray (base {p99_base:.0f}ms -> "
+                    f"{p99_with:.0f}ms; bar: <25%)")
+            return part
+        finally:
+            for fm in fleets:
+                fm.stop()
+            for bk in brokers:
+                bk.close()
+            if server is not None:
+                try:
+                    server.stop()
+                except Exception:
+                    pass
+            shutil.rmtree(base, ignore_errors=True)
+
+    scaling_part = broker_scaling()
+    detail["broker_scaling"] = scaling_part
+    # benchdiff's gated headline keys live at the section top level
+    detail["qps2_over_qps1"] = scaling_part.get("qps2_over_qps1", 0.0)
+    stream_part = streaming()
+    detail["streaming"] = stream_part
+    detail["stream_rss_delta_mb"] = stream_part.get(
+        "stream_rss_delta_mb", 0.0)
+    detail["fairness"] = fairness()
+    detail["note"] = (
+        "A: cache-hot fixed query via client rotation over 1 vs 2 broker "
+        "OS processes discovered from the registry, gain normalized by "
+        "the box's own 2-process ceiling; B: 10M-row SELECT streamed "
+        "through the chunked cursor path with per-chunk VmRSS sampling, "
+        "hash-compared against the buffered run; C: 2 in-process brokers "
+        "gossip per-tenant spend over fleet heartbeats while tenant A "
+        "sprays both and tenant B runs paced scans")
+    return detail, violations
+
+
 def bench_observability(n_queries: int = 24):
     """detail.observability: the flight-recorder phase (ISSUE 7). A
     2-server in-process cluster serves a device group-by; the phase runs
@@ -3931,12 +4590,23 @@ def main():
     ap.add_argument(
         "--phase",
         choices=("full", "faults", "observability", "join", "subrtt",
-                 "cluster", "tiering", "overload", "adaptive"),
+                 "cluster", "tiering", "overload", "adaptive",
+                 "frontdoor"),
         default="full",
         help="'faults' / 'observability' / 'join' / 'subrtt' / 'cluster' "
-             "/ 'tiering' / 'overload' / 'adaptive' run ONLY that phase "
-             "(no dataset build) so CI can gate on each standalone")
+             "/ 'tiering' / 'overload' / 'adaptive' / 'frontdoor' run "
+             "ONLY that phase (no dataset build) so CI can gate on each "
+             "standalone")
     args = ap.parse_args()
+    if args.phase == "frontdoor":
+        detail, violations = bench_frontdoor()
+        print(json.dumps({"metric": "frontdoor-phase standalone",
+                          "detail": {"frontdoor": detail}}))
+        if violations:
+            print(f"frontdoor gate FAILED: {json.dumps(violations)}",
+                  file=sys.stderr)
+            sys.exit(12)
+        return
     if args.phase == "adaptive":
         detail, violations = bench_adaptive()
         print(json.dumps({"metric": "adaptive-phase standalone",
@@ -4066,6 +4736,7 @@ def main():
     tiering_detail, tiering_violations = bench_tiering()
     overload_detail, overload_violations = bench_overload()
     adaptive_detail, adaptive_violations = bench_adaptive()
+    frontdoor_detail, frontdoor_violations = bench_frontdoor()
     micro_detail = bench_micro()
     # micro-kernel regression gate (>25% below the BENCH_r05 reference
     # fails the run AFTER printing, so chunklet work can't silently
@@ -4133,6 +4804,7 @@ def main():
                     "tiering": tiering_detail,
                     "overload": overload_detail,
                     "adaptive": adaptive_detail,
+                    "frontdoor": frontdoor_detail,
                     "micro": micro_detail,
                     "micro_gate": {
                         "reference": micro_ref_source,
@@ -4222,6 +4894,10 @@ def main():
         print(f"adaptive gate FAILED: {json.dumps(adaptive_violations)}",
               file=sys.stderr)
         sys.exit(11)
+    if frontdoor_violations:
+        print(f"frontdoor gate FAILED: {json.dumps(frontdoor_violations)}",
+              file=sys.stderr)
+        sys.exit(12)
 
 
 if __name__ == "__main__":

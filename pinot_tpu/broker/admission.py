@@ -87,7 +87,8 @@ class AdmissionDecision:
 
 
 class _TenantBucket:
-    __slots__ = ("tokens", "last", "rate", "burst", "admitted", "shed")
+    __slots__ = ("tokens", "last", "rate", "burst", "admitted", "shed",
+                 "spent")
 
     def __init__(self, rate: float, burst: float):
         self.rate = rate
@@ -96,6 +97,11 @@ class _TenantBucket:
         self.last = time.monotonic()
         self.admitted = 0
         self.shed = 0
+        # cumulative admitted token cost — the fleet-gossip counter
+        # (ISSUE 18): peers read it from broker heartbeats and debit the
+        # DELTA from their own bucket so N brokers share one logical
+        # per-tenant budget
+        self.spent = 0.0
 
     def refill(self, now: float) -> None:
         dt = now - self.last
@@ -132,6 +138,10 @@ class TenantAdmissionController:
         self.num_admitted = 0
         self.num_shed = 0
         self.num_shed_stale_served = 0  # bumped by the broker's shed path
+        # fleet-gossip bookkeeping (ISSUE 18): last-seen cumulative spend
+        # per peer broker, {peer_id: {tenant: cum_spend}} — deltas against
+        # it are debited locally so the fleet shares one logical budget
+        self._peer_spend_seen: dict = {}
 
     @classmethod
     def from_config(cls, conf) -> "TenantAdmissionController":
@@ -274,6 +284,7 @@ class TenantAdmissionController:
             if b.tokens >= cost:
                 b.tokens -= cost
                 b.admitted += 1
+                b.spent += cost
                 self.num_admitted += 1
                 return AdmissionDecision(True, tenant, priority,
                                          sub_rtt=sub_rtt)
@@ -287,6 +298,56 @@ class TenantAdmissionController:
             False, tenant, priority, reason="tenant_bucket_dry",
             retry_after_s=min(RETRY_AFTER_CAP_S, retry), sub_rtt=sub_rtt)
 
+    # ---- fleet spend gossip (ISSUE 18) -----------------------------------
+    # Every broker keeps the tenant's FULL refill rate but debits what its
+    # peers admitted since the last heartbeat: at equilibrium each broker
+    # nets (rate − fleet_admit_rate_elsewhere) tokens/s, so the fleet as a
+    # whole admits at ONE logical rate regardless of how a tenant sprays
+    # its queries. The budget is eventual — a peer's spend lands one
+    # heartbeat late — so the worst-case over-admit is bounded by one
+    # heartbeat of refill (plus each broker's independent cold-start
+    # burst, a one-time transient).
+
+    def spend_snapshot(self) -> dict:
+        """{tenant: cumulative admitted token cost} — published in the
+        broker's fleet heartbeat for peers to diff against."""
+        with self._lock:
+            return {name: round(b.spent, 3)
+                    for name, b in self._buckets.items() if b.spent > 0}
+
+    def observe_peer_spend(self, peer_id: str, spend: dict) -> None:
+        """Debit a peer broker's admitted spend since its last gossip.
+
+        ``spend`` is the peer's cumulative {tenant: cost} snapshot; the
+        delta vs the last-seen snapshot comes out of the local bucket's
+        tokens (floored at -burst so a hot peer can dent but not
+        permanently bankrupt this broker). A peer whose counter went
+        BACKWARD restarted — treat its full counter as fresh spend once
+        rather than ignoring it."""
+        if not peer_id or not spend:
+            return
+        with self._lock:
+            seen = self._peer_spend_seen.setdefault(peer_id, {})
+            for tenant, cum in spend.items():
+                try:
+                    cum = float(cum)
+                except (TypeError, ValueError):
+                    continue
+                last = seen.get(tenant, 0.0)
+                delta = cum if cum < last else cum - last
+                seen[tenant] = cum
+                if delta <= 0:
+                    continue
+                b = self._bucket(tenant)
+                b.refill(time.monotonic())
+                b.tokens = max(-b.burst, b.tokens - delta)
+
+    def forget_peer(self, peer_id: str) -> None:
+        """Drop a departed peer's last-seen snapshot (a rejoining broker
+        starts a fresh counter and must not be double-debited)."""
+        with self._lock:
+            self._peer_spend_seen.pop(peer_id, None)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -298,6 +359,7 @@ class TenantAdmissionController:
                         "tokens": round(b.tokens, 2),
                         "rate": b.rate, "burst": b.burst,
                         "admitted": b.admitted, "shed": b.shed,
+                        "spent": round(b.spent, 2),
                     } for name, b in self._buckets.items()
                 },
             }

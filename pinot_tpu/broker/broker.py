@@ -655,6 +655,7 @@ class Broker:
         from pinot_tpu.broker.querylog import QueryLogger
 
         self.querylog = QueryLogger.from_config()
+        self.querylog.broker_id = broker_id
         # failure-handling knobs (reference: pinot.broker.* config keys):
         # retry re-sends a failed instance's segments to a replica before
         # declaring partialResult; hedging duplicates a slow request to a
@@ -758,6 +759,24 @@ class Broker:
         self._channels_lock = threading.Lock()
         self._request_id = itertools.count(1)
         self._pool = futures.ThreadPoolExecutor(max_workers=16)
+        # fleet front door (ISSUE 18): a draining broker answers typed
+        # (errorCode 503 / HTTP 503) so rotating clients move to a peer;
+        # queries_served feeds the heartbeat-piggybacked QPS counter
+        self.draining = False
+        self.queries_served = 0
+
+    def drain_response(self) -> dict:
+        """Typed refusal a draining broker returns instead of executing:
+        clients rotate to a live peer on sight of it (the HTTP surface
+        maps it to a 503)."""
+        return {
+            "resultTable": None, "numDocsScanned": 0, "timeUsedMs": 0.0,
+            "brokerDraining": True, "brokerId": self.broker_id,
+            "exceptions": [{
+                "errorCode": 503,
+                "message": f"broker {self.broker_id} is draining",
+            }],
+        }
 
     def close(self) -> None:
         for gname in self._rc_gauges:
@@ -1012,6 +1031,11 @@ class Broker:
 
         t0 = time.time()
         self.metrics.count("queries")
+        if self.draining:
+            # fleet drain (ISSUE 18): typed refusal, never a hang or a
+            # half-executed query — rotating clients retry a live peer
+            self.metrics.count("queriesRefusedDraining")
+            return self.drain_response()
         if sql.strip().rstrip(";").strip().upper() == "SHOW TABLES":
             # catalog surface for standards clients (the JDBC driver's
             # DatabaseMetaData.getTables role, backed by the controller's
@@ -1181,6 +1205,406 @@ class Broker:
                 put_view.update(own_epochs or {})
                 self.result_cache.put(cache_key, resp, put_view, cache_gen)
         return self._log_query(sql, q, resp, t0)
+
+    # ---- streaming result delivery (ISSUE 18) ----------------------------
+    # One chunked front door for every query shape: eligible single-stage
+    # selections ride the per-segment server DataTable streams end to end
+    # (server → broker → client with bounded broker RSS — each block is
+    # decoded, reduced, trimmed, yielded, freed), everything else
+    # (aggregations, ORDER BY, joins, SHOW TABLES, traced queries) falls
+    # back to the buffered execute() re-chunked, so a client can use the
+    # cursor API unconditionally. Chunk protocol:
+    #   {"type": "schema", "columnNames": [...], "columnDataTypes": [...]}
+    #   {"type": "rows", "rows": [[...], ...]}     (0..N chunks)
+    #   {"type": "final", ...response stats/exceptions, no resultTable}
+    # Rows are converted per block by the SAME reduce/finalize code the
+    # buffered path uses (offset/limit neutralized per block, applied
+    # broker-globally), so the concatenated chunks are bit-identical to
+    # the buffered resultTable rows.
+
+    STREAM_CHUNK_ROWS = 50_000
+
+    def execute_stream(self, sql: str, principal: str = None,
+                       chunk_rows: int = 0):
+        """Generator form of execute(): yields schema / rows / final
+        chunks (see the protocol above). The broker never materializes
+        the full result — RSS is bounded by one server block plus one
+        yielded chunk."""
+        t0 = time.time()
+        chunk_rows = int(chunk_rows) or self.STREAM_CHUNK_ROWS
+        if self.draining:
+            self.metrics.count("queries")
+            self.metrics.count("queriesRefusedDraining")
+            yield {"type": "final", **self.drain_response()}
+            return
+        q = None
+        eligible = False
+        try:
+            from pinot_tpu.sql.compiler import compile_select, is_multistage
+            from pinot_tpu.sql.parser import parse_sql
+
+            if sql.strip().rstrip(";").strip().upper() != "SHOW TABLES":
+                stmt = parse_sql(sql)
+                if not is_multistage(stmt):
+                    q = optimize_query(compile_select(stmt))
+                    opts = q.options_ci()
+                    # same eligibility rule as the unary path's
+                    # server-stream branch: any-subset selection
+                    # semantics, untraced, not opted out
+                    eligible = (not q.explain and not q.aggregations()
+                                and not q.distinct and not q.order_by
+                                and opts.get("streaming") is not False
+                                and not opts.get("trace"))
+        except Exception as e:  # noqa: BLE001 — in-band, like execute()
+            self.metrics.count("queries")
+            self.metrics.count("queryErrors")
+            yield {"type": "final", **self._log_query(sql, None, {
+                "exceptions": [{"errorCode": 450,
+                                "message": f"{type(e).__name__}: {e}"}],
+            }, t0)}
+            return
+        if not eligible:
+            # buffered fallback (execute() counts the query + logs it)
+            resp = self.execute(sql, principal=principal)
+            yield from self._chunk_buffered(resp, chunk_rows)
+            return
+        self.metrics.count("queries")
+        yield from self._stream_single_stage(q, sql, principal, t0,
+                                             chunk_rows)
+
+    @staticmethod
+    def _chunk_buffered(resp: dict, chunk_rows: int):
+        """Re-chunk a buffered response onto the streaming protocol."""
+        rt = resp.get("resultTable")
+        if rt:
+            schema = rt.get("dataSchema") or {}
+            yield {"type": "schema",
+                   "columnNames": schema.get("columnNames") or [],
+                   "columnDataTypes": schema.get("columnDataTypes") or []}
+            rows = rt.get("rows") or []
+            for i in range(0, len(rows), chunk_rows):
+                yield {"type": "rows", "rows": rows[i:i + chunk_rows]}
+        final = {k: v for k, v in resp.items() if k != "resultTable"}
+        final["type"] = "final"
+        yield final
+
+    def _stream_single_stage(self, q: QueryContext, sql: str,
+                             principal: str, t0: float, chunk_rows: int):
+        """Admission/quota bracket for the streaming scatter — the same
+        decisions as execute(), but a rejection is a typed final chunk
+        (no stale-cache degrade: streaming skips the result cache)."""
+        gen = self._routing_gen()
+        try:
+            q = self._resolve_table_case(q, gen)
+            tenant = pclass = None
+            if self.admission is not None:
+                from pinot_tpu.broker.querylog import template_key
+
+                tenant, pclass = self.admission.resolve(q, principal)
+                adm_key = self.result_cache.key_for(q, template_key(q))
+                decision = self.admission.try_admit(
+                    tenant, pclass, load_score=self._max_load_score(),
+                    sub_rtt=self.admission.is_sub_rtt(adm_key))
+                if not decision.admitted:
+                    self.metrics.count("queriesAdmissionRejected")
+                    retry_s = max(0.05, float(decision.retry_after_s))
+                    yield {"type": "final", **self._log_query(sql, q, {
+                        "exceptions": [{
+                            "errorCode": 429,
+                            "message": f"admission rejected for tenant "
+                                       f"{decision.tenant!r} (priority "
+                                       f"{decision.priority}): "
+                                       f"{decision.reason}"}],
+                        "retryAfterSeconds": round(retry_s, 3),
+                        "sheddingReason": decision.reason,
+                        "tenant": decision.tenant,
+                        "priorityClass": decision.priority,
+                    }, t0)}
+                    return
+            if not self.quota.acquire(q.table_name, gen):
+                self.metrics.count("queriesQuotaExceeded")
+                yield {"type": "final", **self._log_query(sql, q, {
+                    "exceptions": [{
+                        "errorCode": 429,
+                        "message": f"query quota exceeded for table "
+                                   f"{q.table_name!r}"}],
+                    "retryAfterSeconds": 0.5}, t0)}
+                return
+        except Exception as e:  # noqa: BLE001
+            self.metrics.count("queryErrors")
+            yield {"type": "final", **self._log_query(sql, q, {
+                "exceptions": [{"errorCode": 450,
+                                "message": f"{type(e).__name__}: {e}"}],
+            }, t0)}
+            return
+        reserved: list = []
+        try:
+            yield from self._stream_scatter(q, sql, reserved, gen, t0,
+                                            tenant, pclass, chunk_rows)
+        finally:
+            self.routing.release(reserved)
+
+    def _stream_scatter(self, q: QueryContext, sql: str, reserved: list,
+                        gen, t0: float, tenant, priority, chunk_rows: int):
+        """The streaming scatter body: route like the unary path, then
+        walk the scatter entries SEQUENTIALLY, turning each server's
+        per-segment DataTable blocks into row chunks as they arrive.
+        Sequential order is what makes the output bit-identical to the
+        buffered reduce (results concatenate in the same entry/block
+        order) AND what bounds RSS to one in-flight block."""
+        from pinot_tpu.common.trace import span
+
+        q = self._expand_star(q)
+        request_id = next(self._request_id)
+        trace_id = f"{self.broker_id}-{request_id}"
+        opts = q.options_ci()
+        timeout_s = self.timeout_s
+        if "timeoutms" in opts:
+            timeout_s = max(0.001, float(opts["timeoutms"]) / 1000.0)
+        deadline = Deadline(timeout_s)
+        # per-block finalize runs with offset/limit neutralized — the
+        # broker applies the query's real offset/limit globally below
+        q_all = dataclasses.replace(q, offset=0, limit=1 << 62)
+
+        exceptions: list = []
+        totals = {"numDocsScanned": 0, "totalDocs": 0,
+                  "numSegmentsQueried": 0, "numSegmentsProcessed": 0,
+                  "numSegmentsMatched": 0, "numSegmentsPrunedByServer": 0}
+        n_servers: set = set()
+        responded: set = set()
+        sent_schema = False
+        skip = q.offset
+        remaining = q.limit
+        rows_streamed = 0
+
+        scatter = []  # (instance, physical, segments, time_filter)
+        replicas: dict = {}
+        fully_pruned = []
+        try:
+            with span("broker.route"):
+                for physical, tf in self._physical_tables(q.table_name,
+                                                          gen):
+                    routing, reps, rinfo = \
+                        self.routing.routing_with_replicas(
+                            physical, reserve=True, gen=gen)
+                    reserved.extend(rinfo.get("reserved", ()))
+                    if not routing:
+                        continue
+                    for seg, insts in reps.items():
+                        replicas[(physical, seg)] = insts
+                    records, time_col = self._pruning_inputs(physical, gen)
+                    for inst, segs in routing.items():
+                        kept, _pruned, _bv = prune_segments(
+                            q, records, segs, time_col, tf)
+                        if kept:
+                            scatter.append((inst, physical, kept, tf))
+                        else:
+                            fully_pruned.append(
+                                (inst, physical, segs[:1], tf))
+            if not scatter and fully_pruned:
+                scatter.append(fully_pruned[0])
+            if not scatter:
+                raise KeyError(
+                    f"no routing entry for table {q.table_name!r}")
+
+            def open_stream(inst, phys, segs, tf, attempt):
+                if faults.ACTIVE:
+                    faults.inject("transport.submit", target=inst,
+                                  bound_ms=deadline.remaining_ms())
+                ch = self._channel(inst)
+                if ch is None:
+                    raise ConnectionError(
+                        f"server {inst} not registered")
+                budget_ms = max(1.0, deadline.remaining_ms())
+                payload = make_instance_request(
+                    sql, segs, request_id, self.broker_id, table=phys,
+                    time_filter=tf, timeout_ms=budget_ms, trace=False,
+                    trace_id=trace_id, attempt=attempt,
+                    workload=tenant, priority=priority)
+                return ch.submit_streaming(payload, budget_ms / 1e3 + 0.25)
+
+            with span("broker.stream"), self.metrics.timed("scatterMs"):
+                for inst, phys, segs, tf in scatter:
+                    if remaining <= 0 or deadline.expired():
+                        break
+                    attempt, kind = inst, "primary"
+                    entry_tried = {inst}
+                    entry_yielded = False
+                    while True:
+                        n_servers.add(attempt)
+                        stream = None
+                        try:
+                            stream = open_stream(attempt, phys, segs, tf,
+                                                 kind)
+                            for block in stream:
+                                r = decode(bytes(block))
+                                st = r.stats
+                                if st.server_pressure >= 0 or \
+                                        st.server_inflight >= 0:
+                                    self.routing.loads.observe(
+                                        attempt,
+                                        max(0, st.server_pressure),
+                                        max(0, st.server_inflight))
+                                self._note_epoch(phys, attempt,
+                                                 st.table_epoch)
+                                totals["numDocsScanned"] += \
+                                    st.num_docs_scanned
+                                totals["totalDocs"] += st.total_docs
+                                totals["numSegmentsQueried"] += \
+                                    st.num_segments_queried
+                                totals["numSegmentsProcessed"] += \
+                                    st.num_segments_processed
+                                totals["numSegmentsMatched"] += \
+                                    st.num_segments_matched
+                                totals["numSegmentsPrunedByServer"] += \
+                                    st.num_segments_pruned
+                                if not r.rows:
+                                    continue
+                                table = finalize(
+                                    q_all, merge_intermediates(
+                                        q_all, [r]))
+                                if not sent_schema:
+                                    yield {"type": "schema",
+                                           "columnNames":
+                                               table.column_names,
+                                           "columnDataTypes":
+                                               table.column_types}
+                                    sent_schema = True
+                                rows = table.rows
+                                if skip:
+                                    if skip >= len(rows):
+                                        skip -= len(rows)
+                                        rows = []
+                                    else:
+                                        rows = rows[skip:]
+                                        skip = 0
+                                if rows:
+                                    entry_yielded = True
+                                    if len(rows) > remaining:
+                                        rows = rows[:remaining]
+                                    remaining -= len(rows)
+                                    rows_streamed += len(rows)
+                                    for i in range(0, len(rows),
+                                                   chunk_rows):
+                                        yield {"type": "rows",
+                                               "rows": [list(x) for x in
+                                                        rows[i:i +
+                                                             chunk_rows]]}
+                                # drop this block's row materializations
+                                # NOW — locals otherwise pin the previous
+                                # block's tuples/arrays until the next
+                                # loop iteration rebinds them, doubling
+                                # the streaming high-water mark
+                                rows = table = r = None
+                                if remaining <= 0:
+                                    stream.cancel()
+                                    break
+                                if deadline.expired():
+                                    stream.cancel()
+                                    exceptions.append({
+                                        "errorCode": 250,
+                                        "message":
+                                            f"QUERY_TIMEOUT: {attempt} "
+                                            f"stream cut at the "
+                                            f"{timeout_s * 1e3:.0f}ms "
+                                            f"query budget"})
+                                    break
+                            responded.add(attempt)
+                            self.failures.mark_success(attempt)
+                            break  # entry done
+                        except Exception as exc:  # noqa: BLE001
+                            from pinot_tpu.engine.datatable import (
+                                NoSegmentsHosted,
+                                QueryTimeoutError,
+                                ServerQueryError,
+                            )
+
+                            if isinstance(exc, NoSegmentsHosted):
+                                self.failures.mark_success(attempt)
+                                responded.add(attempt)
+                                break
+                            if isinstance(exc, QueryTimeoutError):
+                                self.failures.mark_success(attempt)
+                                exceptions.append({
+                                    "errorCode": 250,
+                                    "message": f"{attempt}: {exc}"})
+                                break
+                            if isinstance(exc, ServerQueryError):
+                                # query-level error: in-band, no retry
+                                self.failures.mark_success(attempt)
+                                yield {"type": "final",
+                                       **self._log_query(sql, q, {
+                                           "exceptions": [{
+                                               "errorCode": 200,
+                                               "message":
+                                                   f"{attempt}: {exc}"}],
+                                       }, t0)}
+                                return
+                            self.failures.mark_failure(attempt)
+                            # retry on a whole-entry replica ONLY while
+                            # none of this entry's rows were yielded —
+                            # a mid-entry replay would duplicate rows
+                            alt = None
+                            if self.retry_enabled and not entry_yielded \
+                                    and not deadline.expired():
+                                cands = None
+                                for seg in segs:
+                                    insts = set(replicas.get(
+                                        (phys, seg), ()))
+                                    cands = insts if cands is None \
+                                        else cands & insts
+                                pool = [i for i in (cands or ())
+                                        if i not in entry_tried]
+                                healthy = [i for i in pool
+                                           if self.failures.is_healthy(i)]
+                                alt = (healthy or pool or [None])[0]
+                            if alt is None:
+                                exceptions.append({
+                                    "errorCode": 427,
+                                    "message": f"SERVER_NOT_RESPONDING: "
+                                               f"{attempt}: {exc}"})
+                                break
+                            self.metrics.count("retriedRequests")
+                            entry_tried.add(alt)
+                            attempt, kind = alt, "retry"
+                    if exceptions and exceptions[-1].get(
+                            "errorCode") == 250:
+                        break  # budget gone: no further entries
+        except Exception as e:  # noqa: BLE001 — routing/compile errors
+            self.metrics.count("queryErrors")
+            yield {"type": "final", **self._log_query(sql, q, {
+                "exceptions": [{"errorCode": 450,
+                                "message": f"{type(e).__name__}: {e}"}],
+            }, t0)}
+            return
+        if not sent_schema and not exceptions:
+            # zero matching rows anywhere: still surface the shape
+            # (column names from the query; types unknown → STRING)
+            yield {"type": "schema",
+                   "columnNames": [
+                       q.column_name(i)
+                       for i in range(len(q.select_expressions))],
+                   "columnDataTypes":
+                       ["STRING"] * len(q.select_expressions)}
+        if any(x["errorCode"] == 250 for x in exceptions):
+            self.metrics.count("queryTimeouts")
+        resp = {
+            "exceptions": exceptions,
+            "partialResult": bool(exceptions),
+            "streamed": True,
+            "numRowsStreamed": rows_streamed,
+            "numServersQueried": len(n_servers),
+            "numServersResponded": len(responded),
+            "requestId": request_id,
+            "traceId": trace_id,
+            "timeUsedMs": round((time.time() - t0) * 1000, 3),
+        }
+        resp.update(totals)
+        self.metrics.time_ms("query", resp["timeUsedMs"])
+        if self.admission is not None:
+            resp["tenant"] = tenant
+            resp["priorityClass"] = priority
+        yield {"type": "final", **self._log_query(sql, q, resp, t0)}
 
     def _explain_analyze_single(self, sql: str, q: QueryContext) -> dict:
         """Single-stage EXPLAIN ANALYZE: strip the keyword pair, re-enter
@@ -1894,6 +2318,11 @@ class Broker:
         time_used = resp.get("timeUsedMs")
         if time_used is None:
             time_used = round((time.time() - t0) * 1000, 3)
+        # fleet attribution (ISSUE 18): every terminal response says WHICH
+        # broker answered — rotation tests and merged fleet query logs
+        # both key on it — and feeds this broker's heartbeat QPS counter
+        resp.setdefault("brokerId", self.broker_id)
+        self.queries_served += 1
         try:
             from pinot_tpu.broker.querylog import template_key
 

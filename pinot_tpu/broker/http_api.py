@@ -47,6 +47,11 @@ class BrokerHttpServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 enables chunked transfer for the streaming result
+            # path; safe for every other route because they all set
+            # Content-Length (keep-alive framing stays intact)
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args):  # quiet
                 pass
 
@@ -124,7 +129,8 @@ class BrokerHttpServer:
                 self.end_headers()
 
             def do_POST(self):
-                if self.path not in ("/query/sql", "/query"):
+                if self.path not in ("/query/sql", "/query",
+                                     "/query/sql/stream"):
                     self._send(404, {"error": "not found"})
                     return
                 principal = self._authorized()
@@ -135,6 +141,12 @@ class BrokerHttpServer:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
                     sql = payload.get("sql", "")
+                    if outer.broker.draining:
+                        # fleet drain (ISSUE 18): a REAL 503 before any
+                        # execution — rotating clients move to a peer
+                        self._send(503, outer.broker.drain_response(),
+                                   headers={"Retry-After": "1"})
+                        return
                     denied = outer._denied_table(principal, sql)
                     if denied is not None:
                         # per-principal table ACL: reject BEFORE execution
@@ -144,6 +156,9 @@ class BrokerHttpServer:
                             "message": f"Permission denied on table "
                                        f"{denied!r} for principal "
                                        f"{principal!r}"}]})
+                        return
+                    if self.path == "/query/sql/stream":
+                        self._stream_query(sql, principal)
                         return
                     # the authenticated principal is the tenant key for
                     # priority admission (ISSUE 14); "" (auth disabled)
@@ -171,6 +186,43 @@ class BrokerHttpServer:
                         {"exceptions": [{"errorCode": 450,
                                          "message": f"{type(e).__name__}: {e}"}]},
                     )
+
+            def _stream_query(self, sql: str, principal: str) -> None:
+                """Chunked NDJSON result delivery (ISSUE 18): one JSON
+                line per broker chunk (schema / rows / final), HTTP/1.1
+                chunked transfer encoding written by hand so each chunk
+                flushes as it is produced — client RTT-to-first-row is
+                one block, broker RSS stays bounded. urllib/http.client
+                decode the chunk framing transparently; consumers just
+                readline NDJSON."""
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(obj: dict) -> None:
+                    line = (json.dumps(obj) + "\n").encode("utf-8")
+                    self.wfile.write(f"{len(line):X}\r\n".encode("ascii"))
+                    self.wfile.write(line)
+                    self.wfile.write(b"\r\n")
+
+                try:
+                    for chunk in outer.broker.execute_stream(
+                            sql, principal=principal or None):
+                        write_chunk(chunk)
+                except BrokenPipeError:
+                    return  # client went away: stop producing
+                except Exception as e:  # noqa: BLE001 — in-band, typed
+                    try:
+                        write_chunk({"type": "final", "exceptions": [{
+                            "errorCode": 450,
+                            "message": f"{type(e).__name__}: {e}"}]})
+                    except OSError:
+                        return
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         if self.tls is not None:

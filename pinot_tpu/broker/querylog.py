@@ -95,8 +95,13 @@ class QueryLogger:
                  slow_threshold_ms: float = 500.0,
                  sample_rate: float = 0.0,
                  max_bytes: int = 16 << 20,
-                 ring_size: int = 128):
+                 ring_size: int = 128,
+                 broker_id: Optional[str] = None):
         self.path = path
+        # fleet attribution (ISSUE 18): when set, every kept entry stamps
+        # which broker wrote it, so tools/querylog.py can merge JSONL
+        # files from N brokers and still break stats down per broker
+        self.broker_id = broker_id
         self.slow_threshold_ms = float(slow_threshold_ms)
         self.sample_rate = float(sample_rate)
         self.max_bytes = int(max_bytes)
@@ -152,6 +157,7 @@ class QueryLogger:
             template = template()
         entry = {
             "ts": round(time.time(), 3),
+            "brokerId": self.broker_id or resp.get("brokerId"),
             "requestId": resp.get("requestId"),
             "traceId": resp.get("traceId"),
             "table": table,

@@ -117,6 +117,12 @@ class InstanceInfo:
     # controller's tier-aware replica-group assignment reads it
     # (controller.py aggregate_tiers / rebalance_tiered)
     tiers: dict = dataclasses.field(default_factory=dict)
+    # role-specific heartbeat-piggybacked counters (ISSUE 18): brokers
+    # publish {url, draining, qps, cacheHitRate, tenantSpend, ...} here so
+    # clients discover query URLs, clusterstat --brokers renders fleet
+    # health, and admission gossip shares one logical per-tenant budget
+    # across the fleet — all without a second channel
+    stats: dict = dataclasses.field(default_factory=dict)
 
     @property
     def endpoint(self) -> str:
@@ -260,13 +266,14 @@ class ClusterRegistry:
 
     def heartbeat(self, instance_id: str, pressure: float = None,
                   table_epochs: dict = None, heat: dict = None,
-                  tiers: dict = None) -> None:
+                  tiers: dict = None, stats: dict = None) -> None:
         """Liveness tick, optionally carrying the instance's current load
         (scheduler pressure), per-table freshness epochs, the per-segment
-        heat snapshot (ISSUE 11), and the per-segment tier map (ISSUE 12)
-        — the passive half of the broker's load/staleness view (the
-        active half rides piggybacked in every DataTable response) and
-        the controller's temperature/tier aggregation input."""
+        heat snapshot (ISSUE 11), the per-segment tier map (ISSUE 12),
+        and role-specific counters (ISSUE 18 broker fleet stats) — the
+        passive half of the broker's load/staleness view (the active half
+        rides piggybacked in every DataTable response) and the
+        controller's temperature/tier aggregation input."""
 
         def fn(s):
             info = s["instances"].get(instance_id)
@@ -280,6 +287,8 @@ class ClusterRegistry:
                     info.heat = dict(heat)
                 if tiers is not None:
                     info.tiers = dict(tiers)
+                if stats is not None:
+                    info.stats = dict(stats)
 
         self._tx(fn)
 

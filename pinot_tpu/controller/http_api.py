@@ -102,6 +102,44 @@ class ControllerHttpServer:
                         "autoscaler": outer.registry.autoscaler_state(),
                     })
                     return
+                if self.path.rstrip("/") == "/brokers":
+                    # fleet discovery (ISSUE 18): every registered broker
+                    # with liveness, drain state, and the QPS / cache-hit
+                    # counters its heartbeat piggybacked — what a DB-API
+                    # client rotates over and clusterstat --brokers
+                    # renders. Cluster-wide data: restricted principals
+                    # are denied, like /cluster/load.
+                    if outer._access is not None and \
+                            outer._access.is_restricted(principal):
+                        self._send(403, {"error": "Permission denied: "
+                                                  "broker fleet spans "
+                                                  "tables outside this "
+                                                  "principal's grants"})
+                        return
+                    import time as _time
+
+                    from pinot_tpu.cluster.registry import (
+                        HB_STALE_S,
+                        Role,
+                    )
+
+                    now_ms = _time.time() * 1000
+                    brokers = {}
+                    for i in outer.registry.instances(Role.BROKER):
+                        age_ms = max(0.0, now_ms - i.last_heartbeat_ms)
+                        st = i.stats or {}
+                        brokers[i.instance_id] = {
+                            "url": st.get("url"),
+                            "live": age_ms <= HB_STALE_S * 1000.0,
+                            "draining": bool(st.get("draining")),
+                            "heartbeatAgeMs": round(age_ms, 1),
+                            "qps": float(st.get("qps", 0.0) or 0.0),
+                            "queries": int(st.get("queries", 0) or 0),
+                            "cacheHitRate": float(
+                                st.get("cacheHitRate", 0.0) or 0.0),
+                        }
+                    self._send(200, {"brokers": brokers})
+                    return
                 if self.path == "/tables":
                     tables = outer.registry.tables()
                     if outer._access is not None:

@@ -972,7 +972,13 @@ class ServerInstance:
         tdm = self.engine.tables.get(q.table_name)
         wanted = set(req["segments"])
         acquired = [] if tdm is None else tdm.acquire()
-        blocks = []
+        encoded = []
+        # the most recent block stays UNENCODED until the next one arrives
+        # (or the loop ends): the fleet-wide stats stamp lands on the LAST
+        # block, and encoding eagerly lets each earlier block's column
+        # arrays free as soon as its wire bytes exist — peak RSS is one
+        # block's arrays + the encoded tail, not two copies of the result
+        pending = None
         try:
             segments = [s for s in acquired if s.name in wanted]
             if not segments:
@@ -1013,10 +1019,12 @@ class ServerInstance:
                 r = self.engine.host.execute_segment(q, seg)
                 r.stats.num_segments_queried = 0  # set once on the last block
                 produced += len(next(iter(r.rows.values()))) if r.rows else 0
-                blocks.append(r)
+                if pending is not None:
+                    encoded.append(encode(pending))
+                pending = r
                 if produced >= budget:
                     break  # row budget hit: remaining segments unprocessed
-            if not blocks:
+            if pending is None:
                 from pinot_tpu.engine.engine import _impossible
 
                 base = next((s for s in segments
@@ -1028,11 +1036,11 @@ class ServerInstance:
                 if base is None:
                     empty.stats.num_segments_processed = 0
                     empty.stats.num_segments_queried = 0
-                blocks.append(empty)
+                pending = empty
             # same stats contract as execute_segments: every requested
             # segment counts toward numSegmentsQueried and totalDocs, even
             # when pruning or the row budget skipped its execution
-            last = blocks[-1].stats
+            last = pending.stats
             last.num_segments_queried = len(segments)
             last.num_segments_pruned = pruned
             last.num_segments_cold = cold
@@ -1051,7 +1059,8 @@ class ServerInstance:
                             getattr(s, "n_docs", 0)) * ncols * 4)
             except Exception:  # noqa: BLE001 — telemetry never fails a query
                 log.exception("segment heat accounting failed")
-            return [encode(b) for b in blocks]
+            encoded.append(encode(pending))
+            return encoded
         finally:
             if tdm is not None:
                 tdm.release(acquired)

@@ -82,12 +82,13 @@ def cmd_start_server(args) -> int:
 
 def cmd_start_broker(args) -> int:
     from pinot_tpu.broker.broker import Broker
+    from pinot_tpu.broker.fleet import BrokerFleetMember
     from pinot_tpu.broker.http_api import BrokerHttpServer
 
     # generous default: the first aggregate on a fresh server pays XLA
     # compile (~20-40s) before the template cache warms up
-    broker = Broker(_registry(args.registry), broker_id=args.id,
-                    timeout_s=args.timeout_s)
+    registry = _registry(args.registry)
+    broker = Broker(registry, broker_id=args.id, timeout_s=args.timeout_s)
     users = None
     if args.auth:
         users = {}
@@ -101,8 +102,15 @@ def cmd_start_broker(args) -> int:
     http = BrokerHttpServer(broker, host=args.host, port=args.port,
                             users=users)
     http.start()
+    # fleet membership (ISSUE 18): register under Role.BROKER with the
+    # serving URL so clients discover/rotate and peers gossip admission
+    # spend — the BrokerStarter's Helix broker-resource registration
+    fleet = BrokerFleetMember(registry, broker, http_url=http.url,
+                              host=http.host, port=http.port)
+    fleet.start()
     print(f"broker {args.id} serving {http.url}/query/sql")
     _block()
+    fleet.stop()
     http.stop()
     broker.close()
     return 0

@@ -51,7 +51,11 @@ Compared metric families (direction-aware):
   mis-tuned scenario, plus ``adaptive.*.queries_to_converge`` —
   informational, never gated: it moves with min-samples/reprobe tuning —
   ISSUE 17), compared only when BOTH rounds carry a ``detail.adaptive``
-  section.
+  section,
+- the frontdoor phase (``frontdoor.qps2_over_qps1`` — higher is better,
+  the 2-broker scaling ratio — and ``frontdoor.stream_rss_delta_mb`` —
+  lower is better, the streaming SELECT's broker RSS growth; ISSUE 18),
+  compared only when BOTH rounds carry a ``detail.frontdoor`` section.
 """
 
 from __future__ import annotations
@@ -64,7 +68,7 @@ import sys
 _TAIL_SECTIONS = ("ssb100m", "taxi12m", "subrtt", "micro", "concurrency",
                   "observability", "blockskip", "narrow", "join", "faults",
                   "cluster", "breakdown", "roofline", "tiering", "overload",
-                  "adaptive")
+                  "adaptive", "frontdoor")
 
 
 def _brace_match(text: str, key: str):
@@ -286,6 +290,18 @@ def extract_metrics(detail: dict) -> dict:
                 v = _num(entry.get("queries_to_converge"))
                 if v is not None:
                     out[f"adaptive.{sname}.queries_to_converge"] = (v, "info")
+    # frontdoor phase (ISSUE 18): broker-tier scaling efficiency gates
+    # (2-broker QPS over 1-broker, ceiling-normalized upstream in bench);
+    # the streaming path's broker RSS delta is lower-is-better — a
+    # regression means the front door started materializing again
+    fd = detail.get("frontdoor")
+    if isinstance(fd, dict):
+        v = _num(fd.get("qps2_over_qps1"))
+        if v is not None:
+            out["frontdoor.qps2_over_qps1"] = (v, "higher")
+        v = _num(fd.get("stream_rss_delta_mb"))
+        if v is not None:
+            out["frontdoor.stream_rss_delta_mb"] = (v, "lower")
     sub = detail.get("subrtt")
     if isinstance(sub, dict):
         # link_floor_ms is deliberately NOT compared: it is a property of

@@ -16,6 +16,9 @@ Options:
                    pressure, heartbeat age/liveness, and the controller
                    autoscaler's state (watermarks, sustain counters,
                    last scale action — ISSUE 14)
+    --brokers      fetch /brokers instead: the broker fleet with
+                   live/draining state and per-broker QPS + cache hit
+                   rate from heartbeat-piggybacked counters (ISSUE 18)
     --user u:p     basic auth for an ACL'd controller
     --json         machine-readable output (one dict)
 """
@@ -62,6 +65,31 @@ def gather_load(base_url: str, user: str = None) -> dict:
     """The controller's /cluster/load doc (ISSUE 14): per-instance
     pressure + heartbeat ages + autoscaler state."""
     return _get(base_url, "/cluster/load", user)
+
+
+def gather_brokers(base_url: str, user: str = None) -> dict:
+    """The controller's /brokers doc (ISSUE 18): the fleet with
+    liveness, drain state, and heartbeat-piggybacked QPS / cache-hit
+    counters."""
+    return _get(base_url, "/brokers", user)
+
+
+def render_brokers(doc: dict) -> str:
+    brokers = doc.get("brokers") or {}
+    lines = [f"{len(brokers)} broker(s):"]
+    for name in sorted(brokers):
+        rec = brokers[name]
+        state = "DRAINING" if rec.get("draining") \
+            else ("live" if rec.get("live") else "STALE")
+        lines.append(
+            f"  {name}: [{state}] url={rec.get('url')} "
+            f"qps={rec.get('qps')} queries={rec.get('queries')} "
+            f"cacheHitRate={rec.get('cacheHitRate', 0.0):.1%} "
+            f"hb={rec.get('heartbeatAgeMs')}ms")
+    if not brokers:
+        lines.append("  (no brokers registered — start one with "
+                     "admin start-broker)")
+    return "\n".join(lines)
 
 
 def render_load(doc: dict) -> str:
@@ -145,11 +173,17 @@ def main(argv=None) -> int:
                     help="show per-instance pressure, heartbeat "
                          "liveness, and autoscaler state instead of "
                          "segment heat (ISSUE 14 overload view)")
+    ap.add_argument("--brokers", action="store_true", dest="brokers",
+                    help="show the broker fleet: live/draining state "
+                         "and per-broker QPS + cache hit rate from "
+                         "heartbeat-piggybacked counters (ISSUE 18)")
     ap.add_argument("--user", default=None, help="basic auth user:pass")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
     try:
-        if args.load:
+        if args.brokers:
+            doc = gather_brokers(args.controller, user=args.user)
+        elif args.load:
             doc = gather_load(args.controller, user=args.user)
         else:
             heat = gather(args.controller, table=args.table,
@@ -158,6 +192,10 @@ def main(argv=None) -> int:
         print(f"cannot reach controller {args.controller}: {e}",
               file=sys.stderr)
         return 2
+    if args.brokers:
+        print(json.dumps(doc, indent=2) if args.as_json
+              else render_brokers(doc))
+        return 0
     if args.load:
         print(json.dumps(doc, indent=2) if args.as_json
               else render_load(doc))

@@ -1,4 +1,4 @@
-"""Query-log summarizer: ``python -m pinot_tpu.tools.querylog <log.jsonl>``.
+"""Query-log summarizer: ``python -m pinot_tpu.tools.querylog <log.jsonl>...``.
 
 Reads the broker's structured JSONL query log (broker/querylog.py) and
 prints the operator's five-minute view: volume + error/timeout/partial
@@ -6,6 +6,11 @@ counts, latency percentiles overall and per table/template, the
 per-phase p50 breakdown reconstructed from the attached traces (queue /
 compile / gather / kernel / link / reduce — the waterfall that tells
 kernel-ms from link-ms from queue-ms), and the top-N slowest queries.
+
+Accepts MULTIPLE log paths (ISSUE 18): a broker fleet writes one JSONL
+per broker, each entry stamped with its ``brokerId`` — passing them all
+merges the entries into one fleet-wide summary (per-template stats
+aggregate across brokers) plus a per-broker volume/latency breakdown.
 
 Options:
     --top N        how many slow queries to list (default 5)
@@ -183,6 +188,24 @@ def summarize(entries: list, top: int = 5,
                 "advisorState": _advisor_state([k for _, _, _, k in v])}
             for t, v in sorted(by_tpl.items())
         }
+    # fleet breakdown (ISSUE 18): when entries carry brokerId stamps
+    # (broker/querylog.py), break volume/error/latency down per broker —
+    # the merged-fleet view's answer to "is one broker the slow one?"
+    by_broker: dict = {}
+    for e in entries:
+        bid = e.get("brokerId")
+        if bid:
+            by_broker.setdefault(bid, []).append(e)
+    if by_broker:
+        summary["brokers"] = {
+            b: {"queries": len(v),
+                "errors": sum(1 for e in v if e.get("exceptions")),
+                "p50Ms": round(_percentile(
+                    sorted(e.get("timeUsedMs", 0.0) for e in v), 0.5), 2),
+                "p90Ms": round(_percentile(
+                    sorted(e.get("timeUsedMs", 0.0) for e in v), 0.9), 2)}
+            for b, v in sorted(by_broker.items())
+        }
     slowest = sorted(entries, key=lambda e: e.get("timeUsedMs", 0.0),
                      reverse=True)[:top]
     summary["slowest"] = [
@@ -214,16 +237,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m pinot_tpu.tools.querylog",
         description="summarize a pinot-tpu broker query log (JSONL)")
-    ap.add_argument("path", help="query log file")
+    ap.add_argument("paths", nargs="+", metavar="path",
+                    help="query log file(s) — pass one per broker to "
+                         "merge a fleet's logs (ISSUE 18)")
     ap.add_argument("--top", type=int, default=5)
     ap.add_argument("--per-template", action="store_true")
     ap.add_argument("--json", action="store_true", dest="as_json")
     args = ap.parse_args(argv)
-    try:
-        entries = load(args.path)
-    except OSError as e:
-        print(f"cannot read {args.path}: {e}", file=sys.stderr)
-        return 2
+    entries = []
+    for path in args.paths:
+        try:
+            entries.extend(load(path))
+        except OSError as e:
+            print(f"cannot read {path}: {e}", file=sys.stderr)
+            return 2
     if not entries:
         print("no entries", file=sys.stderr)
         return 1
@@ -241,6 +268,9 @@ def main(argv=None) -> int:
     if summary["phaseP50Ms"]:
         print("phase p50s (ms): " + ", ".join(
             f"{k}={v}" for k, v in summary["phaseP50Ms"].items()))
+    for b, row in (summary.get("brokers") or {}).items():
+        print(f"  broker {b}: n={row['queries']} errors={row['errors']} "
+              f"p50={row['p50Ms']}ms p90={row['p90Ms']}ms")
     for t, row in summary["tables"].items():
         print(f"  table {t}: n={row['queries']} p50={row['p50Ms']}ms "
               f"p90={row['p90Ms']}ms")

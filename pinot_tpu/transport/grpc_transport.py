@@ -35,6 +35,18 @@ FETCH_SEGMENT_METHOD = "/pinot.PinotQueryServer/FetchSegment"
 EXECUTE_STAGE_METHOD = "/pinot.PinotQueryServer/ExecuteStage"
 EXCHANGE_TRANSFER_METHOD = "/pinot.PinotQueryServer/ExchangeTransfer"
 
+# wide-result headroom (ISSUE 18): gRPC's 4 MB default inbound cap turns a
+# multi-million-row buffered SELECT into RESOURCE_EXHAUSTED before the
+# broker ever sees the DataTable. Mirror the reference's GrpcConfig
+# maxInboundMessageSizeBytes default (128 MB) on both ends of the wire;
+# the streaming path stays the right answer for results bigger than one
+# message, this just keeps the unary path honest up to the same bound.
+MAX_INBOUND_MESSAGE_BYTES = 128 * 1024 * 1024
+_SIZE_OPTIONS = (
+    ("grpc.max_receive_message_length", MAX_INBOUND_MESSAGE_BYTES),
+    ("grpc.max_send_message_length", MAX_INBOUND_MESSAGE_BYTES),
+)
+
 
 def make_instance_request(sql: str, segments: list, request_id: int,
                           broker_id: str = "", trace: bool = False,
@@ -153,6 +165,7 @@ class QueryServerTransport:
             handlers=(_BytesHandler(submit_fn, submit_streaming_fn,
                                     fetch_segment_fn, execute_stage_fn,
                                     exchange_transfer_fn),),
+            options=_SIZE_OPTIONS,
         )
         if tls is not None:
             # TlsConfig (common/tls.py) — the reference's Netty/gRPC TLS
@@ -184,9 +197,10 @@ class QueryRouterChannel:
         if tls is not None:
             self._channel = grpc.secure_channel(
                 endpoint, tls.channel_credentials(),
-                options=tls.channel_options())
+                options=tuple(tls.channel_options()) + _SIZE_OPTIONS)
         else:
-            self._channel = grpc.insecure_channel(endpoint)
+            self._channel = grpc.insecure_channel(
+                endpoint, options=_SIZE_OPTIONS)
         self._submit = self._channel.unary_unary(
             SUBMIT_METHOD, request_serializer=None, response_deserializer=None
         )

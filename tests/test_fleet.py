@@ -1,0 +1,527 @@
+"""Broker fleet front door (ISSUE 18): discovery, drain/rotation,
+cross-broker cache coherence, fleet-fair admission gossip, and streaming
+result delivery.
+
+Reference analogs: BrokerStarter's Helix BROKER-resource registration
+(clients discover the fleet through ZK), BrokerResourceOnlineOfflineState
+drain semantics, and the gRPC/cursor streaming result delivery — here
+over the registry's existing heartbeat plumbing plus HTTP chunked NDJSON.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pinot_tpu import client as pt_client
+from pinot_tpu.broker.admission import TenantAdmissionController
+from pinot_tpu.broker.broker import Broker
+from pinot_tpu.broker.fleet import (BrokerFleetMember, discover_broker_urls,
+                                    live_brokers)
+from pinot_tpu.broker.http_api import BrokerHttpServer
+from pinot_tpu.cluster.registry import ClusterRegistry, Role
+from pinot_tpu.common.datatypes import DataType
+from pinot_tpu.common.schema import Schema
+from pinot_tpu.common.table_config import StreamConfig, TableConfig, TableType
+from pinot_tpu.controller.controller import Controller
+from pinot_tpu.server.server import ServerInstance
+from pinot_tpu.storage.creator import build_segment
+from pinot_tpu.stream.memory_stream import TopicRegistry
+
+
+def wait_until(cond, timeout=10.0, interval=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    registry = ClusterRegistry()
+    controller = Controller(registry, str(tmp_path / "deepstore"))
+    server = ServerInstance("server_0", registry, str(tmp_path / "srv0"),
+                            device_executor=None)
+    server.start()
+    yield registry, controller, server
+    try:
+        server.stop()
+    except Exception:
+        pass
+
+
+def _offline_table(tmp_path, controller, name="sales", n_segments=2,
+                   rows=3000):
+    schema = Schema.build(
+        name=name,
+        dimensions=[("region", DataType.STRING)],
+        metrics=[("amount", DataType.INT)],
+    )
+    cfg = TableConfig(table_name=name)
+    controller.add_table(cfg, schema)
+    rng = np.random.default_rng(42)
+    for i in range(n_segments):
+        cols = {
+            "region": np.array(["na", "eu", "apac"])[
+                rng.integers(0, 3, rows)],
+            "amount": rng.integers(1, 500, rows).astype(np.int32),
+        }
+        d = str(tmp_path / f"{name}_up{i}")
+        build_segment(schema, cols, d, cfg, f"{name}_s{i}")
+        controller.upload_segment(name, d)
+
+
+def _wait_served(broker, sql, timeout=15.0):
+    def ok():
+        r = broker.execute(sql)
+        return not r.get("exceptions") and not r.get("partialResult")
+    assert wait_until(ok, timeout=timeout)
+
+
+class TestFleetMembership:
+    def test_register_discover_drain_deregister(self, cluster, tmp_path):
+        registry, controller, server = cluster
+        bks = [Broker(registry, broker_id=f"bk_{i}") for i in range(2)]
+        fleets = [
+            BrokerFleetMember(registry, bks[i],
+                              http_url=f"http://127.0.0.1:{8100 + i}",
+                              heartbeat_interval_ms=100).start()
+            for i in range(2)
+        ]
+        try:
+            assert wait_until(
+                lambda: len(discover_broker_urls(registry)) == 2)
+            assert sorted(discover_broker_urls(registry)) == [
+                "http://127.0.0.1:8100", "http://127.0.0.1:8101"]
+
+            # drain publishes immediately: discovery drops the member
+            # without waiting a heartbeat, liveness keeps it visible
+            fleets[0].drain()
+            assert discover_broker_urls(registry) == \
+                ["http://127.0.0.1:8101"]
+            assert len(live_brokers(registry, include_draining=True)) == 2
+            assert bks[0].execute("SELECT 1").get("brokerDraining")
+
+            fleets[0].undrain()
+            assert len(discover_broker_urls(registry)) == 2
+
+            # stop() deregisters cleanly — no TTL wait
+            fleets[1].stop()
+            fleets = fleets[:1]
+            assert discover_broker_urls(registry) == \
+                ["http://127.0.0.1:8100"]
+        finally:
+            for fm in fleets:
+                fm.stop()
+            for bk in bks:
+                bk.close()
+
+    def test_heartbeat_stats_and_controller_endpoint(self, cluster,
+                                                     tmp_path):
+        from pinot_tpu.controller.http_api import ControllerHttpServer
+
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller)
+        bk = Broker(registry, broker_id="bk_stats", result_cache=True)
+        fm = BrokerFleetMember(registry, bk, http_url="http://x:1",
+                               heartbeat_interval_ms=100).start()
+        http = ControllerHttpServer(registry)
+        http.start()
+        try:
+            _wait_served(bk, "SELECT COUNT(*) FROM sales")
+            bk.execute("SELECT COUNT(*) FROM sales")  # cache hit
+            # counters surface in the registry heartbeat...
+            def stats():
+                infos = {i.instance_id: i
+                         for i in registry.instances(Role.BROKER)}
+                return (infos.get("bk_stats").stats
+                        if "bk_stats" in infos else {})
+            assert wait_until(lambda: stats().get("queries", 0) >= 2)
+            # the hit counter rides the NEXT heartbeat tick
+            assert wait_until(lambda: stats().get("cacheHits", 0) >= 1)
+            # ...and through the controller's GET /brokers
+            with urllib.request.urlopen(http.url + "/brokers",
+                                        timeout=5) as resp:
+                doc = json.loads(resp.read())
+            rec = doc["brokers"]["bk_stats"]
+            assert rec["live"] and not rec["draining"]
+            assert rec["url"] == "http://x:1"
+            assert rec["queries"] >= 2
+        finally:
+            http.stop()
+            fm.stop()
+            bk.close()
+
+
+class TestCrossBrokerCoherence:
+    def test_ingest_via_a_invalidates_b_within_heartbeat(self, cluster,
+                                                         tmp_path):
+        """Two cache-enabled brokers; realtime ingest lands while B holds
+        a cached result. B's next read must NOT serve the stale count —
+        the per-table freshness epoch rides server heartbeats to every
+        broker's epoch view, so coherence needs no cross-broker
+        invalidation channel."""
+        registry, controller, server = cluster
+        TopicRegistry.delete("coh")
+        topic = TopicRegistry.create("coh", 1)
+        schema = Schema.build(
+            name="coh", dimensions=[("k", DataType.STRING)],
+            metrics=[("n", DataType.INT)])
+        cfg = TableConfig(
+            table_name="coh", table_type=TableType.REALTIME,
+            stream=StreamConfig(
+                stream_type="memory", topic="coh", decoder="json",
+                segment_flush_threshold_rows=10_000,
+                segment_flush_threshold_seconds=3600,
+            ),
+        )
+        controller.add_table(cfg, schema)
+        for i in range(50):
+            topic.publish_json({"k": f"k{i % 5}", "n": 1})
+
+        bk_a = Broker(registry, broker_id="coh_a", result_cache=True)
+        bk_b = Broker(registry, broker_id="coh_b", result_cache=True)
+        fleets = [BrokerFleetMember(registry, bk,
+                                    heartbeat_interval_ms=100).start()
+                  for bk in (bk_a, bk_b)]
+        sql = "SELECT COUNT(*) FROM coh"
+
+        def count(bk):
+            r = bk.execute(sql)
+            if r.get("exceptions"):
+                return -1
+            return r["resultTable"]["rows"][0][0]
+
+        try:
+            assert wait_until(lambda: count(bk_a) == 50, timeout=15)
+            assert wait_until(lambda: count(bk_b) == 50)
+            # both caches hot on the same result
+            assert bk_a.execute(sql).get("resultCacheHit")
+            assert bk_b.execute(sql).get("resultCacheHit")
+
+            # concurrent reads on B while ingest flows through the stream
+            stale_served = [0]
+            stop = threading.Event()
+
+            def hammer_b():
+                while not stop.is_set():
+                    r = bk_b.execute(sql)
+                    n = r["resultTable"]["rows"][0][0]
+                    if r.get("resultCacheHit") and n not in (50, 80):
+                        stale_served[0] += 1
+                    time.sleep(0.01)
+
+            t = threading.Thread(target=hammer_b)
+            t.start()
+            for i in range(30):
+                topic.publish_json({"k": f"k{i % 5}", "n": 1})
+            # B converges to the new count within (consume + heartbeat)
+            assert wait_until(lambda: count(bk_b) == 80, timeout=15)
+            stop.set()
+            t.join()
+            # no cache hit on B ever served a count that was neither the
+            # pre- nor post-ingest value
+            assert stale_served[0] == 0
+            # and the fresh result re-caches: B hits again at 80
+            assert wait_until(
+                lambda: bk_b.execute(sql).get("resultCacheHit")
+                and count(bk_b) == 80)
+            assert count(bk_a) == 80
+        finally:
+            stop.set()
+            for fm in fleets:
+                fm.stop()
+            bk_a.close()
+            bk_b.close()
+            TopicRegistry.delete("coh")
+
+
+class TestAdmissionGossip:
+    def test_observe_peer_spend_debits_local_bucket(self):
+        adm = TenantAdmissionController(rate_qps=5.0, burst=4.0)
+        # local bucket starts at full burst: 4 admits pass
+        for _ in range(4):
+            assert adm.try_admit("t1", "dashboard").admitted
+        assert not adm.try_admit("t1", "dashboard").admitted
+        # peer restart: counter going BACKWARD is treated as fresh spend,
+        # not a negative delta
+        adm2 = TenantAdmissionController(rate_qps=5.0, burst=4.0)
+        adm2.observe_peer_spend("peer", {"t1": 100.0})
+        adm2.observe_peer_spend("peer", {"t1": 2.0})
+        snap = adm2._peer_spend_seen["peer"]
+        assert snap["t1"] == 2.0
+        # a peer's spend empties the local bucket too (shared budget)
+        adm3 = TenantAdmissionController(rate_qps=5.0, burst=4.0)
+        adm3.observe_peer_spend("peer", {"t2": 4.0})
+        assert not adm3.try_admit("t2", "dashboard").admitted
+        adm3.forget_peer("peer")
+        assert "peer" not in adm3._peer_spend_seen
+
+    def test_fleet_shares_one_tenant_budget(self, cluster, tmp_path):
+        """Spend on broker A propagates through heartbeat gossip and
+        empties the same tenant's bucket on broker B."""
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="adm", n_segments=1,
+                       rows=500)
+        bks = [Broker(registry, broker_id=f"adm_{i}",
+                      admission=TenantAdmissionController(
+                          rate_qps=2.0, burst=6.0))
+               for i in range(2)]
+        fleets = [BrokerFleetMember(registry, bk,
+                                    heartbeat_interval_ms=100).start()
+                  for bk in bks]
+        sql = "SELECT COUNT(*) FROM adm"
+        try:
+            _wait_served(bks[0], sql)
+            # burn tenant X's burst on broker A only
+            for _ in range(8):
+                bks[0].execute(sql, principal="tx")
+            # within a couple of heartbeats, broker B has observed A's
+            # spend and refuses the same tenant despite never serving it
+            def b_rejects():
+                r = bks[1].execute(sql, principal="tx")
+                excs = r.get("exceptions") or []
+                return bool(excs) and excs[0].get("errorCode") == 429
+            assert wait_until(b_rejects, timeout=5)
+            # a different tenant still has its own full budget on B
+            r = bks[1].execute(sql, principal="ty")
+            assert not r.get("exceptions")
+        finally:
+            for fm in fleets:
+                fm.stop()
+            for bk in bks:
+                bk.close()
+
+
+class TestClientRotation:
+    def test_retry_policy_single_source(self):
+        assert pt_client.retry_after_s("2") == 2.0
+        assert pt_client.retry_after_s(99) == pt_client.MAX_RETRY_AFTER_S
+        assert pt_client.retry_after_s(0.0) == 0.05
+        assert pt_client.retry_after_s("nope") == 0.5
+        assert pt_client.is_quota_rejection(
+            {"exceptions": [{"errorCode": 429}]})
+        assert not pt_client.is_quota_rejection(
+            {"exceptions": [{"errorCode": 429}, {"errorCode": 450}]})
+        assert not pt_client.is_quota_rejection({"exceptions": []})
+        # the in-process and HTTP paths share the ONE module-level policy
+        assert pt_client.Connection._retry_after_s is pt_client.retry_after_s
+        assert pt_client.Connection._is_quota_rejection \
+            is pt_client.is_quota_rejection
+
+    def test_drain_mid_run_rotates_with_zero_errors(self, cluster,
+                                                    tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="rot")
+        bks = [Broker(registry, broker_id=f"rot_{i}") for i in range(2)]
+        https = [BrokerHttpServer(bk, port=0) for bk in bks]
+        for h in https:
+            h.start()
+        fleets = [BrokerFleetMember(registry, bks[i], http_url=https[i].url,
+                                    heartbeat_interval_ms=100).start()
+                  for i in range(2)]
+        try:
+            _wait_served(bks[0], "SELECT COUNT(*) FROM rot")
+            conn = pt_client.connect(
+                broker_urls=[h.url for h in https], timeout_s=10.0)
+            cur = conn.cursor()
+            served_by = set()
+            for k in range(30):
+                if k == 10:
+                    fleets[0].drain()  # broker 0 starts 503ing mid-run
+                cur.execute("SELECT COUNT(*) FROM rot")
+                assert cur.fetchone() == (6000,)
+                served_by.add(cur.stats.get("brokerId"))
+            # pre-drain traffic reached both; post-drain all landed on 1
+            assert served_by == {"rot_0", "rot_1"}
+            assert bks[1].queries_served > bks[0].queries_served
+
+            # drain the whole fleet: bounded rotation fails typed
+            fleets[1].drain()
+            with pytest.raises(pt_client.NoLiveBrokersError):
+                cur.execute("SELECT COUNT(*) FROM rot")
+            conn.close()
+        finally:
+            for fm in fleets:
+                fm.stop()
+            for h in https:
+                h.stop()
+            for bk in bks:
+                bk.close()
+
+    def test_registry_discovery_connection(self, cluster, tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="disc")
+        bk = Broker(registry, broker_id="disc_0")
+        http = BrokerHttpServer(bk, port=0)
+        http.start()
+        fm = BrokerFleetMember(registry, bk, http_url=http.url,
+                               heartbeat_interval_ms=100).start()
+        try:
+            _wait_served(bk, "SELECT COUNT(*) FROM disc")
+            assert wait_until(
+                lambda: discover_broker_urls(registry) == [http.url])
+            conn = pt_client.connect(registry=registry, discover=True)
+            cur = conn.cursor()
+            cur.execute("SELECT COUNT(*) FROM disc")
+            assert cur.fetchone() == (6000,)
+            conn.close()
+        finally:
+            fm.stop()
+            http.stop()
+            bk.close()
+
+
+class TestStreaming:
+    def _rows_via_stream(self, chunks):
+        rows, final, schema = [], None, None
+        for c in chunks:
+            if c.get("type") == "schema":
+                schema = c
+            elif c.get("type") == "rows":
+                rows.extend(tuple(r) for r in c["rows"])
+            elif c.get("type") == "final":
+                final = c
+        return schema, rows, final
+
+    def test_inprocess_stream_parity_and_order(self, cluster, tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="st", n_segments=2,
+                       rows=4000)
+        bk = Broker(registry, broker_id="st_bk")
+        try:
+            _wait_served(bk, "SELECT COUNT(*) FROM st")
+            sql = "SELECT region, amount FROM st LIMIT 8000"
+            buffered = bk.execute(sql)
+            schema, rows, final = self._rows_via_stream(
+                bk.execute_stream(sql, chunk_rows=1000))
+            assert schema["columnNames"] == \
+                buffered["resultTable"]["dataSchema"]["columnNames"]
+            assert final.get("streamed") is True
+            assert not final.get("exceptions")
+            assert final["numRowsStreamed"] == 8000
+            assert rows == [tuple(r) for r in
+                            buffered["resultTable"]["rows"]]
+            # brokerId + querylog stamping covers the streaming path too
+            assert final.get("brokerId") == "st_bk"
+
+            # offset/limit trim happens broker-side, identically
+            sql2 = "SELECT region, amount FROM st LIMIT 100, 37"
+            b2 = bk.execute(sql2)
+            _, rows2, f2 = self._rows_via_stream(bk.execute_stream(sql2))
+            assert rows2 == [tuple(r) for r in
+                             b2["resultTable"]["rows"]]
+            assert len(rows2) == 37
+        finally:
+            bk.close()
+
+    def test_nonstreamable_falls_back_buffered(self, cluster, tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="agg", n_segments=1,
+                       rows=2000)
+        bk = Broker(registry, broker_id="agg_bk")
+        try:
+            _wait_served(bk, "SELECT COUNT(*) FROM agg")
+            sql = ("SELECT region, COUNT(*) FROM agg GROUP BY region "
+                   "ORDER BY region")
+            buffered = bk.execute(sql)
+            schema, rows, final = self._rows_via_stream(
+                bk.execute_stream(sql))
+            assert rows == [tuple(r) for r in
+                            buffered["resultTable"]["rows"]]
+            assert not final.get("exceptions")
+            # the universal cursor API: same chunk shape, not the true
+            # server-streaming path
+            assert not final.get("streamed")
+        finally:
+            bk.close()
+
+    def test_http_ndjson_stream_and_client_cursor(self, cluster, tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="hs", n_segments=2,
+                       rows=3000)
+        bk = Broker(registry, broker_id="hs_bk")
+        http = BrokerHttpServer(bk, port=0)
+        http.start()
+        try:
+            _wait_served(bk, "SELECT COUNT(*) FROM hs")
+            sql = "SELECT region, amount FROM hs LIMIT 6000"
+            # raw wire: chunked transfer, one JSON object per line
+            req = urllib.request.Request(
+                http.url + "/query/sql/stream",
+                data=json.dumps({"sql": sql}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.headers.get("Content-Type") == \
+                    "application/x-ndjson"
+                lines = [json.loads(ln) for ln in resp if ln.strip()]
+            assert lines[0]["type"] == "schema"
+            assert lines[-1]["type"] == "final"
+            n_wire = sum(len(c.get("rows") or ()) for c in lines)
+            assert n_wire == 6000
+
+            # DB-API streaming cursor against the same endpoint
+            conn = pt_client.connect(http.url, timeout_s=10.0)
+            cur = conn.cursor()
+            cur.execute_stream(sql)
+            assert [d[0] for d in cur.description] == ["region", "amount"]
+            streamed = cur.fetchall()
+            assert cur.stats.get("numRowsStreamed") == 6000
+            cur.execute(sql)
+            assert streamed == cur.fetchall()
+            conn.close()
+        finally:
+            http.stop()
+            bk.close()
+
+    def test_stream_open_rotates_off_draining_broker(self, cluster,
+                                                     tmp_path):
+        registry, controller, server = cluster
+        _offline_table(tmp_path, controller, name="sr", n_segments=1,
+                       rows=1000)
+        bks = [Broker(registry, broker_id=f"sr_{i}") for i in range(2)]
+        try:
+            _wait_served(bks[0], "SELECT COUNT(*) FROM sr")
+            bks[0].draining = True
+            conn = pt_client.connect(brokers=list(bks), timeout_s=10.0)
+            cur = conn.cursor()
+            for _ in range(4):  # every rotation start lands on sr_1
+                cur.execute_stream("SELECT region FROM sr LIMIT 10")
+                assert len(cur.fetchall()) == 10
+                assert cur.stats.get("brokerId") == "sr_1"
+            conn.close()
+        finally:
+            for bk in bks:
+                bk.close()
+
+
+class TestQuerylogFleetMerge:
+    def test_multi_file_merge_with_broker_breakdown(self, tmp_path):
+        from pinot_tpu.tools import querylog as ql
+
+        def entry(bid, ms, exc=None):
+            return {"brokerId": bid, "timeUsedMs": ms, "table": "t",
+                    "exceptions": exc or []}
+
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        a.write_text("\n".join(json.dumps(entry("bk_a", 10.0))
+                               for _ in range(4)))
+        b.write_text("\n".join(
+            [json.dumps(entry("bk_b", 30.0)) for _ in range(2)]
+            + [json.dumps(entry("bk_b", 50.0,
+                                [{"errorCode": 450, "message": "x"}]))]))
+        entries = ql.load(str(a)) + ql.load(str(b))
+        summary = ql.summarize(entries)
+        assert summary["queries"] == 7
+        assert summary["brokers"]["bk_a"] == {
+            "queries": 4, "errors": 0, "p50Ms": 10.0, "p90Ms": 10.0}
+        assert summary["brokers"]["bk_b"]["queries"] == 3
+        assert summary["brokers"]["bk_b"]["errors"] == 1
+        # CLI accepts multiple paths
+        assert ql.main([str(a), str(b), "--json"]) == 0
